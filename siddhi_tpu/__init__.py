@@ -1,0 +1,36 @@
+"""siddhi_tpu — a TPU-native stream-processing / CEP framework.
+
+A from-scratch re-design of the capabilities of Siddhi 4.x
+(reference: /root/reference, single-JVM Java event-at-a-time engine) for
+TPU hardware: queries compile to a small number of fused, batched JAX/XLA
+array programs over columnar micro-batches; partitions and concurrent
+queries become batch/shard axes over a `jax.sharding.Mesh`.
+
+Public facade (mirrors reference core:SiddhiManager.java:45 /
+core:SiddhiAppRuntime.java:93):
+
+    from siddhi_tpu import SiddhiManager
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime('''
+        define stream StockStream (symbol string, price double, volume int);
+        @info(name='q1')
+        from StockStream[price > 100] select symbol, price insert into OutStream;
+    ''')
+    rt.add_callback("OutStream", lambda events: ...)
+    h = rt.input_handler("StockStream")
+    rt.start()
+    h.send(("IBM", 101.0, 5))
+    rt.flush()          # drain micro-batch through the compiled kernels
+"""
+
+from .query import ast, parse, parse_expression, parse_query, parse_store_query
+from .core.runtime import SiddhiAppRuntime, SiddhiManager
+from .core.schema import StreamSchema
+from .core.batch import EventBatch
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "SiddhiManager", "SiddhiAppRuntime", "StreamSchema", "EventBatch",
+    "ast", "parse", "parse_query", "parse_store_query", "parse_expression",
+]

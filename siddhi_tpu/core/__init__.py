@@ -1,0 +1,1 @@
+"""Core runtime: schemas, columnar batches, expression compiler, plans."""

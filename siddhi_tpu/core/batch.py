@@ -1,0 +1,113 @@
+"""Columnar event micro-batches (struct-of-arrays) + host-side accumulator.
+
+The TPU replacement for the reference's pooled linked-list event chunks
+(reference: core:event/ComplexEventChunk.java:29, StreamEventPool.java:26):
+instead of borrowing pooled row objects per event, the host accumulates rows
+into per-attribute numpy buffers; `freeze()` yields an immutable EventBatch
+whose columns ship to device as one contiguous array each.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from .schema import STRING_CODE_DTYPE, TIMESTAMP_DTYPE, StreamSchema, StringTable, dtype_of
+from ..query.ast import AttrType
+
+
+@dataclass
+class EventBatch:
+    """One micro-batch of events for a single stream. Immutable."""
+    schema: StreamSchema
+    timestamps: np.ndarray            # (n,) int64 ms
+    columns: dict                     # name -> (n,) ndarray
+    n: int
+
+    def column(self, name: str) -> np.ndarray:
+        return self.columns[name]
+
+    def rows(self, strings: Optional[StringTable] = None) -> list[tuple]:
+        """Decode back to row tuples (strings decoded if table given)."""
+        out = []
+        for i in range(self.n):
+            row = []
+            for a in self.schema.attributes:
+                v = self.columns[a.name][i]
+                if a.type == AttrType.STRING and strings is not None:
+                    row.append(strings.decode(int(v)))
+                elif a.type == AttrType.BOOL:
+                    row.append(bool(v))
+                elif a.type in (AttrType.INT, AttrType.LONG):
+                    row.append(int(v))
+                elif a.type in (AttrType.FLOAT, AttrType.DOUBLE):
+                    row.append(float(v))
+                else:
+                    row.append(v)
+            out.append(tuple(row))
+        return out
+
+    @classmethod
+    def empty(cls, schema: StreamSchema) -> "EventBatch":
+        cols = {a.name: np.empty(0, dtype=dtype_of(a.type)) for a in schema.attributes}
+        return cls(schema, np.empty(0, dtype=TIMESTAMP_DTYPE), cols, 0)
+
+    @classmethod
+    def from_rows(cls, schema: StreamSchema, rows: Sequence[tuple],
+                  timestamps: Sequence[int], strings: StringTable) -> "EventBatch":
+        b = BatchBuilder(schema, strings)
+        for ts, row in zip(timestamps, rows):
+            b.append(ts, row)
+        return b.freeze()
+
+
+class BatchBuilder:
+    """Mutable row accumulator -> EventBatch.  The per-stream ingest buffer
+    behind InputHandler (analog of the junction's ring slot filling,
+    reference: core:stream/StreamJunction.java:150-275)."""
+
+    def __init__(self, schema: StreamSchema, strings: StringTable,
+                 capacity: int = 1024):
+        self.schema = schema
+        self.strings = strings
+        self.capacity = capacity
+        self._ts: list[int] = []
+        self._cols: dict[str, list] = {a.name: [] for a in schema.attributes}
+
+    def __len__(self) -> int:
+        return len(self._ts)
+
+    @property
+    def full(self) -> bool:
+        return len(self._ts) >= self.capacity
+
+    def append(self, timestamp: int, row: Sequence[Any]) -> None:
+        attrs = self.schema.attributes
+        if len(row) != len(attrs):
+            raise ValueError(
+                f"stream {self.schema.id!r} expects {len(attrs)} attributes "
+                f"{self.schema.names}, got {len(row)}: {row!r}")
+        self._ts.append(int(timestamp))
+        for a, v in zip(attrs, row):
+            if a.type == AttrType.STRING:
+                v = self.strings.encode(v)
+            self._cols[a.name].append(v)
+
+    def freeze_and_clear(self) -> EventBatch:
+        b = self.freeze()
+        self._ts = []
+        self._cols = {a.name: [] for a in self.schema.attributes}
+        return b
+
+    def freeze(self) -> EventBatch:
+        n = len(self._ts)
+        cols = {}
+        for a in self.schema.attributes:
+            dt = dtype_of(a.type)
+            if dt == np.dtype(object):
+                cols[a.name] = np.asarray(self._cols[a.name], dtype=object)
+            else:
+                cols[a.name] = np.asarray(self._cols[a.name], dtype=dt)
+        return EventBatch(self.schema, np.asarray(self._ts, dtype=TIMESTAMP_DTYPE),
+                          cols, n)

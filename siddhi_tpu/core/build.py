@@ -1,0 +1,54 @@
+"""App builder: walks the AST's execution elements and instantiates plans.
+
+Analog of the reference's SiddhiAppParser.parse loop (reference:
+core:util/parser/SiddhiAppParser.java:225-254) + QueryParser dispatch.
+Kept separate from runtime.py so the runtime facade stays small.
+"""
+from __future__ import annotations
+
+from ..query import ast
+from .planner import (FilterProjectPlan, PlanError, output_target_of,
+                      selector_has_aggregators)
+
+
+def build_app(rt) -> None:
+    """Populate rt (SiddhiAppRuntime) with plans from rt.app."""
+    app = rt.app
+    for i, elem in enumerate(app.execution_elements):
+        if isinstance(elem, ast.Query):
+            plan = plan_query(rt, elem, default_name=f"query_{i}")
+            rt._register_plan(plan)
+        elif isinstance(elem, ast.Partition):
+            plan_partition(rt, elem, index=i)
+        else:
+            raise PlanError(f"unknown execution element {type(elem).__name__}")
+
+
+def plan_query(rt, q: ast.Query, default_name: str):
+    name = q.name(default_name)
+    target = output_target_of(q)
+    inp = q.input
+
+    if isinstance(inp, ast.SingleInputStream):
+        if inp.stream_id not in rt.schemas:
+            raise PlanError(f"query {name!r}: unknown input stream {inp.stream_id!r}")
+        schema = rt.schemas[inp.stream_id]
+        has_window = inp.window is not None
+        has_agg = selector_has_aggregators(q.selector) or q.selector.group_by
+        if not has_window and not has_agg:
+            if not isinstance(q.output, (ast.InsertInto, ast.ReturnAction)):
+                raise PlanError(f"query {name!r}: table ops not yet supported")
+            if q.rate is not None:
+                raise PlanError(f"query {name!r}: output rate limiting not yet supported")
+            filters = [f.expr for f in inp.filters]
+            return FilterProjectPlan(
+                name, schema, inp.alias, filters, q.selector, rt.strings,
+                target, q.selector.limit, q.selector.offset,
+                events_for=q.output.events_for)
+        raise PlanError(f"query {name!r}: windows/aggregations not yet supported")
+
+    raise PlanError(f"query {name!r}: input type {type(inp).__name__} not yet supported")
+
+
+def plan_partition(rt, p: ast.Partition, index: int) -> None:
+    raise PlanError("partitions not yet supported")

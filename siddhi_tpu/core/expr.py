@@ -1,0 +1,410 @@
+"""Expression compiler: AST expression trees -> fused JAX columnar kernels.
+
+The TPU replacement for the reference's interpreted per-event executor tree
+(reference: core:executor/ExpressionExecutor.java + ~10k LoC of per-type
+executor classes under core:executor/{condition,math,function}/ and
+core:util/parser/ExpressionParser.java:231).  Where the reference walks one
+executor object per AST node per event, here the whole expression compiles
+once into a closed jnp function evaluated over entire columns; XLA fuses the
+tree into a handful of vector ops.
+
+Compiled signature:  fn(env: dict[str, jnp.ndarray]) -> jnp.ndarray
+where env maps flattened variable keys ("price", "e1.price") to columns.
+
+Type rules follow Java numeric promotion like the reference's typed executor
+dispatch (int/long -> trunc division, widest type wins).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..query import ast
+from ..query.ast import AttrType, CompareOp, MathOp
+
+Array = jnp.ndarray
+Env = dict
+
+
+@dataclass
+class CompiledExpr:
+    fn: Callable[[Env], Array]
+    type: AttrType
+    # variable env keys this expression reads (for wiring/pruning)
+    reads: frozenset
+
+
+class ExprError(Exception):
+    pass
+
+
+class ExprContext:
+    """Resolves variables / functions for one compilation site."""
+
+    def resolve(self, var: ast.Variable) -> tuple[str, AttrType]:
+        raise NotImplementedError
+
+    def resolve_string_constant(self, s: str) -> int:
+        """Encode a string literal to its dictionary code."""
+        raise NotImplementedError
+
+
+class SingleStreamContext(ExprContext):
+    """Variables resolve against a single stream schema (+ optional alias)."""
+
+    def __init__(self, schema, strings, alias: Optional[str] = None,
+                 extra: Optional[dict] = None):
+        self.schema = schema
+        self.strings = strings
+        self.alias = alias or schema.id
+        self.extra = extra or {}     # name -> (key, AttrType), e.g. group-by outputs
+
+    def resolve(self, var: ast.Variable) -> tuple[str, AttrType]:
+        if var.stream_ref is not None and var.stream_ref not in (self.alias, self.schema.id):
+            raise ExprError(
+                f"unknown stream reference {var.stream_ref!r} (stream is "
+                f"{self.schema.id!r} / alias {self.alias!r})")
+        if var.attribute in self.extra and var.stream_ref is None:
+            return self.extra[var.attribute]
+        return var.attribute, self.schema.type_of(var.attribute)
+
+    def resolve_string_constant(self, s: str) -> int:
+        return self.strings.encode(s)
+
+
+class MultiStreamContext(ExprContext):
+    """Variables resolve against several named schemas (joins, patterns).
+
+    keys in env are "<ref>.<attr>"; unqualified attrs resolve if unambiguous.
+    For pattern count-states, indexed refs ("e1[0].x") get key
+    "<ref>[<idx>].<attr>".
+    """
+
+    def __init__(self, schemas: dict, strings, extra: Optional[dict] = None):
+        self.schemas = schemas       # ref -> StreamSchema
+        self.strings = strings
+        self.extra = extra or {}
+
+    def resolve(self, var: ast.Variable) -> tuple[str, AttrType]:
+        if var.stream_ref is None:
+            if var.attribute in self.extra:
+                return self.extra[var.attribute]
+            hits = [(ref, s) for ref, s in self.schemas.items()
+                    if var.attribute in s.types]
+            if not hits:
+                raise ExprError(f"unknown attribute {var.attribute!r}")
+            if len(hits) > 1:
+                raise ExprError(
+                    f"ambiguous attribute {var.attribute!r} (in "
+                    f"{[r for r, _ in hits]}); qualify with stream ref")
+            ref, schema = hits[0]
+            return f"{ref}.{var.attribute}", schema.type_of(var.attribute)
+        ref = var.stream_ref
+        if ref not in self.schemas:
+            raise ExprError(f"unknown stream reference {ref!r}; have {list(self.schemas)}")
+        schema = self.schemas[ref]
+        if var.index is not None:
+            return (f"{ref}[{var.index}].{var.attribute}",
+                    schema.type_of(var.attribute))
+        return f"{ref}.{var.attribute}", schema.type_of(var.attribute)
+
+    def resolve_string_constant(self, s: str) -> int:
+        return self.strings.encode(s)
+
+
+# ---------------------------------------------------------------------------
+# type algebra (Java numeric promotion, reference ExpressionParser dispatch)
+# ---------------------------------------------------------------------------
+
+_NUM_RANK = {AttrType.INT: 0, AttrType.LONG: 1, AttrType.FLOAT: 2, AttrType.DOUBLE: 3}
+_RANK_NUM = {v: k for k, v in _NUM_RANK.items()}
+
+
+def promote(a: AttrType, b: AttrType) -> AttrType:
+    if a not in _NUM_RANK or b not in _NUM_RANK:
+        raise ExprError(f"cannot apply arithmetic to {a}/{b}")
+    return _RANK_NUM[max(_NUM_RANK[a], _NUM_RANK[b])]
+
+
+_JNP_OF = {
+    AttrType.INT: jnp.int32, AttrType.LONG: jnp.int64,
+    AttrType.FLOAT: jnp.float32, AttrType.DOUBLE: jnp.float64,
+    AttrType.BOOL: jnp.bool_, AttrType.STRING: jnp.int32,
+}
+
+
+def jnp_dtype(t: AttrType):
+    return _JNP_OF[t]
+
+
+def _cast(x: Array, t: AttrType) -> Array:
+    return x.astype(jnp_dtype(t))
+
+
+# ---------------------------------------------------------------------------
+# scalar function registry (extension point; analog of @Extension functions,
+# reference: core:executor/function/*, core:util/SiddhiExtensionLoader.java:50)
+# ---------------------------------------------------------------------------
+
+# (namespace, name) -> builder(args: list[CompiledExpr], ctx) -> CompiledExpr
+SCALAR_FUNCTIONS: dict = {}
+
+
+def register_scalar_function(name: str, builder, namespace: Optional[str] = None):
+    SCALAR_FUNCTIONS[(namespace, name.lower())] = builder
+
+
+def _fn_if_then_else(args, ctx):
+    c, a, b = args
+    if c.type != AttrType.BOOL:
+        raise ExprError("ifThenElse condition must be bool")
+    t = a.type if a.type == b.type else promote(a.type, b.type)
+    return CompiledExpr(
+        lambda env: jnp.where(c.fn(env), _cast(a.fn(env), t), _cast(b.fn(env), t)),
+        t, c.reads | a.reads | b.reads)
+
+
+def _fn_coalesce(args, ctx):
+    # device columns have no nulls except string code 0; coalesce picks the
+    # first non-zero string code / first arg for numerics.
+    t = args[0].type
+    if t == AttrType.STRING:
+        def fn(env):
+            out = args[0].fn(env)
+            for a in args[1:]:
+                out = jnp.where(out != 0, out, a.fn(env))
+            return out
+        return CompiledExpr(fn, t, frozenset().union(*[a.reads for a in args]))
+    return args[0]
+
+
+def _make_convert(target: AttrType):
+    def build(args, ctx):
+        src = args[0]
+        return CompiledExpr(lambda env: _cast(src.fn(env), target), target, src.reads)
+    return build
+
+
+def _fn_convert(args, ctx):
+    raise ExprError("convert(x, 'type') handled in compile_function")
+
+
+def _fn_math1(jfn, out_type=None):
+    def build(args, ctx):
+        a = args[0]
+        t = out_type or (AttrType.DOUBLE if a.type in (AttrType.FLOAT, AttrType.DOUBLE)
+                         else a.type)
+        return CompiledExpr(lambda env: _cast(jfn(a.fn(env)), t), t, a.reads)
+    return build
+
+
+def _fn_minmax(jfn):
+    def build(args, ctx):
+        t = args[0].type
+        for a in args[1:]:
+            t = promote(t, a.type)
+        def fn(env):
+            out = _cast(args[0].fn(env), t)
+            for a in args[1:]:
+                out = jfn(out, _cast(a.fn(env), t))
+            return out
+        return CompiledExpr(fn, t, frozenset().union(*[a.reads for a in args]))
+    return build
+
+
+register_scalar_function("ifthenelse", _fn_if_then_else)
+register_scalar_function("coalesce", _fn_coalesce)
+register_scalar_function("maximum", _fn_minmax(jnp.maximum))
+register_scalar_function("minimum", _fn_minmax(jnp.minimum))
+register_scalar_function("abs", _fn_math1(jnp.abs), namespace="math")
+register_scalar_function("sqrt", _fn_math1(jnp.sqrt, AttrType.DOUBLE), namespace="math")
+register_scalar_function("log", _fn_math1(jnp.log, AttrType.DOUBLE), namespace="math")
+register_scalar_function("exp", _fn_math1(jnp.exp, AttrType.DOUBLE), namespace="math")
+register_scalar_function("floor", _fn_math1(jnp.floor, AttrType.DOUBLE), namespace="math")
+register_scalar_function("ceil", _fn_math1(jnp.ceil, AttrType.DOUBLE), namespace="math")
+register_scalar_function("round", _fn_math1(jnp.round), namespace="math")
+register_scalar_function("sin", _fn_math1(jnp.sin, AttrType.DOUBLE), namespace="math")
+register_scalar_function("cos", _fn_math1(jnp.cos, AttrType.DOUBLE), namespace="math")
+register_scalar_function("power", _fn_minmax(jnp.power), namespace="math")
+
+
+# ---------------------------------------------------------------------------
+# the compiler
+# ---------------------------------------------------------------------------
+
+def compile_expression(expr: ast.Expression, ctx: ExprContext) -> CompiledExpr:
+    if isinstance(expr, ast.Constant):
+        return _compile_constant(expr, ctx)
+    if isinstance(expr, ast.TimeConstant):
+        v = jnp.asarray(expr.millis, dtype=jnp.int64)
+        return CompiledExpr(lambda env: v, AttrType.LONG, frozenset())
+    if isinstance(expr, ast.Variable):
+        key, t = ctx.resolve(expr)
+        return CompiledExpr(lambda env: env[key], t, frozenset([key]))
+    if isinstance(expr, ast.Compare):
+        return _compile_compare(expr, ctx)
+    if isinstance(expr, ast.And):
+        l, r = compile_expression(expr.left, ctx), compile_expression(expr.right, ctx)
+        _want_bool(l, r)
+        return CompiledExpr(lambda env: l.fn(env) & r.fn(env), AttrType.BOOL,
+                            l.reads | r.reads)
+    if isinstance(expr, ast.Or):
+        l, r = compile_expression(expr.left, ctx), compile_expression(expr.right, ctx)
+        _want_bool(l, r)
+        return CompiledExpr(lambda env: l.fn(env) | r.fn(env), AttrType.BOOL,
+                            l.reads | r.reads)
+    if isinstance(expr, ast.Not):
+        e = compile_expression(expr.expr, ctx)
+        _want_bool(e)
+        return CompiledExpr(lambda env: ~e.fn(env), AttrType.BOOL, e.reads)
+    if isinstance(expr, ast.Math):
+        return _compile_math(expr, ctx)
+    if isinstance(expr, ast.FunctionCall):
+        return _compile_function(expr, ctx)
+    if isinstance(expr, ast.IsNull):
+        return _compile_is_null(expr, ctx)
+    if isinstance(expr, ast.In):
+        raise ExprError("'in Table' must be rewritten by the table planner "
+                        "before expression compilation")
+    raise ExprError(f"cannot compile expression node {type(expr).__name__}")
+
+
+def _compile_constant(expr: ast.Constant, ctx: ExprContext) -> CompiledExpr:
+    t = expr.type
+    if t == AttrType.STRING:
+        code = ctx.resolve_string_constant(expr.value)
+        v = jnp.asarray(code, dtype=jnp.int32)
+    else:
+        v = jnp.asarray(expr.value, dtype=jnp_dtype(t))
+    return CompiledExpr(lambda env: v, t, frozenset())
+
+
+def _want_bool(*exprs: CompiledExpr):
+    for e in exprs:
+        if e.type != AttrType.BOOL:
+            raise ExprError(f"expected bool operand, got {e.type}")
+
+
+def _compile_compare(expr: ast.Compare, ctx: ExprContext) -> CompiledExpr:
+    l = compile_expression(expr.left, ctx)
+    r = compile_expression(expr.right, ctx)
+    if AttrType.STRING in (l.type, r.type):
+        if l.type != r.type:
+            raise ExprError(f"cannot compare {l.type} with {r.type}")
+        if expr.op not in (CompareOp.EQ, CompareOp.NEQ):
+            raise ExprError("strings support only ==/!= on device")
+        op = {CompareOp.EQ: lambda a, b: a == b,
+              CompareOp.NEQ: lambda a, b: a != b}[expr.op]
+        return CompiledExpr(lambda env: op(l.fn(env), r.fn(env)), AttrType.BOOL,
+                            l.reads | r.reads)
+    if AttrType.BOOL in (l.type, r.type):
+        if l.type != r.type or expr.op not in (CompareOp.EQ, CompareOp.NEQ):
+            raise ExprError(f"bad bool comparison {l.type} {expr.op} {r.type}")
+    else:
+        t = promote(l.type, r.type)
+        lf, rf = l.fn, r.fn
+        l = CompiledExpr(lambda env: _cast(lf(env), t), t, l.reads)
+        r = CompiledExpr(lambda env: _cast(rf(env), t), t, r.reads)
+    ops = {
+        CompareOp.LT: lambda a, b: a < b,
+        CompareOp.LE: lambda a, b: a <= b,
+        CompareOp.GT: lambda a, b: a > b,
+        CompareOp.GE: lambda a, b: a >= b,
+        CompareOp.EQ: lambda a, b: a == b,
+        CompareOp.NEQ: lambda a, b: a != b,
+    }
+    op = ops[expr.op]
+    lf2, rf2 = l.fn, r.fn
+    return CompiledExpr(lambda env: op(lf2(env), rf2(env)), AttrType.BOOL,
+                        l.reads | r.reads)
+
+
+def _compile_math(expr: ast.Math, ctx: ExprContext) -> CompiledExpr:
+    l = compile_expression(expr.left, ctx)
+    r = compile_expression(expr.right, ctx)
+    t = promote(l.type, r.type)
+    is_int = t in (AttrType.INT, AttrType.LONG)
+    lf, rf = l.fn, r.fn
+    if expr.op == MathOp.ADD:
+        fn = lambda env: _cast(lf(env), t) + _cast(rf(env), t)
+    elif expr.op == MathOp.SUB:
+        fn = lambda env: _cast(lf(env), t) - _cast(rf(env), t)
+    elif expr.op == MathOp.MUL:
+        fn = lambda env: _cast(lf(env), t) * _cast(rf(env), t)
+    elif expr.op == MathOp.DIV:
+        if is_int:
+            # Java int division truncates toward zero (lax.div semantics)
+            fn = lambda env: lax.div(_cast(lf(env), t), _cast(rf(env), t))
+        else:
+            fn = lambda env: _cast(lf(env), t) / _cast(rf(env), t)
+    elif expr.op == MathOp.MOD:
+        # Java % truncated remainder == lax.rem
+        fn = lambda env: lax.rem(_cast(lf(env), t), _cast(rf(env), t))
+    else:
+        raise ExprError(f"unknown math op {expr.op}")
+    return CompiledExpr(fn, t, l.reads | r.reads)
+
+
+# functions resolvable statically at compile time
+_CONVERT_TYPES = {"string": AttrType.STRING, "int": AttrType.INT,
+                  "long": AttrType.LONG, "float": AttrType.FLOAT,
+                  "double": AttrType.DOUBLE, "bool": AttrType.BOOL}
+
+
+def _compile_function(expr: ast.FunctionCall, ctx: ExprContext) -> CompiledExpr:
+    name = expr.name.lower()
+    ns = expr.namespace.lower() if expr.namespace else None
+    if ns is None and name in ("convert", "cast"):
+        src = compile_expression(expr.args[0], ctx)
+        if not isinstance(expr.args[1], ast.Constant):
+            raise ExprError(f"{name} target type must be a literal")
+        target = _CONVERT_TYPES[str(expr.args[1].value).lower()]
+        if target == AttrType.STRING or src.type == AttrType.STRING:
+            if src.type == target:
+                return src
+            raise ExprError("string<->numeric conversion is a host-side op")
+        return CompiledExpr(lambda env: _cast(src.fn(env), target), target, src.reads)
+    if ns is None and name == "eventtimestamp":
+        return CompiledExpr(lambda env: env["__timestamp__"], AttrType.LONG,
+                            frozenset(["__timestamp__"]))
+    if ns is None and name.startswith("instanceof"):
+        kind = name[len("instanceof"):]
+        src = compile_expression(expr.args[0], ctx)
+        expected = {"integer": AttrType.INT, "long": AttrType.LONG,
+                    "float": AttrType.FLOAT, "double": AttrType.DOUBLE,
+                    "boolean": AttrType.BOOL, "string": AttrType.STRING}.get(kind)
+        ok = src.type == expected
+        v = jnp.asarray(ok)
+        return CompiledExpr(lambda env: jnp.broadcast_to(v, _any_shape(env)),
+                            AttrType.BOOL, src.reads)
+    builder = SCALAR_FUNCTIONS.get((ns, name))
+    if builder is None:
+        raise ExprError(f"unknown function {ns or ''}:{name}" if ns
+                        else f"unknown function {name}()")
+    args = [compile_expression(a, ctx) for a in expr.args]
+    return builder(args, ctx)
+
+
+def _any_shape(env):
+    for v in env.values():
+        if hasattr(v, "shape") and v.ndim > 0:
+            return v.shape
+    return ()
+
+
+def _compile_is_null(expr: ast.IsNull, ctx: ExprContext) -> CompiledExpr:
+    if expr.expr is not None:
+        e = compile_expression(expr.expr, ctx)
+        if e.type == AttrType.STRING:
+            return CompiledExpr(lambda env: e.fn(env) == 0, AttrType.BOOL, e.reads)
+        # numeric device columns cannot be null
+        return CompiledExpr(lambda env: jnp.zeros(_any_shape(env), dtype=bool),
+                            AttrType.BOOL, e.reads)
+    # `e1 is null` — pattern state presence; resolved by the NFA compiler via
+    # a presence column in env.
+    ref = expr.stream_ref
+    key = f"__present__.{ref}" if expr.index is None else f"__present__.{ref}[{expr.index}]"
+    return CompiledExpr(lambda env: ~env[key], AttrType.BOOL, frozenset([key]))

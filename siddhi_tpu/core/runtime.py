@@ -1,0 +1,313 @@
+"""Engine facade: SiddhiManager / SiddhiAppRuntime / InputHandler / callbacks.
+
+The TPU framework's analog of the reference runtime layer (reference:
+core:SiddhiManager.java:45, core:SiddhiAppRuntime.java:93,
+core:stream/input/InputHandler.java:51, core:stream/StreamJunction.java:62).
+
+Execution model difference, by design: the reference walks a processor
+graph per event on the caller thread.  Here events accumulate into
+host-side columnar builders (per stream); `flush()` drains them as
+micro-batches through the compiled array programs and routes outputs —
+batched dataflow instead of event-at-a-time interpretation.  `send()`
+auto-flushes when a builder reaches capacity.
+"""
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Sequence, Union
+
+from ..query import ast as qast
+from ..query.parser import parse
+from .batch import BatchBuilder, EventBatch
+from .planner import OutputBatch, PlanError, QueryPlan
+from .schema import StreamSchema, StringTable
+
+
+@dataclass
+class Event:
+    """Host-side decoded event (reference: core:event/Event.java)."""
+    timestamp: int
+    data: tuple
+
+    def __iter__(self):
+        return iter(self.data)
+
+
+class InputHandler:
+    """User-facing ingest handle (reference: InputHandler.send:51-94)."""
+
+    def __init__(self, runtime: "SiddhiAppRuntime", stream_id: str):
+        self._rt = runtime
+        self.stream_id = stream_id
+
+    def send(self, data, timestamp: Optional[int] = None) -> None:
+        """Accepts one row tuple, a list of row tuples, or an Event."""
+        self._rt.send(self.stream_id, data, timestamp)
+
+
+class SiddhiAppRuntime:
+    def __init__(self, app: qast.SiddhiApp, manager: Optional["SiddhiManager"] = None):
+        self.app = app
+        self.manager = manager
+        self.strings = StringTable()
+        self.batch_capacity = 2048
+        self._started = False
+        self._playback = qast.find_annotation(app.annotations, "app:playback") is not None
+        self._clock_ms: Optional[int] = None   # virtual/playback clock
+
+        # stream schemas: defined + inferred from query outputs
+        self.schemas: dict = {}
+        for sid, sd in app.stream_definitions.items():
+            self.schemas[sid] = StreamSchema.of(sd)
+
+        self.tables: dict = {}
+        self.named_windows: dict = {}
+        self.aggregations: dict = {}
+
+        self._plans: list[QueryPlan] = []
+        self._subscribers: dict = defaultdict(list)   # stream_id -> [plan]
+        self._stream_callbacks: dict = defaultdict(list)
+        self._query_callbacks: dict = defaultdict(list)
+        self._plan_by_name: dict = {}
+
+        self._builders: dict = {}
+        self._pending: list = []      # FIFO of (stream_id, EventBatch) awaiting dispatch
+
+        self._build()
+
+    # -- construction --------------------------------------------------------
+
+    def _build(self) -> None:
+        from . import build as _build_mod
+        _build_mod.build_app(self)
+
+    def _register_plan(self, plan: QueryPlan) -> None:
+        self._plans.append(plan)
+        self._plan_by_name[plan.name] = plan
+        for sid in plan.input_streams:
+            self._subscribers[sid].append(plan)
+        tgt = plan.output_target
+        if tgt is not None and plan.out_schema is not None and tgt not in self.tables:
+            if tgt in self.schemas:
+                have = self.schemas[tgt]
+                want = plan.out_schema
+                if [a.type for a in have.attributes] != [a.type for a in want.attributes]:
+                    raise PlanError(
+                        f"query {plan.name!r} inserts into {tgt!r} with mismatched "
+                        f"schema {want.attributes} vs {have.attributes}")
+            else:
+                self.schemas[tgt] = StreamSchema(tgt, plan.out_schema.attributes)
+
+    # -- public API ----------------------------------------------------------
+
+    def input_handler(self, stream_id: str) -> InputHandler:
+        if stream_id not in self.schemas:
+            raise KeyError(f"unknown stream {stream_id!r}")
+        return InputHandler(self, stream_id)
+
+    # alias matching the reference name
+    getInputHandler = input_handler
+
+    def add_callback(self, stream_id: str, fn: Callable) -> None:
+        """StreamCallback: fn(list[Event]) on every batch reaching stream_id."""
+        self._stream_callbacks[stream_id].append(fn)
+
+    def add_query_callback(self, query_name: str, fn: Callable) -> None:
+        """QueryCallback: fn(timestamp_ms, in_events, removed_events)."""
+        if query_name not in self._plan_by_name:
+            raise KeyError(f"unknown query {query_name!r}; have {list(self._plan_by_name)}")
+        self._query_callbacks[query_name].append(fn)
+
+    def start(self) -> None:
+        self._started = True
+
+    def shutdown(self) -> None:
+        self.flush()
+        self._started = False
+
+    # -- time ----------------------------------------------------------------
+
+    def now_ms(self) -> int:
+        if self._clock_ms is not None:
+            return self._clock_ms
+        return int(time.time() * 1000)
+
+    def set_time(self, ms: int) -> None:
+        """Advance the virtual clock (playback/test mode) and fire timers."""
+        self._clock_ms = ms
+        self._fire_timers(ms)
+
+    def _fire_timers(self, now_ms: int) -> None:
+        for plan in self._plans:
+            for ob in plan.on_timer(now_ms):
+                self._emit(plan, ob)
+        self._drain()
+
+    # -- ingest --------------------------------------------------------------
+
+    def send(self, stream_id: str, data, timestamp: Optional[int] = None) -> None:
+        schema = self.schemas[stream_id]
+        b = self._builders.get(stream_id)
+        if b is None:
+            b = self._builders[stream_id] = BatchBuilder(schema, self.strings,
+                                                         self.batch_capacity)
+        def advance(ts: int) -> int:
+            if self._playback:
+                self._clock_ms = ts
+            return ts
+
+        if isinstance(data, Event):
+            b.append(advance(data.timestamp if timestamp is None else timestamp),
+                     data.data)
+        elif data and isinstance(data, (list,)) and isinstance(data[0], (tuple, list, Event)):
+            for row in data:
+                if isinstance(row, Event):
+                    b.append(advance(row.timestamp), row.data)
+                else:
+                    b.append(advance(self.now_ms() if timestamp is None else timestamp),
+                             row)
+        else:
+            ts = self.now_ms() if timestamp is None else timestamp
+            if timestamp is not None:
+                advance(ts)
+            b.append(ts, tuple(data))
+        if b.full:
+            self.flush()
+
+    # -- dispatch ------------------------------------------------------------
+
+    def flush(self) -> None:
+        """Drain all pending builders through the compiled plans."""
+        for sid, b in self._builders.items():
+            if len(b):
+                self._pending.append((sid, b.freeze_and_clear()))
+        self._drain()
+
+    def _drain(self) -> None:
+        guard = 0
+        while self._pending:
+            guard += 1
+            if guard > 100_000:
+                raise RuntimeError("runaway stream recursion (insert-into cycle?)")
+            sid, batch = self._pending.pop(0)
+            for cb in self._stream_callbacks.get(sid, ()):  # junction callbacks
+                cb(self._decode(batch))
+            for plan in self._subscribers.get(sid, ()):
+                for ob in plan.process(sid, batch):
+                    self._emit(plan, ob)
+
+    def _emit(self, plan: QueryPlan, ob: OutputBatch) -> None:
+        if ob.batch.n == 0:
+            return
+        for cb in self._query_callbacks.get(plan.name, ()):
+            events = self._decode(ob.batch)
+            if ob.is_expired:
+                cb(int(ob.batch.timestamps[-1]), None, events)
+            else:
+                cb(int(ob.batch.timestamps[-1]), events, None)
+        if ob.target is not None and not ob.is_expired:
+            self._pending.append((ob.target, ob.batch))
+
+    def _decode(self, batch: EventBatch) -> list:
+        rows = batch.rows(self.strings)
+        return [Event(int(ts), row) for ts, row in zip(batch.timestamps, rows)]
+
+    # -- persistence (full snapshot; reference SiddhiAppRuntime.persist:595) --
+
+    def snapshot(self) -> dict:
+        self.flush()
+        return {
+            "strings": self.strings.state(),
+            "plans": {p.name: p.state_dict() for p in self._plans},
+            "tables": {k: t.state_dict() for k, t in self.tables.items()},
+            "clock": self._clock_ms,
+        }
+
+    def restore(self, snap: dict) -> None:
+        self.strings.restore(snap["strings"])
+        for name, st in snap["plans"].items():
+            if name in self._plan_by_name:
+                self._plan_by_name[name].load_state_dict(st)
+        for k, st in snap.get("tables", {}).items():
+            if k in self.tables:
+                self.tables[k].load_state_dict(st)
+        self._clock_ms = snap.get("clock")
+
+    def persist(self) -> str:
+        if self.manager is None or self.manager.persistence_store is None:
+            raise RuntimeError("no persistence store configured")
+        import pickle
+        rev = f"{self.app.name}-{time.time_ns()}"
+        self.manager.persistence_store.save(self.app.name, rev,
+                                            pickle.dumps(self.snapshot()))
+        return rev
+
+    def restore_revision(self, rev: str) -> None:
+        import pickle
+        data = self.manager.persistence_store.load(self.app.name, rev)
+        self.restore(pickle.loads(data))
+
+    def restore_last_state(self) -> None:
+        rev = self.manager.persistence_store.last_revision(self.app.name)
+        if rev is not None:
+            self.restore_revision(rev)
+
+
+class InMemoryPersistenceStore:
+    """reference: core:util/persistence/InMemoryPersistenceStore.java"""
+
+    def __init__(self):
+        self._data: dict = defaultdict(dict)
+        self._order: dict = defaultdict(list)
+
+    def save(self, app: str, revision: str, blob: bytes) -> None:
+        self._data[app][revision] = blob
+        self._order[app].append(revision)
+
+    def load(self, app: str, revision: str) -> bytes:
+        return self._data[app][revision]
+
+    def last_revision(self, app: str) -> Optional[str]:
+        revs = self._order[app]
+        return revs[-1] if revs else None
+
+
+class SiddhiManager:
+    """reference: core:SiddhiManager.java:45"""
+
+    def __init__(self):
+        self.persistence_store = None
+        self._runtimes: dict = {}
+
+    def create_app_runtime(self, app: Union[str, qast.SiddhiApp]) -> SiddhiAppRuntime:
+        if isinstance(app, str):
+            app = parse(app)
+        rt = SiddhiAppRuntime(app, self)
+        self._runtimes[rt.app.name] = rt
+        return rt
+
+    createSiddhiAppRuntime = create_app_runtime
+
+    def set_persistence_store(self, store) -> None:
+        self.persistence_store = store
+
+    def persist(self) -> None:
+        for rt in self._runtimes.values():
+            rt.persist()
+
+    def restore_last_state(self) -> None:
+        for rt in self._runtimes.values():
+            rt.restore_last_state()
+
+    def validate_app(self, app: Union[str, qast.SiddhiApp]) -> None:
+        """Compile-check an app without registering a runtime."""
+        if isinstance(app, str):
+            app = parse(app)
+        SiddhiAppRuntime(app, self).shutdown()
+
+    def shutdown(self) -> None:
+        for rt in list(self._runtimes.values()):
+            rt.shutdown()
+        self._runtimes.clear()

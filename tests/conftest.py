@@ -1,0 +1,11 @@
+"""Test config: force CPU backend with 8 virtual devices so sharding tests
+exercise a multi-chip mesh without TPU hardware (bench.py uses the real chip)."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,320 @@
+"""Front-end golden tests: SiddhiQL text -> AST structure.
+
+Mirrors the reference's query-compiler test strategy (parse a string, assert
+AST equivalence — reference: modules/siddhi-query-compiler/src/test/.../
+SimpleQueryTestCase.java, PatternQueryTestCase.java etc.)."""
+import pytest
+
+from siddhi_tpu.query import ast, parse, parse_expression, parse_query
+from siddhi_tpu.query.ast import AttrType, CompareOp, MathOp
+
+
+def test_define_stream():
+    app = parse("define stream StockStream (symbol string, price double, volume int);")
+    sd = app.stream_definitions["StockStream"]
+    assert sd.attributes == (
+        ast.Attribute("symbol", AttrType.STRING),
+        ast.Attribute("price", AttrType.DOUBLE),
+        ast.Attribute("volume", AttrType.INT),
+    )
+
+
+def test_app_annotations_and_table():
+    app = parse("""
+        @app:name('Test')  @app:statistics(reporter='console')
+        define stream S (a int);
+        @PrimaryKey('k') @Index('v')
+        define table T (k string, v int);
+    """)
+    assert app.name == "Test"
+    td = app.table_definitions["T"]
+    assert td.primary_keys() == ["k"]
+    assert td.indexes() == ["v"]
+
+
+def test_simple_filter_query():
+    app = parse("""
+        define stream StockStream (symbol string, price double, volume int);
+        @info(name='q1')
+        from StockStream[price > 100 and volume < 50] select symbol, price
+        insert into OutStream;
+    """)
+    q = app.execution_elements[0]
+    assert q.name("x") == "q1"
+    inp = q.input
+    assert isinstance(inp, ast.SingleInputStream)
+    assert inp.stream_id == "StockStream"
+    f = inp.filters[0].expr
+    assert isinstance(f, ast.And)
+    assert f.left == ast.Compare(ast.Variable("price"), CompareOp.GT,
+                                 ast.Constant(100, AttrType.INT))
+    assert q.selector.attributes[0].name == "symbol"
+    assert isinstance(q.output, ast.InsertInto)
+    assert q.output.target == "OutStream"
+
+
+def test_window_query_with_groupby_having():
+    q = parse_query("""
+        from StockStream#window.length(20)
+        select symbol, avg(price) as avgPrice
+        group by symbol
+        having avgPrice > 50
+        insert all events into OutStream
+    """)
+    w = q.input.window
+    assert w.name == "length"
+    assert w.args == (ast.Constant(20, AttrType.INT),)
+    assert q.selector.group_by == (ast.Variable("symbol"),)
+    assert isinstance(q.selector.having, ast.Compare)
+    assert q.output.events_for == ast.OutputEventsFor.ALL
+
+
+def test_time_windows_and_units():
+    q = parse_query("from S#window.time(1 min 30 sec) select * insert into O")
+    assert q.input.window.args == (ast.TimeConstant(90_000),)
+    q2 = parse_query("from S#window.timeBatch(500 ms) select * insert expired events into O")
+    assert q2.input.window.args == (ast.TimeConstant(500),)
+    assert q2.output.events_for == ast.OutputEventsFor.EXPIRED
+
+
+def test_join_query():
+    q = parse_query("""
+        from TickStream#window.length(10) as t
+        join NewsStream#window.time(5 sec) as n
+        on t.symbol == n.symbol
+        select t.symbol, t.price, n.headline
+        insert into JoinedStream
+    """)
+    j = q.input
+    assert isinstance(j, ast.JoinInputStream)
+    assert j.left.ref_id == "t" and j.right.ref_id == "n"
+    assert j.join_type == ast.JoinType.INNER
+    assert isinstance(j.on, ast.Compare)
+
+
+def test_left_outer_join():
+    q = parse_query("""
+        from A#window.length(5) left outer join B#window.length(5)
+        on A.x == B.x select A.x insert into O
+    """)
+    assert q.input.join_type == ast.JoinType.LEFT_OUTER
+
+
+def test_pattern_query():
+    q = parse_query("""
+        from every e1=StockStream[price > 100] -> e2=StockStream[price > e1.price]
+        within 1 sec
+        select e1.price as p1, e2.price as p2
+        insert into AlertStream
+    """)
+    st = q.input
+    assert isinstance(st, ast.StateInputStream)
+    assert st.type == ast.StateType.PATTERN
+    assert st.within == ast.TimeConstant(1000)
+    nxt = st.state
+    assert isinstance(nxt, ast.NextStateElement)
+    assert isinstance(nxt.state, ast.EveryStateElement)
+    e1 = nxt.state.state
+    assert isinstance(e1, ast.StreamStateElement)
+    assert e1.stream.ref_id == "e1"
+    e2 = nxt.next
+    assert isinstance(e2, ast.StreamStateElement)
+    # cross-state reference e1.price
+    f = e2.stream.filters[0].expr
+    assert f.right == ast.Variable("price", stream_ref="e1")
+
+
+def test_pattern_count_and_logical():
+    q = parse_query("""
+        from e1=A[x>1]<2:5> -> e2=B and e3=C -> not D[y==2] for 2 sec
+        select e1[0].x as first insert into O
+    """)
+    s = q.input.state
+    c = s.state
+    assert isinstance(c, ast.CountStateElement)
+    assert (c.min_count, c.max_count) == (2, 5)
+    mid = s.next.state
+    assert isinstance(mid, ast.LogicalStateElement) and mid.op == "and"
+    absent = s.next.next
+    assert isinstance(absent, ast.AbsentStreamStateElement)
+    assert absent.waiting_time == ast.TimeConstant(2000)
+    # indexed reference e1[0].x
+    sel = q.selector.attributes[0].expr
+    assert sel == ast.Variable("x", stream_ref="e1", index=0)
+
+
+def test_sequence_query():
+    q = parse_query("""
+        from every e1=Stock[price>100], e2=Stock[price>e1.price]
+        select e1.price, e2.price insert into O
+    """)
+    st = q.input
+    assert st.type == ast.StateType.SEQUENCE
+    assert isinstance(st.state, ast.NextStateElement)
+
+
+def test_sequence_regex_counts():
+    q = parse_query("from e1=A+, e2=B? select e1[0].x insert into O")
+    st = q.input
+    assert st.type == ast.StateType.SEQUENCE
+    plus = st.state.state
+    assert isinstance(plus, ast.CountStateElement)
+    assert (plus.min_count, plus.max_count) == (1, ast.CountStateElement.ANY)
+    opt = st.state.next
+    assert (opt.min_count, opt.max_count) == (0, 1)
+
+
+def test_partition():
+    app = parse("""
+        define stream S (symbol string, price double);
+        partition with (symbol of S)
+        begin
+            @info(name='pq')
+            from S select symbol, avg(price) as ap insert into #Inner;
+            from #Inner select * insert into Out;
+        end;
+    """)
+    p = app.execution_elements[0]
+    assert isinstance(p, ast.Partition)
+    assert p.keys[0].stream_id == "S"
+    assert p.keys[0].expr == ast.Variable("symbol")
+    assert len(p.queries) == 2
+    assert p.queries[0].output.is_inner
+    assert p.queries[1].input.is_inner
+
+
+def test_range_partition():
+    app = parse("""
+        define stream S (p double);
+        partition with (p < 10 as 'low' or p >= 10 as 'high' of S)
+        begin from S select p insert into O; end;
+    """)
+    k = app.execution_elements[0].keys[0]
+    assert len(k.ranges) == 2
+    assert k.ranges[0].key == "low"
+
+
+def test_output_rate():
+    q = parse_query("from S select a output last every 5 events insert into O")
+    assert q.rate == ast.EventOutputRate(5, ast.RateType.LAST)
+    q2 = parse_query("from S select a output snapshot every 1 sec insert into O")
+    assert q2.rate == ast.SnapshotOutputRate(1000)
+    q3 = parse_query("from S select a output every 100 ms insert into O")
+    assert q3.rate == ast.TimeOutputRate(100, ast.RateType.ALL)
+
+
+def test_table_ops():
+    q = parse_query("from S select sym, p update or insert into T set T.p = p on T.sym == sym")
+    assert isinstance(q.output, ast.UpdateOrInsertTable)
+    assert q.output.set_clauses[0].attribute == ast.Variable("p", stream_ref="T")
+    q2 = parse_query("from S delete T on T.sym == sym")
+    assert isinstance(q2.output, ast.DeleteFrom)
+    q3 = parse_query("from S select * update T set T.p = p + 1 on T.sym == sym")
+    assert isinstance(q3.output, ast.UpdateTable)
+
+
+def test_aggregation_definition():
+    app = parse("""
+        define stream S (symbol string, price double, ts long);
+        define aggregation TradeAgg
+        from S
+        select symbol, avg(price) as ap, sum(price) as total
+        group by symbol
+        aggregate by ts every sec ... year;
+    """)
+    agg = app.aggregation_definitions["TradeAgg"]
+    assert agg.by_attribute == ast.Variable("ts")
+    assert agg.durations[0] == ast.Duration.SECONDS
+    assert agg.durations[-1] == ast.Duration.YEARS
+    assert len(agg.durations) == 7
+
+
+def test_trigger_definitions():
+    app = parse("""
+        define trigger T5 at every 5 sec;
+        define trigger TStart at 'start';
+        define trigger TCron at '*/5 * * * * ?';
+    """)
+    assert app.trigger_definitions["T5"].at_every_millis == 5000
+    assert app.trigger_definitions["TStart"].at_start
+    assert app.trigger_definitions["TCron"].at_cron == "*/5 * * * * ?"
+    # triggers define an implicit stream
+    assert "T5" in app.stream_definitions
+
+
+def test_expressions():
+    e = parse_expression("a + b * 2 - c / 4 % 3")
+    # a + ((b*2)) - ((c/4)%3)  with left assoc
+    assert isinstance(e, ast.Math) and e.op == MathOp.SUB
+    e2 = parse_expression("not (a == 1 or b is null) and c in T")
+    assert isinstance(e2, ast.And)
+    assert isinstance(e2.left, ast.Not)
+    assert isinstance(e2.right, ast.In)
+    e3 = parse_expression("str:concat(a, 'x')")
+    assert e3 == ast.FunctionCall("concat", (ast.Variable("a"),
+                                             ast.Constant("x", AttrType.STRING)),
+                                  namespace="str")
+    e4 = parse_expression("-5")
+    assert e4 == ast.Constant(-5, AttrType.INT)
+
+
+def test_ifthenelse_and_functions():
+    e = parse_expression("ifThenElse(p > 10, 'hi', 'lo')")
+    assert isinstance(e, ast.FunctionCall)
+    assert len(e.args) == 3
+
+
+def test_source_sink_annotations():
+    app = parse("""
+        @source(type='inMemory', topic='t1', @map(type='passThrough'))
+        define stream In (a int);
+        @sink(type='inMemory', topic='t2', @map(type='json'))
+        define stream Out (a int);
+        from In select a insert into Out;
+    """)
+    src = ast.find_annotation(app.stream_definitions["In"].annotations, "source")
+    assert src.element("type") == "inMemory"
+    assert src.annotations[0].name == "map"
+
+
+def test_define_window_and_named_window_use():
+    app = parse("""
+        define stream S (a int);
+        define window W (a int) length(5) output all events;
+        from S insert into W;
+        from W select a insert into O;
+    """)
+    wd = app.window_definitions["W"]
+    assert wd.window.name == "length"
+    assert len(app.execution_elements) == 2
+
+
+def test_function_definition():
+    app = parse("""
+        define function concatFn[javascript] return string {
+            var x = { a: 1 };
+            return data[0] + data[1];
+        };
+    """)
+    fd = app.function_definitions["concatFn"]
+    assert fd.language == "javascript"
+    assert fd.return_type == AttrType.STRING
+    assert "data[0] + data[1]" in fd.body
+
+
+def test_absent_logical_pattern():
+    q = parse_query("""
+        from e1=RegulatorStream -> not TempStream[temp > e1.temp] and e2=HumidStream
+        select e1.temp insert into O
+    """)
+    lg = q.input.state.next
+    assert isinstance(lg, ast.LogicalStateElement)
+    assert isinstance(lg.left, ast.AbsentStreamStateElement)
+    assert lg.op == "and"
+
+
+def test_parse_errors():
+    with pytest.raises(Exception):
+        parse("define stream S (a unknowntype);")
+    with pytest.raises(Exception):
+        parse_query("from S select a")   # missing output action
