@@ -32,20 +32,27 @@ def plan_query(rt, q: ast.Query, default_name: str):
     if isinstance(inp, ast.SingleInputStream):
         if inp.stream_id not in rt.schemas:
             raise PlanError(f"query {name!r}: unknown input stream {inp.stream_id!r}")
+        if isinstance(q.output, (ast.UpdateTable, ast.DeleteFrom,
+                                 ast.UpdateOrInsertTable)) \
+                and target not in rt.tables:
+            raise PlanError(f"query {name!r}: unknown table {target!r}")
         schema = rt.schemas[inp.stream_id]
         has_window = inp.window is not None
         has_agg = selector_has_aggregators(q.selector) or q.selector.group_by
-        if not has_window and not has_agg:
-            if not isinstance(q.output, (ast.InsertInto, ast.ReturnAction)):
-                raise PlanError(f"query {name!r}: table ops not yet supported")
-            if q.rate is not None:
-                raise PlanError(f"query {name!r}: output rate limiting not yet supported")
-            filters = [f.expr for f in inp.filters]
-            return FilterProjectPlan(
-                name, schema, inp.alias, filters, q.selector, rt.strings,
-                target, q.selector.limit, q.selector.offset,
-                events_for=q.output.events_for)
-        raise PlanError(f"query {name!r}: windows/aggregations not yet supported")
+        # TPU fast path: stateless filter/project with device-typed columns
+        if (not has_window and not has_agg and q.rate is None
+                and isinstance(q.output, (ast.InsertInto, ast.ReturnAction))
+                and not any(isinstance(h, ast.StreamFunction) for h in inp.handlers)):
+            try:
+                filters = [f.expr for f in inp.filters]
+                return FilterProjectPlan(
+                    name, schema, inp.alias, filters, q.selector, rt.strings,
+                    target, q.selector.limit, q.selector.offset,
+                    events_for=q.output.events_for)
+            except Exception:
+                pass   # host-only functions etc. -> sequential backend
+        from ..interp.engine import InterpSingleQueryPlan
+        return InterpSingleQueryPlan(name, rt, q, inp, target)
 
     raise PlanError(f"query {name!r}: input type {type(inp).__name__} not yet supported")
 

@@ -131,6 +131,10 @@ class QueryPlan:
         """Called by the scheduler tick (time windows, absent patterns...)."""
         return []
 
+    def next_wakeup(self):
+        """Next timestamp (ms) this plan needs a timer callback, or None."""
+        return None
+
     # checkpoint hooks (reference: core:util/snapshot/Snapshotable.java)
     def state_dict(self) -> dict:
         return {}

@@ -135,15 +135,31 @@ class SiddhiAppRuntime:
         return int(time.time() * 1000)
 
     def set_time(self, ms: int) -> None:
-        """Advance the virtual clock (playback/test mode) and fire timers."""
-        self._clock_ms = ms
+        """Advance the virtual clock (playback/test mode), firing due timers
+        in wakeup order so timer-driven emissions interleave deterministically
+        (reference: core:util/Scheduler.java:89 notifyAt semantics)."""
+        self.flush()
         self._fire_timers(ms)
-
-    def _fire_timers(self, now_ms: int) -> None:
-        for plan in self._plans:
-            for ob in plan.on_timer(now_ms):
-                self._emit(plan, ob)
+        self._clock_ms = ms
         self._drain()
+
+    def _fire_timers(self, upto_ms: int) -> None:
+        guard = 0
+        while True:
+            guard += 1
+            if guard > 1_000_000:
+                raise RuntimeError("runaway timer loop")
+            due = [(w, p) for p in self._plans
+                   for w in [p.next_wakeup()] if w is not None and w <= upto_ms]
+            if not due:
+                return
+            w0 = min(w for w, _ in due)
+            self._clock_ms = w0
+            for w, plan in due:
+                if w <= w0:
+                    for ob in plan.on_timer(w0):
+                        self._emit(plan, ob)
+            self._drain()
 
     # -- ingest --------------------------------------------------------------
 
@@ -207,7 +223,10 @@ class SiddhiAppRuntime:
                 cb(int(ob.batch.timestamps[-1]), None, events)
             else:
                 cb(int(ob.batch.timestamps[-1]), events, None)
-        if ob.target is not None and not ob.is_expired:
+        # plans emit only what events_for selects; everything with a target is
+        # inserted (expired events become current on entering the next stream,
+        # reference: InsertIntoStreamCallback)
+        if ob.target is not None:
             self._pending.append((ob.target, ob.batch))
 
     def _decode(self, batch: EventBatch) -> list:

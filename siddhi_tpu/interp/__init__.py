@@ -1,0 +1,2 @@
+"""Sequential host backend: reference semantics, differential oracle,
+CPU baseline, and fallback executor."""

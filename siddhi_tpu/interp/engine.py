@@ -1,0 +1,556 @@
+"""Sequential (host) query engine — the reference-semantics backend.
+
+Event-at-a-time execution mirroring the reference's processor chains
+(reference: core:query/input/ProcessStreamReceiver.java:106 ->
+FilterProcessor -> WindowProcessor -> QuerySelector -> OutputRateLimiter
+-> OutputCallback).  Roles:
+  1. differential-test oracle for the batched TPU plans,
+  2. measured CPU baseline for bench.py,
+  3. fallback executor for features the TPU backend doesn't cover yet.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Callable, Optional
+
+from ..query import ast
+from ..query.ast import AttrType
+from ..core.batch import BatchBuilder, EventBatch
+from ..core.planner import OutputBatch, PlanError, QueryPlan
+from ..core.runtime import Event
+from ..core.schema import StreamSchema, StringTable
+from .aggregators import make_aggregator
+from .expr import PyExprContext, compile_py
+from . import windows as W
+
+CURRENT, EXPIRED, RESET = W.CURRENT, W.EXPIRED, W.RESET
+
+
+# ---------------------------------------------------------------------------
+# selector compilation (aggregator site extraction)
+# ---------------------------------------------------------------------------
+
+class AggSite:
+    __slots__ = ("name", "arg_fns", "in_type", "out_type", "key")
+
+    def __init__(self, name, arg_fns, in_type, out_type, key):
+        self.name = name
+        self.arg_fns = arg_fns      # compiled arg getters (first arg aggregated)
+        self.in_type = in_type
+        self.out_type = out_type
+        self.key = key              # env key "__agg<i>"
+
+
+def extract_aggregators(expr: ast.Expression, sites: list, ctx) -> ast.Expression:
+    """Replace aggregator calls with placeholder variables; append AggSite."""
+    from ..core.planner import AGGREGATOR_NAMES
+    if isinstance(expr, ast.FunctionCall) and expr.namespace is None \
+            and expr.name.lower() in AGGREGATOR_NAMES:
+        arg_fns = [compile_py(a, ctx) for a in expr.args]
+        in_type = arg_fns[0][1] if arg_fns else None
+        agg = make_aggregator(expr.name, in_type)
+        key = f"__agg{len(sites)}"
+        sites.append(AggSite(expr.name.lower(), [f for f, _ in arg_fns],
+                             in_type, agg.type, key))
+        return ast.Variable(key)
+    if isinstance(expr, ast.Math):
+        return ast.Math(extract_aggregators(expr.left, sites, ctx), expr.op,
+                        extract_aggregators(expr.right, sites, ctx))
+    if isinstance(expr, ast.Compare):
+        return ast.Compare(extract_aggregators(expr.left, sites, ctx), expr.op,
+                           extract_aggregators(expr.right, sites, ctx))
+    if isinstance(expr, ast.And):
+        return ast.And(extract_aggregators(expr.left, sites, ctx),
+                       extract_aggregators(expr.right, sites, ctx))
+    if isinstance(expr, ast.Or):
+        return ast.Or(extract_aggregators(expr.left, sites, ctx),
+                      extract_aggregators(expr.right, sites, ctx))
+    if isinstance(expr, ast.Not):
+        return ast.Not(extract_aggregators(expr.expr, sites, ctx))
+    if isinstance(expr, ast.FunctionCall):
+        return ast.FunctionCall(expr.name,
+                                tuple(extract_aggregators(a, sites, ctx)
+                                      for a in expr.args), expr.namespace)
+    return expr
+
+
+class InterpSelector:
+    """QuerySelector analog (reference: core:query/selector/QuerySelector.java:76):
+    group-by keyed aggregator banks, having, order-by, limit/offset."""
+
+    def __init__(self, selector: ast.Selector, ctx: PyExprContext,
+                 in_schema: Optional[StreamSchema], out_stream_id: str):
+        self.selector = selector
+        self.sites: list[AggSite] = []
+        names, types, fns = [], [], []
+        if selector.select_all:
+            if in_schema is None:
+                raise PlanError("select * needs a single input schema")
+            for a in in_schema.attributes:
+                f, t = compile_py(ast.Variable(a.name), ctx)
+                names.append(a.name)
+                types.append(t)
+                fns.append(f)
+        else:
+            for oa in selector.attributes:
+                rewritten = extract_aggregators(oa.expr, self.sites, ctx)
+                site_extra = {s.key: (s.key, s.out_type) for s in self.sites}
+                ctx2 = PyExprContext(ctx.schemas, {**ctx.extra, **site_extra},
+                                     ctx.default_ref)
+                f, t = compile_py(rewritten, ctx2)
+                names.append(oa.name)
+                types.append(t)
+                fns.append(f)
+        self.names, self.types, self.fns = names, types, fns
+        self.group_fns = [compile_py(g, ctx)[0] for g in selector.group_by]
+        self.having = None
+        if selector.having is not None:
+            extra = {n: (n, t) for n, t in zip(names, types)}
+            extra.update({s.key: (s.key, s.out_type) for s in self.sites})
+            hctx = PyExprContext(ctx.schemas, {**ctx.extra, **extra}, ctx.default_ref)
+            h_rewritten = extract_aggregators(selector.having, self.sites, hctx)
+            extra.update({s.key: (s.key, s.out_type) for s in self.sites})
+            hctx = PyExprContext(ctx.schemas, {**ctx.extra, **extra}, ctx.default_ref)
+            self.having, _ = compile_py(h_rewritten, hctx)
+        self.order_by = [(compile_py(ob.var, PyExprContext(
+            ctx.schemas, {n: (n, t) for n, t in zip(names, types)},
+            ctx.default_ref))[0], ob.order == ast.OrderDir.DESC)
+            for ob in selector.order_by]
+        # group key -> [Aggregator]
+        self._groups: dict = defaultdict(self._new_bank)
+        self.out_schema = StreamSchema(out_stream_id, tuple(
+            ast.Attribute(n, t) for n, t in zip(names, types)))
+
+    def _new_bank(self):
+        return [make_aggregator(s.name, s.in_type) for s in self.sites]
+
+    def _bank_for(self, env) -> list:
+        key = tuple(f(env) for f in self.group_fns) if self.group_fns else ()
+        return self._groups[key]
+
+    def process(self, kind: str, env: dict):
+        """Run one window-emitted event through the selector.
+        Returns an output row (list) or None (reset/having-filtered)."""
+        if kind == RESET:
+            for bank in self._groups.values():
+                for a in bank:
+                    a.reset()
+            return None
+        bank = self._bank_for(env)
+        for site, agg in zip(self.sites, bank):
+            v = site.arg_fns[0](env) if site.arg_fns else None
+            if kind == CURRENT:
+                agg.add(v)
+            else:
+                agg.remove(v)
+        for site, agg in zip(self.sites, bank):
+            env[site.key] = agg.value()
+        row = [f(env) for f in self.fns]
+        if self.having is not None:
+            for n, v in zip(self.names, row):
+                env[n] = v
+            if not self.having(env):
+                return None
+        return row
+
+    def order_limit(self, rows: list) -> list:
+        """Apply order-by / offset / limit to one output chunk of (ts, row)."""
+        for fn, desc in reversed(self.order_by):
+            rows.sort(key=lambda tr: fn(dict(zip(self.names, tr[1]))), reverse=desc)
+        off = self.selector.offset or 0
+        if off:
+            rows = rows[off:]
+        if self.selector.limit is not None:
+            rows = rows[:self.selector.limit]
+        return rows
+
+    def state(self):
+        return {repr(k): [a.state() for a in bank]
+                for k, bank in self._groups.items()}
+
+    def restore(self, st):
+        self._groups.clear()
+        for k, states in st.items():
+            bank = self._new_bank()
+            for a, s in zip(bank, states):
+                a.restore(s)
+            self._groups[eval(k)] = bank   # keys are repr of simple tuples
+
+
+# ---------------------------------------------------------------------------
+# output rate limiting (reference: core:query/output/ratelimit/*, 12 impls)
+# ---------------------------------------------------------------------------
+
+class RateLimiter:
+    """Pass-through base; subclasses buffer/emit per policy."""
+    needs_timer = False
+
+    def feed(self, kind: str, ts: int, row: list) -> list:
+        return [(kind, ts, row)]
+
+    def on_timer(self, now_ms: int) -> list:
+        return []
+
+    def next_wakeup(self) -> Optional[int]:
+        return None
+
+    def state(self) -> dict:
+        return {}
+
+    def restore(self, st) -> None:
+        pass
+
+
+class EventRateLimiter(RateLimiter):
+    def __init__(self, count: int, mode: ast.RateType):
+        self.count = count
+        self.mode = mode
+        self.buf: list = []
+        self.n = 0
+
+    def feed(self, kind, ts, row):
+        if kind != CURRENT:
+            return []        # rate limiting applies to output (current) events
+        self.n += 1
+        if self.mode == ast.RateType.FIRST:
+            first = self.n % self.count == 1 or self.count == 1
+            return [(kind, ts, row)] if first else []
+        self.buf.append((kind, ts, row))
+        if self.n % self.count == 0:
+            out, self.buf = self.buf, []
+            if self.mode == ast.RateType.LAST:
+                return [out[-1]]
+            return out
+        return []
+
+    def state(self):
+        return {"buf": self.buf, "n": self.n}
+
+    def restore(self, st):
+        self.buf, self.n = list(st["buf"]), st["n"]
+
+
+class TimeRateLimiter(RateLimiter):
+    needs_timer = True
+
+    def __init__(self, millis: int, mode: ast.RateType):
+        self.millis = millis
+        self.mode = mode
+        self.buf: list = []
+        self.window_start: Optional[int] = None
+        self.emitted_this_window = False
+
+    def feed(self, kind, ts, row):
+        if kind != CURRENT:
+            return []
+        if self.window_start is None:
+            self.window_start = ts
+        if self.mode == ast.RateType.FIRST:
+            if not self.emitted_this_window:
+                self.emitted_this_window = True
+                return [(kind, ts, row)]
+            return []
+        self.buf.append((kind, ts, row))
+        return []
+
+    def on_timer(self, now_ms):
+        if self.window_start is None:
+            return []
+        out = []
+        while now_ms >= self.window_start + self.millis:
+            self.window_start += self.millis
+            self.emitted_this_window = False
+            if self.buf:
+                if self.mode == ast.RateType.LAST:
+                    out.append(self.buf[-1])
+                else:
+                    out.extend(self.buf)
+                self.buf = []
+        return out
+
+    def next_wakeup(self):
+        if self.window_start is None:
+            return None
+        return self.window_start + self.millis
+
+    def state(self):
+        return {"buf": self.buf, "ws": self.window_start,
+                "em": self.emitted_this_window}
+
+    def restore(self, st):
+        self.buf = list(st["buf"])
+        self.window_start = st["ws"]
+        self.emitted_this_window = st["em"]
+
+
+class SnapshotRateLimiter(RateLimiter):
+    """Emits, every interval, the latest live output rows (reference:
+    WrappedSnapshotOutputRateLimiter re-plays window snapshots)."""
+    needs_timer = True
+
+    def __init__(self, millis: int):
+        self.millis = millis
+        self.live: dict = {}       # source seq -> (ts, row)
+        self.seq = 0
+        self.window_start: Optional[int] = None
+
+    def feed(self, kind, ts, row):
+        if self.window_start is None:
+            self.window_start = ts
+        if kind == CURRENT:
+            self.live[self.seq] = (ts, row)
+            self.seq += 1
+        elif kind == EXPIRED and self.live:
+            self.live.pop(next(iter(self.live)), None)
+        return []
+
+    def on_timer(self, now_ms):
+        if self.window_start is None:
+            return []
+        out = []
+        while now_ms >= self.window_start + self.millis:
+            self.window_start += self.millis
+            out.extend((CURRENT, now_ms, row) for _, row in self.live.values())
+        return out
+
+    def next_wakeup(self):
+        if self.window_start is None:
+            return None
+        return self.window_start + self.millis
+
+    def state(self):
+        return {"live": list(self.live.items()), "seq": self.seq,
+                "ws": self.window_start}
+
+    def restore(self, st):
+        self.live = dict(st["live"])
+        self.seq = st["seq"]
+        self.window_start = st["ws"]
+
+
+def make_rate_limiter(rate) -> Optional[RateLimiter]:
+    if rate is None:
+        return None
+    if isinstance(rate, ast.EventOutputRate):
+        return EventRateLimiter(rate.count, rate.type)
+    if isinstance(rate, ast.TimeOutputRate):
+        return TimeRateLimiter(rate.millis, rate.type)
+    if isinstance(rate, ast.SnapshotOutputRate):
+        return SnapshotRateLimiter(rate.millis)
+    raise PlanError(f"unknown output rate {rate}")
+
+
+# ---------------------------------------------------------------------------
+# window factory
+# ---------------------------------------------------------------------------
+
+def _const(e, what="argument"):
+    if isinstance(e, ast.TimeConstant):
+        return e.millis
+    if isinstance(e, ast.Constant):
+        return e.value
+    raise PlanError(f"window {what} must be constant, got {e}")
+
+
+def make_window(h: ast.WindowHandler, ctx: PyExprContext,
+                schema: StreamSchema) -> W.Window:
+    name = h.name.lower()
+    args = h.args
+
+    def getter(i):
+        f, _ = compile_py(args[i], ctx)
+        return lambda ev_env: f(ev_env)
+
+    def ev_getter(i):
+        f, _ = compile_py(args[i], ctx)
+        names = schema.names
+        def g(ev):
+            env = dict(zip(names, ev.data))
+            env["__timestamp__"] = ev.timestamp
+            return f(env)
+        return g
+
+    if name == "length":
+        return W.LengthWindow(int(_const(args[0])))
+    if name == "lengthbatch":
+        return W.LengthBatchWindow(int(_const(args[0])))
+    if name == "time":
+        return W.TimeWindow(int(_const(args[0])))
+    if name == "timebatch":
+        start = int(_const(args[1])) if len(args) > 1 else None
+        return W.TimeBatchWindow(int(_const(args[0])), start)
+    if name == "externaltime":
+        return W.ExternalTimeWindow(ev_getter(0), int(_const(args[1])))
+    if name == "externaltimebatch":
+        start = int(_const(args[2])) if len(args) > 2 else None
+        return W.ExternalTimeBatchWindow(ev_getter(0), int(_const(args[1])), start)
+    if name == "timelength":
+        return W.TimeLengthWindow(int(_const(args[0])), int(_const(args[1])))
+    if name == "batch":
+        return W.BatchWindow()
+    if name == "session":
+        key = ev_getter(1) if len(args) > 1 else None
+        latency = int(_const(args[2])) if len(args) > 2 else 0
+        return W.SessionWindow(int(_const(args[0])), key, latency)
+    if name == "sort":
+        desc = False
+        if len(args) > 2 and isinstance(args[2], ast.Constant):
+            desc = str(args[2].value).lower() == "desc"
+        return W.SortWindow(int(_const(args[0])), ev_getter(1), desc)
+    if name == "delay":
+        return W.DelayWindow(int(_const(args[0])))
+    if name == "frequent":
+        key = ev_getter(1) if len(args) > 1 else None
+        return W.FrequentWindow(int(_const(args[0])), key)
+    if name == "lossyfrequent":
+        err = float(_const(args[1])) if len(args) > 1 else None
+        key = ev_getter(2) if len(args) > 2 else None
+        return W.LossyFrequentWindow(float(_const(args[0])), err, key)
+    if name == "cron":
+        return W.CronWindow(str(_const(args[0])))
+    raise PlanError(f"unknown window type {h.name!r}")
+
+
+# ---------------------------------------------------------------------------
+# single-stream query plan
+# ---------------------------------------------------------------------------
+
+class InterpSingleQueryPlan(QueryPlan):
+    """from S[f]#window.w(...) select ... group by ... having ...
+    output rate ... insert <events_for> into Target — sequential backend."""
+
+    def __init__(self, name: str, rt, q: ast.Query, inp: ast.SingleInputStream,
+                 target: Optional[str]):
+        self.name = name
+        self.rt = rt
+        schema = rt.schemas[inp.stream_id]
+        self.in_schema = schema
+        self.input_streams = (inp.stream_id,)
+        self.output_target = target
+        self.events_for = getattr(q.output, "events_for", ast.OutputEventsFor.CURRENT)
+        ctx = PyExprContext({inp.alias: schema, inp.stream_id: schema},
+                            default_ref=inp.alias)
+        self.ctx = ctx
+        self.filters = [compile_py(f.expr, ctx)[0] for f in inp.filters]
+        for h in inp.handlers:
+            if isinstance(h, ast.StreamFunction):
+                if (h.namespace, h.name.lower()) != (None, "log"):
+                    raise PlanError(f"query {name!r}: stream function "
+                                    f"{h.name!r} not supported")
+        self._log = any(isinstance(h, ast.StreamFunction) and
+                        h.name.lower() == "log" for h in inp.handlers)
+        self.window: Optional[W.Window] = None
+        wh = inp.window
+        if wh is not None:
+            self.window = make_window(wh, ctx, schema)
+        self.sel = InterpSelector(q.selector, ctx, schema, target or f"#{name}")
+        self.out_schema = self.sel.out_schema
+        self.rate = make_rate_limiter(q.rate)
+        self._names = schema.names
+
+    # -- helpers -------------------------------------------------------------
+
+    def _env_of(self, ev: Event) -> dict:
+        env = dict(zip(self._names, ev.data))
+        env["__timestamp__"] = ev.timestamp
+        return env
+
+    def _run_selector(self, emissions: list) -> list:
+        """window emissions [(kind, ev)] -> [(kind, ts, row)] post-rate-limit."""
+        out = []
+        for kind, ev in emissions:
+            if kind == RESET:
+                self.sel.process(RESET, {})
+                continue
+            env = self._env_of(ev)
+            row = self.sel.process(kind, env)
+            if row is None:
+                continue
+            out.append((kind, ev.timestamp, row))
+        # order-by/limit apply per chunk on current rows
+        if self.sel.order_by or self.sel.selector.limit is not None \
+                or self.sel.selector.offset:
+            cur = [(t, r) for k, t, r in out if k == CURRENT]
+            cur = self.sel.order_limit(cur)
+            out = [(k, t, r) for k, t, r in out if k != CURRENT] + \
+                  [(CURRENT, t, r) for t, r in cur]
+        if self.rate is not None:
+            out2 = []
+            for k, t, r in out:
+                out2.extend(self.rate.feed(k, t, r))
+            out = out2
+        return out
+
+    def _to_output_batches(self, rows: list) -> list:
+        """[(kind, ts, row)] -> [OutputBatch] honoring events_for."""
+        want_current = self.events_for in (ast.OutputEventsFor.CURRENT,
+                                           ast.OutputEventsFor.ALL)
+        want_expired = self.events_for in (ast.OutputEventsFor.EXPIRED,
+                                           ast.OutputEventsFor.ALL)
+        cur = [(t, r) for k, t, r in rows if k == CURRENT and want_current]
+        exp = [(t, r) for k, t, r in rows if k == EXPIRED and want_expired]
+        out = []
+        for subset, is_exp in ((cur, False), (exp, True)):
+            if not subset:
+                continue
+            bb = BatchBuilder(self.out_schema, self.rt.strings)
+            for t, r in subset:
+                bb.append(t, tuple(r))
+            out.append(OutputBatch(self.output_target, bb.freeze(), is_exp))
+        return out
+
+    # -- QueryPlan interface -------------------------------------------------
+
+    def process(self, stream_id: str, batch: EventBatch) -> list:
+        rows = batch.rows(self.rt.strings)
+        emitted: list = []
+        for ts, row in zip(batch.timestamps, rows):
+            ev = Event(int(ts), row)
+            env = self._env_of(ev)
+            if any(not f(env) for f in self.filters):
+                continue
+            if self._log:
+                print(f"{self.name}: {ev.timestamp}, {ev.data}")
+            now = self.rt.now_ms() if not self.rt._playback else ev.timestamp
+            if self.window is None:
+                emitted.append((CURRENT, ev))
+            else:
+                emitted.extend(self.window.process(ev, now))
+        if isinstance(self.window, W.BatchWindow):
+            emitted.extend(self.window.end_chunk(self.rt.now_ms()))
+        out_rows = self._run_selector(emitted)
+        return self._to_output_batches(out_rows)
+
+    def on_timer(self, now_ms: int) -> list:
+        rows = []
+        if self.window is not None:
+            rows.extend(self._run_selector(self.window.on_timer(now_ms)))
+        if self.rate is not None:
+            rows.extend(self.rate.on_timer(now_ms))
+        return self._to_output_batches(rows)
+
+    def next_wakeup(self) -> Optional[int]:
+        cands = []
+        if self.window is not None:
+            w = self.window.next_wakeup()
+            if w is not None:
+                cands.append(w)
+        if self.rate is not None:
+            w = self.rate.next_wakeup()
+            if w is not None:
+                cands.append(w)
+        return min(cands) if cands else None
+
+    def state_dict(self) -> dict:
+        return {
+            "window": self.window.state() if self.window else None,
+            "selector": self.sel.state(),
+            "rate": self.rate.state() if self.rate else None,
+        }
+
+    def load_state_dict(self, d: dict) -> None:
+        if self.window is not None and d.get("window") is not None:
+            self.window.restore(d["window"])
+        self.sel.restore(d["selector"])
+        if self.rate is not None and d.get("rate") is not None:
+            self.rate.restore(d["rate"])

@@ -1,0 +1,17 @@
+"""Host in-memory tables (reference: core:table/InMemoryTable.java:225 over
+EventHolders, core:table/holder/IndexEventHolder.java:59 primary-key map +
+secondary indexes).  Filled in by the tables milestone; `compile_in_table`
+lowers `expr in Table` membership tests."""
+from __future__ import annotations
+
+from ..core.expr import ExprError
+from ..query.ast import AttrType
+
+
+def compile_in_table(expr, ctx):
+    table = getattr(ctx, "tables", {}).get(expr.table_id)
+    if table is None:
+        raise ExprError(f"'in {expr.table_id}': unknown table")
+    from .expr import compile_py
+    f, t = compile_py(expr.expr, ctx)
+    return (lambda env: table.contains_value(f(env))), AttrType.BOOL

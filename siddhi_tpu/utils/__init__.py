@@ -1,0 +1,1 @@
+"""Cross-cutting services: scheduler, cron, statistics, snapshots."""
