@@ -24,6 +24,10 @@ class EventBatch:
     timestamps: np.ndarray            # (n,) int64 ms
     columns: dict                     # name -> (n,) ndarray
     n: int
+    # global arrival sequence numbers (n,) int64 — preserve cross-stream
+    # ordering for patterns/sequences/joins (the reference gets this for free
+    # from synchronous per-event dispatch)
+    seqs: Optional[np.ndarray] = None
 
     def column(self, name: str) -> np.ndarray:
         return self.columns[name]
@@ -73,6 +77,7 @@ class BatchBuilder:
         self.strings = strings
         self.capacity = capacity
         self._ts: list[int] = []
+        self._seqs: list[int] = []
         self._cols: dict[str, list] = {a.name: [] for a in schema.attributes}
 
     def __len__(self) -> int:
@@ -82,21 +87,31 @@ class BatchBuilder:
     def full(self) -> bool:
         return len(self._ts) >= self.capacity
 
-    def append(self, timestamp: int, row: Sequence[Any]) -> None:
+    def append(self, timestamp: int, row: Sequence[Any],
+               seq: Optional[int] = None) -> None:
         attrs = self.schema.attributes
         if len(row) != len(attrs):
             raise ValueError(
                 f"stream {self.schema.id!r} expects {len(attrs)} attributes "
                 f"{self.schema.names}, got {len(row)}: {row!r}")
         self._ts.append(int(timestamp))
+        self._seqs.append(seq if seq is not None else len(self._seqs))
         for a, v in zip(attrs, row):
             if a.type == AttrType.STRING:
                 v = self.strings.encode(v)
+            elif v is None:
+                # null capture (e.g. absent-pattern refs): typed columns carry
+                # a neutral value (nan for floats, 0 for ints, False for bool)
+                v = (float("nan") if a.type in (AttrType.FLOAT, AttrType.DOUBLE)
+                     else False if a.type == AttrType.BOOL
+                     else 0 if a.type in (AttrType.INT, AttrType.LONG)
+                     else None)
             self._cols[a.name].append(v)
 
     def freeze_and_clear(self) -> EventBatch:
         b = self.freeze()
         self._ts = []
+        self._seqs = []
         self._cols = {a.name: [] for a in self.schema.attributes}
         return b
 
@@ -110,4 +125,4 @@ class BatchBuilder:
             else:
                 cols[a.name] = np.asarray(self._cols[a.name], dtype=dt)
         return EventBatch(self.schema, np.asarray(self._ts, dtype=TIMESTAMP_DTYPE),
-                          cols, n)
+                          cols, n, np.asarray(self._seqs, dtype=np.int64))

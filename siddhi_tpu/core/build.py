@@ -54,6 +54,10 @@ def plan_query(rt, q: ast.Query, default_name: str):
         from ..interp.engine import InterpSingleQueryPlan
         return InterpSingleQueryPlan(name, rt, q, inp, target)
 
+    if isinstance(inp, ast.StateInputStream):
+        from ..interp.engine import InterpPatternQueryPlan
+        return InterpPatternQueryPlan(name, rt, q, inp, target)
+
     raise PlanError(f"query {name!r}: input type {type(inp).__name__} not yet supported")
 
 

@@ -135,6 +135,11 @@ class QueryPlan:
         """Next timestamp (ms) this plan needs a timer callback, or None."""
         return None
 
+    def finalize(self) -> list:
+        """Called when a drain round settles; multi-input plans flush their
+        seq-merged buffers here. Returns OutputBatches."""
+        return []
+
     # checkpoint hooks (reference: core:util/snapshot/Snapshotable.java)
     def state_dict(self) -> dict:
         return {}

@@ -74,6 +74,7 @@ class SiddhiAppRuntime:
 
         self._builders: dict = {}
         self._pending: list = []      # FIFO of (stream_id, EventBatch) awaiting dispatch
+        self._seq = 0                 # global arrival order counter
 
         self._build()
 
@@ -174,21 +175,25 @@ class SiddhiAppRuntime:
                 self._clock_ms = ts
             return ts
 
+        def nseq() -> int:
+            self._seq += 1
+            return self._seq
+
         if isinstance(data, Event):
             b.append(advance(data.timestamp if timestamp is None else timestamp),
-                     data.data)
+                     data.data, nseq())
         elif data and isinstance(data, (list,)) and isinstance(data[0], (tuple, list, Event)):
             for row in data:
                 if isinstance(row, Event):
-                    b.append(advance(row.timestamp), row.data)
+                    b.append(advance(row.timestamp), row.data, nseq())
                 else:
                     b.append(advance(self.now_ms() if timestamp is None else timestamp),
-                             row)
+                             row, nseq())
         else:
             ts = self.now_ms() if timestamp is None else timestamp
             if timestamp is not None:
                 advance(ts)
-            b.append(ts, tuple(data))
+            b.append(ts, tuple(data), nseq())
         if b.full:
             self.flush()
 
@@ -203,10 +208,22 @@ class SiddhiAppRuntime:
 
     def _drain(self) -> None:
         guard = 0
-        while self._pending:
+        while True:
             guard += 1
             if guard > 100_000:
                 raise RuntimeError("runaway stream recursion (insert-into cycle?)")
+            if not self._pending:
+                # multi-input plans (patterns/sequences/joins) buffer events
+                # per stream and merge by global seq once the round settles
+                progressed = False
+                for plan in self._plans:
+                    for ob in plan.finalize():
+                        self._emit(plan, ob)
+                        progressed = True
+                if not self._pending and not progressed:
+                    return
+                if not self._pending:
+                    continue
             sid, batch = self._pending.pop(0)
             for cb in self._stream_callbacks.get(sid, ()):  # junction callbacks
                 cb(self._decode(batch))

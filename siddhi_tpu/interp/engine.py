@@ -554,3 +554,181 @@ class InterpSingleQueryPlan(QueryPlan):
         self.sel.restore(d["selector"])
         if self.rate is not None and d.get("rate") is not None:
             self.rate.restore(d["rate"])
+
+
+# ---------------------------------------------------------------------------
+# pattern / sequence query plan
+# ---------------------------------------------------------------------------
+
+class InterpPatternQueryPlan(QueryPlan):
+    """from [every] e1=A[...] -> e2=B[...] within T select ... — sequential
+    backend over the NFA matcher (reference call stack: SURVEY §3.3)."""
+
+    def __init__(self, name: str, rt, q: ast.Query,
+                 state_input, target: Optional[str]):
+        from .nfa import NFACompiler, PatternMatcher
+        from ..query.ast import StateType
+        self.name = name
+        self.rt = rt
+        self.output_target = target
+        self.events_for = getattr(q.output, "events_for", ast.OutputEventsFor.CURRENT)
+
+        comp = NFACompiler()
+        entries, _exits = comp.lower(state_input.state)
+        self.nodes = comp.nodes
+        qw = state_input.within.millis if state_input.within else None
+        self.matcher = PatternMatcher(
+            self.nodes, [n.id for n in entries],
+            state_input.type == StateType.SEQUENCE, qw)
+
+        # schemas per ref + per stream for filter/selector contexts
+        schemas: dict = {}
+        for n in self.nodes:
+            if n.stream_id not in rt.schemas:
+                raise PlanError(f"query {name!r}: unknown stream {n.stream_id!r}")
+            schemas[n.ref] = rt.schemas[n.stream_id]
+        self.matcher._schema_names = {
+            sid: rt.schemas[sid].names for sid in {n.stream_id for n in self.nodes}}
+        self.input_streams = tuple({n.stream_id for n in self.nodes})
+
+        # node filters: current event attrs unqualified + own ref; other refs
+        for n, elem_filters in zip(self.nodes, _collect_filters(state_input.state)):
+            if elem_filters:
+                own = rt.schemas[n.stream_id]
+                ctx = PyExprContext({**schemas, n.ref: own}, default_ref=n.ref)
+                fns = [compile_py(f.expr, ctx)[0] for f in elem_filters]
+                if len(fns) == 1:
+                    n.filter_fn = fns[0]
+                else:
+                    n.filter_fn = lambda env, _fns=fns: all(f(env) for f in _fns)
+
+        # selector over capture refs
+        sel_ast = q.selector
+        if sel_ast.select_all:
+            # select * on patterns: concatenation of each ref's attributes
+            attrs = []
+            seen = set()
+            for n in self.nodes:
+                for a in rt.schemas[n.stream_id].attributes:
+                    nm = a.name if a.name not in seen else f"{n.ref}_{a.name}"
+                    seen.add(nm)
+                    attrs.append(ast.OutputAttribute(
+                        ast.Variable(a.name, stream_ref=n.ref), nm))
+            sel_ast = ast.Selector(False, tuple(attrs), sel_ast.group_by,
+                                   sel_ast.having, sel_ast.order_by,
+                                   sel_ast.limit, sel_ast.offset)
+        ctx = PyExprContext(schemas)
+        self.sel = InterpSelector(sel_ast, ctx, None, target or f"#{name}")
+        self.out_schema = self.sel.out_schema
+        self.rate = make_rate_limiter(q.rate)
+        self._buffer: list = []      # (seq, stream_id, Event)
+
+    # -- QueryPlan interface -------------------------------------------------
+
+    def process(self, stream_id: str, batch: EventBatch) -> list:
+        rows = batch.rows(self.rt.strings)
+        seqs = batch.seqs if batch.seqs is not None else range(batch.n)
+        for seq, ts, row in zip(seqs, batch.timestamps, rows):
+            self._buffer.append((int(seq), stream_id, Event(int(ts), row)))
+        return []
+
+    def finalize(self) -> list:
+        if not self._buffer:
+            return []
+        self.matcher.start(self.rt.now_ms())
+        buf = sorted(self._buffer, key=lambda t: t[0])
+        self._buffer = []
+        out_rows: list = []
+        for _seq, sid, ev in buf:
+            if self.rt._playback:
+                # fire absent-state deadlines that precede this event
+                while True:
+                    w = self.matcher.next_wakeup()
+                    if w is None or w > ev.timestamp:
+                        break
+                    out_rows.extend(self._matches_to_rows(
+                        self.matcher.on_timer(w)))
+            out_rows.extend(self._matches_to_rows(
+                self.matcher.on_event(sid, ev)))
+        if self.rate is not None:
+            out_rows = [r for k, t, row in out_rows
+                        for r in self.rate.feed(k, t, row)]
+        return self._to_batches(out_rows)
+
+    def on_timer(self, now_ms: int) -> list:
+        self.matcher.start(now_ms)
+        rows = self._matches_to_rows(self.matcher.on_timer(now_ms))
+        if self.rate is not None:
+            rows = [r for k, t, row in rows for r in self.rate.feed(k, t, row)]
+            rows.extend(self.rate.on_timer(now_ms))
+        return self._to_batches(rows)
+
+    def next_wakeup(self):
+        self.matcher.start(self.rt.now_ms())
+        cands = []
+        w = self.matcher.next_wakeup()
+        if w is not None:
+            cands.append(w)
+        if self.rate is not None:
+            w = self.rate.next_wakeup()
+            if w is not None:
+                cands.append(w)
+        return min(cands) if cands else None
+
+    # -- helpers -------------------------------------------------------------
+
+    def _matches_to_rows(self, matches: list) -> list:
+        rows = []
+        for m in matches:
+            env = self.matcher.env_of_captures(m["captures"])
+            env["__timestamp__"] = m["ts"]
+            row = self.sel.process(CURRENT, env)
+            if row is not None:
+                rows.append((CURRENT, m["ts"], row))
+        return rows
+
+    def _to_batches(self, rows: list) -> list:
+        if not rows or self.events_for == ast.OutputEventsFor.EXPIRED:
+            return []
+        bb = BatchBuilder(self.out_schema, self.rt.strings)
+        for _k, t, r in rows:
+            bb.append(t, tuple(r))
+        return [OutputBatch(self.output_target, bb.freeze())]
+
+    def state_dict(self) -> dict:
+        return {"matcher": self.matcher.state(),
+                "selector": self.sel.state(),
+                "rate": self.rate.state() if self.rate else None}
+
+    def load_state_dict(self, d: dict) -> None:
+        self.matcher.restore(d["matcher"])
+        self.sel.restore(d["selector"])
+        if self.rate is not None and d.get("rate") is not None:
+            self.rate.restore(d["rate"])
+
+
+def _collect_filters(elem) -> list:
+    """Filters per lowered node, in the same order NFACompiler.lower
+    creates nodes (depends on tree shape)."""
+    out: list = []
+
+    def walk(e):
+        if isinstance(e, ast.StreamStateElement):
+            out.append(e.stream.filters)
+        elif isinstance(e, ast.AbsentStreamStateElement):
+            out.append(e.stream.filters)
+        elif isinstance(e, ast.CountStateElement):
+            out.append(e.stream.stream.filters)
+        elif isinstance(e, ast.LogicalStateElement):
+            walk(e.left)
+            walk(e.right)
+        elif isinstance(e, ast.NextStateElement):
+            walk(e.state)
+            walk(e.next)
+        elif isinstance(e, ast.EveryStateElement):
+            walk(e.state)
+        else:
+            raise PlanError(f"unknown state element {type(e).__name__}")
+
+    walk(elem)
+    return out

@@ -1,0 +1,259 @@
+"""Pattern/sequence (CEP NFA) integration tests — sequential backend.
+
+Mirrors reference expectations (reference: modules/siddhi-core/src/test/.../
+query/pattern/{EveryPattern,PatternCount,LogicalPattern,AbsentPattern}TestCase.java
+and query/sequence/*)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+@pytest.fixture
+def mgr():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def collect(rt, stream):
+    got = []
+    rt.add_callback(stream, lambda evs: got.extend(evs))
+    return got
+
+
+def test_simple_pattern(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream Stock (symbol string, price double);
+        from e1=Stock[price > 100] -> e2=Stock[price > e1.price]
+        select e1.price as p1, e2.price as p2 insert into O;
+    """)
+    got = collect(rt, "O")
+    h = rt.input_handler("Stock")
+    h.send(("A", 101.0))
+    h.send(("A", 50.0))     # irrelevant (pattern skips)
+    h.send(("A", 102.5))    # completes
+    h.send(("A", 200.0))    # no every -> no more matches
+    rt.flush()
+    assert [e.data for e in got] == [(101.0, 102.5)]
+
+
+def test_every_pattern(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (sym string, p double);
+        from every e1=S[p > 100] -> e2=S[p > e1.p]
+        select e1.p as p1, e2.p as p2 insert into O;
+    """)
+    got = collect(rt, "O")
+    h = rt.input_handler("S")
+    for p in [101.0, 102.0, 103.0]:
+        h.send(("A", p))
+    rt.flush()
+    # every arms a new e1 per event>100; each armed partial is consumed by
+    # its first completing e2: (101,102) then (102,103)
+    datas = sorted(e.data for e in got)
+    assert datas == [(101.0, 102.0), (102.0, 103.0)]
+
+
+def test_pattern_within(mgr):
+    rt = mgr.create_app_runtime("""
+        @app:playback
+        define stream S (p double);
+        from every e1=S[p > 100] -> e2=S[p > e1.p] within 1 sec
+        select e1.p as p1, e2.p as p2 insert into O;
+    """)
+    got = collect(rt, "O")
+    h = rt.input_handler("S")
+    h.send((101.0,), timestamp=1000)
+    h.send((150.0,), timestamp=2500)   # too late for e1=101 (within 1 sec)
+    h.send((200.0,), timestamp=3000)   # completes e1=150
+    rt.flush()
+    assert [e.data for e in got] == [(150.0, 200.0)]
+
+
+def test_pattern_across_streams(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream A (x int);
+        define stream B (y int);
+        from e1=A[x > 0] -> e2=B[y > e1.x]
+        select e1.x as x, e2.y as y insert into O;
+    """)
+    got = collect(rt, "O")
+    ha, hb = rt.input_handler("A"), rt.input_handler("B")
+    ha.send((5,))
+    hb.send((3,))     # y not > 5
+    hb.send((7,))     # completes
+    rt.flush()
+    assert [e.data for e in got] == [(5, 7)]
+
+
+def test_pattern_count(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream T (temp double);
+        from e1=T[temp > 30]<2:3> -> e2=T[temp < 10]
+        select e1[0].temp as t0, e1[1].temp as t1, e2.temp as tl
+        insert into O;
+    """)
+    got = collect(rt, "O")
+    h = rt.input_handler("T")
+    h.send((31.0,))
+    h.send((32.0,))
+    h.send((5.0,))
+    rt.flush()
+    assert [e.data for e in got] == [(31.0, 32.0, 5.0)]
+
+
+def test_logical_and_pattern(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream A (x int);
+        define stream B (y int);
+        define stream C (z int);
+        from e1=A and e2=B -> e3=C
+        select e1.x as x, e2.y as y, e3.z as z insert into O;
+    """)
+    got = collect(rt, "O")
+    ha, hb, hc = (rt.input_handler(s) for s in "ABC")
+    hb.send((2,))
+    hc.send((9,))     # C before A+B complete: ignored
+    ha.send((1,))
+    hc.send((3,))
+    rt.flush()
+    assert [e.data for e in got] == [(1, 2, 3)]
+
+
+def test_logical_or_pattern(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream A (x int);
+        define stream B (y int);
+        from e1=A or e2=B select e1.x as x, e2.y as y insert into O;
+    """)
+    got = collect(rt, "O")
+    hb = rt.input_handler("B")
+    hb.send((42,))
+    rt.flush()
+    # e1 absent -> null -> int column neutral 0
+    assert [e.data for e in got] == [(0, 42)]
+
+
+def test_absent_pattern_timer(mgr):
+    rt = mgr.create_app_runtime("""
+        @app:playback
+        define stream A (x int);
+        define stream B (y int);
+        from e1=A -> not B for 1 sec
+        select e1.x as x insert into O;
+    """)
+    got = collect(rt, "O")
+    ha = rt.input_handler("A")
+    ha.send((7,), timestamp=1000)
+    rt.flush()
+    assert got == []
+    rt.set_time(2100)        # deadline 2000 passed, no B
+    assert [e.data for e in got] == [(7,)]
+
+
+def test_absent_pattern_suppressed_by_event(mgr):
+    rt = mgr.create_app_runtime("""
+        @app:playback
+        define stream A (x int);
+        define stream B (y int);
+        from e1=A -> not B for 1 sec
+        select e1.x as x insert into O;
+    """)
+    got = collect(rt, "O")
+    ha, hb = rt.input_handler("A"), rt.input_handler("B")
+    ha.send((7,), timestamp=1000)
+    hb.send((1,), timestamp=1500)   # B arrives within the window -> no match
+    rt.flush()
+    rt.set_time(3000)
+    assert got == []
+
+
+def test_absent_and_present(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream R (t double);
+        define stream T (t double);
+        define stream H (h double);
+        from e1=R -> not T[t > e1.t] and e2=H
+        select e1.t as rt_, e2.h as h insert into O;
+    """)
+    got = collect(rt, "O")
+    hr, ht, hh = rt.input_handler("R"), rt.input_handler("T"), rt.input_handler("H")
+    hr.send((20.0,))
+    hh.send((55.0,))
+    rt.flush()
+    assert [e.data for e in got] == [(20.0, 55.0)]
+    # second round: T fires first -> suppressed
+    hr.send((30.0,))
+    rt.flush()
+    ht.send((35.0,))
+    hh.send((60.0,))
+    rt.flush()
+    assert len(got) == 1
+
+
+def test_sequence_strict(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (sym string, p double);
+        from every e1=S[p > 100], e2=S[p > e1.p]
+        select e1.p as p1, e2.p as p2 insert into O;
+    """)
+    got = collect(rt, "O")
+    h = rt.input_handler("S")
+    h.send(("A", 101.0))
+    h.send(("A", 50.0))    # breaks contiguity for pending e1=101
+    h.send(("A", 102.0))
+    h.send(("A", 103.0))   # completes e1=102 (every re-arms)
+    rt.flush()
+    assert [e.data for e in got] == [(102.0, 103.0)]
+
+
+def test_sequence_plus(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (v int);
+        from every e1=S[v > 0]+, e2=S[v == 0]
+        select e1[0].v as first, e1[last].v as last_, e2.v as z insert into O;
+    """)
+    got = collect(rt, "O")
+    h = rt.input_handler("S")
+    for v in [1, 2, 3, 0]:
+        h.send((v,))
+    rt.flush()
+    # every arms at each positive; strict contiguity keeps runs: [1,2,3]0, [2,3]0, [3]0
+    datas = sorted(e.data for e in got)
+    assert (1, 3, 0) in datas
+    assert (3, 3, 0) in datas
+
+
+def test_pattern_select_star(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream A (x int);
+        define stream B (y int);
+        from e1=A -> e2=B select * insert into O;
+    """)
+    got = collect(rt, "O")
+    ha, hb = rt.input_handler("A"), rt.input_handler("B")
+    ha.send((1,))
+    hb.send((2,))
+    rt.flush()
+    assert [e.data for e in got] == [(1, 2)]
+
+
+def test_pattern_snapshot_restore(mgr):
+    app = """
+        define stream S (p double);
+        from e1=S[p > 100] -> e2=S[p > e1.p]
+        select e1.p as p1, e2.p as p2 insert into O;
+    """
+    rt = mgr.create_app_runtime(app)
+    h = rt.input_handler("S")
+    h.send(("101.0", ) if False else (101.0,))
+    rt.flush()
+    snap = rt.snapshot()
+
+    rt2 = mgr.create_app_runtime(app.replace("define", "@app:name('x2') define", 1))
+    got = collect(rt2, "O")
+    rt2.restore(snap)
+    h2 = rt2.input_handler("S")
+    h2.send((150.0,))
+    rt2.flush()
+    assert [e.data for e in got] == [(101.0, 150.0)]
