@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Benchmark driver — prints ONE JSON line with the headline metric.
+
+Current headline: filter-query throughput (BASELINE.json config 1) on the
+TPU fast path vs. the sequential host interpreter (our measured CPU stand-in
+for the single-JVM reference; see BASELINE.md — the reference publishes no
+numbers, so vs_baseline is measured-TPU / measured-CPU-interpreter).
+
+Will be upgraded to the north-star metric (events/sec/chip on partitioned
+patterns, DEBS-2016 shape) as the batched NFA lands.
+"""
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+def build_runtime(tpu: bool):
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core import build as build_mod
+    from siddhi_tpu.interp.engine import InterpSingleQueryPlan
+
+    mgr = SiddhiManager()
+    app = """
+    define stream StockStream (symbol string, price double, volume int);
+    @info(name='q1')
+    from StockStream[price > 100.0] select symbol, price insert into OutStream;
+    """
+    if not tpu:
+        # force the sequential backend by monkey-scoping the planner choice
+        orig = build_mod.plan_query
+
+        def plan_seq(rt, q, default_name):
+            name = q.name(default_name)
+            from siddhi_tpu.core.planner import output_target_of
+            return InterpSingleQueryPlan(name, rt, q, q.input,
+                                         output_target_of(q))
+        build_mod.plan_query = plan_seq
+        try:
+            rt = mgr.create_app_runtime(app)
+        finally:
+            build_mod.plan_query = orig
+    else:
+        rt = mgr.create_app_runtime(app)
+    return rt
+
+
+def run(rt, n_events: int, batch: int) -> float:
+    """Returns events/sec pushed through the query."""
+    from siddhi_tpu.core.batch import EventBatch
+    from siddhi_tpu.core.schema import TIMESTAMP_DTYPE
+
+    schema = rt.schemas["StockStream"]
+    rng = np.random.default_rng(0)
+    sym_codes = np.array([rt.strings.encode(s) for s in
+                          ("IBM", "WSO2", "GOOG", "MSFT")], dtype=np.int32)
+    counted = [0]
+    rt.add_batch_callback("OutStream", lambda b: counted.__setitem__(0, counted[0] + b.n))
+    rt.start()
+
+    batches = []
+    for start in range(0, n_events, batch):
+        n = min(batch, n_events - start)
+        cols = {
+            "symbol": rng.choice(sym_codes, size=n),
+            "price": rng.uniform(50, 150, size=n),
+            "volume": rng.integers(1, 1000, size=n, dtype=np.int32),
+        }
+        ts = np.full(n, 1_700_000_000_000, dtype=TIMESTAMP_DTYPE)
+        batches.append(EventBatch(schema, ts, cols, n))
+
+    # warmup (compile)
+    rt._pending.append(("StockStream", batches[0]))
+    rt._drain()
+
+    t0 = time.perf_counter()
+    for b in batches:
+        rt._pending.append(("StockStream", b))
+        rt._drain()
+    dt = time.perf_counter() - t0
+    assert counted[0] > 0
+    return n_events / dt
+
+
+def main():
+    # Host<->device transfer through the tunnel is the bottleneck for this
+    # shallow query (~30 MB/s measured); use large micro-batches to amortize
+    # the ~200 ms per-call latency.
+    n = 2_000_000
+    tpu_rt = build_runtime(tpu=True)
+    tpu_eps = run(tpu_rt, n, 1 << 18)
+    cpu_rt = build_runtime(tpu=False)
+    cpu_eps = run(cpu_rt, min(n, 200_000), 8192)
+    print(json.dumps({
+        "metric": "filter_query_throughput",
+        "value": round(tpu_eps),
+        "unit": "events/sec",
+        "vs_baseline": round(tpu_eps / cpu_eps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

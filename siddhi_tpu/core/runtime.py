@@ -14,6 +14,7 @@ auto-flushes when a builder reaches capacity.
 from __future__ import annotations
 
 import time
+import numpy as np
 from collections import defaultdict
 from dataclasses import dataclass
 from typing import Any, Callable, Optional, Sequence, Union
@@ -69,6 +70,7 @@ class SiddhiAppRuntime:
         self._plans: list[QueryPlan] = []
         self._subscribers: dict = defaultdict(list)   # stream_id -> [plan]
         self._stream_callbacks: dict = defaultdict(list)
+        self._batch_callbacks: dict = defaultdict(list)
         self._query_callbacks: dict = defaultdict(list)
         self._plan_by_name: dict = {}
 
@@ -114,6 +116,11 @@ class SiddhiAppRuntime:
     def add_callback(self, stream_id: str, fn: Callable) -> None:
         """StreamCallback: fn(list[Event]) on every batch reaching stream_id."""
         self._stream_callbacks[stream_id].append(fn)
+
+    def add_batch_callback(self, stream_id: str, fn: Callable) -> None:
+        """Columnar StreamCallback: fn(EventBatch), no row decode (the
+        zero-copy consumer path; decode via batch.rows(rt.strings))."""
+        self._batch_callbacks[stream_id].append(fn)
 
     def add_query_callback(self, query_name: str, fn: Callable) -> None:
         """QueryCallback: fn(timestamp_ms, in_events, removed_events)."""
@@ -225,6 +232,8 @@ class SiddhiAppRuntime:
                 if not self._pending:
                     continue
             sid, batch = self._pending.pop(0)
+            for cb in self._batch_callbacks.get(sid, ()):
+                cb(batch)
             for cb in self._stream_callbacks.get(sid, ()):  # junction callbacks
                 cb(self._decode(batch))
             for plan in self._subscribers.get(sid, ()):
@@ -244,6 +253,12 @@ class SiddhiAppRuntime:
         # inserted (expired events become current on entering the next stream,
         # reference: InsertIntoStreamCallback)
         if ob.target is not None:
+            # derived events arrive "now": stamp global seqs so downstream
+            # multi-input plans (patterns/joins) merge them in true order
+            n = ob.batch.n
+            ob.batch.seqs = np.arange(self._seq + 1, self._seq + 1 + n,
+                                      dtype=np.int64)
+            self._seq += n
             self._pending.append((ob.target, ob.batch))
 
     def _decode(self, batch: EventBatch) -> list:

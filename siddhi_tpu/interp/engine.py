@@ -650,6 +650,10 @@ class InterpPatternQueryPlan(QueryPlan):
                         self.matcher.on_timer(w)))
             out_rows.extend(self._matches_to_rows(
                 self.matcher.on_event(sid, ev)))
+        if self.sel.order_by or self.sel.selector.limit is not None \
+                or self.sel.selector.offset:
+            cur = [(t, r) for _k, t, r in out_rows]
+            out_rows = [(CURRENT, t, r) for t, r in self.sel.order_limit(cur)]
         if self.rate is not None:
             out_rows = [r for k, t, row in out_rows
                         for r in self.rate.feed(k, t, row)]

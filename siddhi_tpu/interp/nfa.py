@@ -57,7 +57,7 @@ class PM:
     _ids = itertools.count()
 
     __slots__ = ("captures", "first_ts", "nodes", "deadlines", "filled",
-                 "dead_branches", "alive", "uid", "armed_ts", "sticky_at")
+                 "alive", "uid", "armed_ts", "sticky_at")
 
     def __init__(self):
         self.captures: dict = {}          # ref -> [Event]
@@ -65,7 +65,6 @@ class PM:
         self.nodes: set = set()           # node ids where pending
         self.deadlines: dict = {}         # node id -> ms (absent)
         self.filled: dict = {}            # node id -> bool (logical)
-        self.dead_branches: set = set()   # node ids whose absent branch failed
         self.alive = True
         self.uid = next(PM._ids)
         self.armed_ts: Optional[int] = None
@@ -80,7 +79,6 @@ class PM:
         p.nodes = set()
         p.deadlines = dict(self.deadlines)
         p.filled = dict(self.filled)
-        p.dead_branches = set(self.dead_branches)
         p.armed_ts = self.armed_ts
         return p
 
@@ -90,7 +88,6 @@ class PM:
                 "first_ts": self.first_ts, "nodes": sorted(self.nodes),
                 "deadlines": dict(self.deadlines),
                 "filled": dict(self.filled),
-                "dead_branches": sorted(self.dead_branches),
                 "armed_ts": self.armed_ts,
                 "sticky_at": sorted(self.sticky_at)}
 
@@ -103,7 +100,6 @@ class PM:
         p.nodes = set(st["nodes"])
         p.deadlines = {int(k): v for k, v in st["deadlines"].items()}
         p.filled = {int(k): v for k, v in st["filled"].items()}
-        p.dead_branches = set(st["dead_branches"])
         p.armed_ts = st["armed_ts"]
         p.sticky_at = set(st.get("sticky_at", ()))
         return p
@@ -204,6 +200,7 @@ class PatternMatcher:
             self.by_stream.setdefault(n.stream_id, []).append(n)
         self.started = False
         self._schema_names: dict = {}   # stream_id -> attr names (set by plan)
+        self._names_by_ref: Optional[dict] = None   # lazy ref -> attr names
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -265,7 +262,6 @@ class PatternMatcher:
         matches: list = []
         staged: list = []          # (pm, node_id) to register after the event
         transitioned: set = set()  # pm uids that advanced/collected
-        eligible: set = set()      # pm uids that were pending at a consuming node
 
         for node in self.by_stream.get(stream_id, ()):
             for pm in list(self.pendings[node.id]):
@@ -280,8 +276,6 @@ class PatternMatcher:
                     if self._eval(node, pm, ev):
                         self._absent_stream_arrived(pm, node, matches, ev)
                     continue
-                if pm.first_ts is not None:
-                    eligible.add(pm.uid)
                 if self._eval(node, pm, ev):
                     self._transition(pm, node, ev, staged, matches, transitioned)
 
@@ -329,10 +323,13 @@ class PatternMatcher:
         return env
 
     def env_of_captures(self, captures: dict) -> dict:
+        names_by_ref = self._names_by_ref
+        if names_by_ref is None:
+            names_by_ref = self._names_by_ref = {
+                n.ref: self._schema_names[n.stream_id] for n in self.nodes}
         env: dict = {}
         for ref, evs in captures.items():
-            node = next((n for n in self.nodes if n.ref == ref), None)
-            names = self._schema_names[node.stream_id] if node else ()
+            names = names_by_ref.get(ref, ())
             if not evs:
                 continue
             last = evs[-1]
@@ -423,7 +420,6 @@ class PatternMatcher:
     def _absent_stream_arrived(self, pm: PM, node: Node, matches, ev):
         """The forbidden stream fired for a pending absent node."""
         if node.partner_id is not None and node.partner_op == "or":
-            pm.dead_branches.add(node.id)
             self._leave(pm, node.id)
             return
         if node.partner_id is not None:  # and-with-absent: whole pm dies

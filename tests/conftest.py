@@ -1,11 +1,18 @@
 """Test config: force CPU backend with 8 virtual devices so sharding tests
-exercise a multi-chip mesh without TPU hardware (bench.py uses the real chip)."""
+exercise a multi-chip mesh without TPU hardware (bench.py uses the real chip).
+
+Note: the environment's sitecustomize imports jax with the TPU platform
+pinned before conftest runs, so env vars alone don't stick — we must also
+update jax.config (safe: no backend computation has run yet)."""
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+jax.config.update("jax_platforms", "cpu")
 
 import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

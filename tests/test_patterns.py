@@ -246,7 +246,7 @@ def test_pattern_snapshot_restore(mgr):
     """
     rt = mgr.create_app_runtime(app)
     h = rt.input_handler("S")
-    h.send(("101.0", ) if False else (101.0,))
+    h.send((101.0,))
     rt.flush()
     snap = rt.snapshot()
 
