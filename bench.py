@@ -1,13 +1,14 @@
 #!/usr/bin/env python
 """Benchmark driver — prints ONE JSON line with the headline metric.
 
-Current headline: filter-query throughput (BASELINE.json config 1) on the
-TPU fast path vs. the sequential host interpreter (our measured CPU stand-in
-for the single-JVM reference; see BASELINE.md — the reference publishes no
-numbers, so vs_baseline is measured-TPU / measured-CPU-interpreter).
+Headline (BASELINE.json config 4 shape): partitioned 3-state CEP pattern
+`every e1 -> e2 -> e3` by key over 1k partitions — the north-star
+workload.  Device path: all per-key NFA instances advance as one batched
+kernel (partition axis P).  Baseline: the sequential host interpreter
+with per-key cloned matchers — our measured stand-in for the single-JVM
+reference engine (the reference publishes no numbers, BASELINE.md).
 
-Will be upgraded to the north-star metric (events/sec/chip on partitioned
-patterns, DEBS-2016 shape) as the batched NFA lands.
+vs_baseline = device events/sec ÷ host-interpreter events/sec.
 """
 import json
 import sys
@@ -17,88 +18,80 @@ sys.path.insert(0, ".")
 
 import numpy as np
 
+KEYS = 1000
 
-def build_runtime(tpu: bool):
-    from siddhi_tpu import SiddhiManager
-    from siddhi_tpu.core import build as build_mod
-    from siddhi_tpu.interp.engine import InterpSingleQueryPlan
-
-    mgr = SiddhiManager()
-    app = """
-    define stream StockStream (symbol string, price double, volume int);
-    @info(name='q1')
-    from StockStream[price > 100.0] select symbol, price insert into OutStream;
-    """
-    if not tpu:
-        # force the sequential backend by monkey-scoping the planner choice
-        orig = build_mod.plan_query
-
-        def plan_seq(rt, q, default_name):
-            name = q.name(default_name)
-            from siddhi_tpu.core.planner import output_target_of
-            return InterpSingleQueryPlan(name, rt, q, q.input,
-                                         output_target_of(q))
-        build_mod.plan_query = plan_seq
-        try:
-            rt = mgr.create_app_runtime(app)
-        finally:
-            build_mod.plan_query = orig
-    else:
-        rt = mgr.create_app_runtime(app)
-    return rt
+APP = """
+define stream S (sym string, p double);
+partition with (sym of S)
+begin
+  @info(name='q')
+  from every e1=S[p > 100.0] -> e2=S[p > e1.p] -> e3=S[p > e2.p]
+    within 10 sec
+  select e1.p as p1, e2.p as p2, e3.p as p3 insert into M;
+end;
+"""
 
 
-def run(rt, n_events: int, batch: int) -> float:
-    """Returns events/sec pushed through the query."""
+def make_batches(rt, n_events, batch):
     from siddhi_tpu.core.batch import EventBatch
-    from siddhi_tpu.core.schema import TIMESTAMP_DTYPE
 
-    schema = rt.schemas["StockStream"]
+    schema = rt.schemas["S"]
     rng = np.random.default_rng(0)
-    sym_codes = np.array([rt.strings.encode(s) for s in
-                          ("IBM", "WSO2", "GOOG", "MSFT")], dtype=np.int32)
-    counted = [0]
-    rt.add_batch_callback("OutStream", lambda b: counted.__setitem__(0, counted[0] + b.n))
-    rt.start()
-
+    sym_codes = np.array([rt.strings.encode(f"K{i}") for i in range(KEYS)],
+                         dtype=np.int32)
     batches = []
+    seq0 = 1
+    ts0 = 1_700_000_000_000
     for start in range(0, n_events, batch):
         n = min(batch, n_events - start)
         cols = {
-            "symbol": rng.choice(sym_codes, size=n),
-            "price": rng.uniform(50, 150, size=n),
-            "volume": rng.integers(1, 1000, size=n, dtype=np.int32),
+            "sym": rng.choice(sym_codes, size=n),
+            "p": rng.uniform(90.0, 130.0, size=n),
         }
-        ts = np.full(n, 1_700_000_000_000, dtype=TIMESTAMP_DTYPE)
-        batches.append(EventBatch(schema, ts, cols, n))
+        ts = ts0 + np.arange(start, start + n, dtype=np.int64)
+        seqs = np.arange(seq0 + start, seq0 + start + n, dtype=np.int64)
+        batches.append(EventBatch(schema, ts, cols, n, seqs))
+    return batches
 
-    # warmup (compile)
-    rt._pending.append(("StockStream", batches[0]))
+
+def run(mode: str, n_events: int, batch: int):
+    """Returns (events/sec, match_count)."""
+    from siddhi_tpu import SiddhiManager
+
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(
+        f"@app:devicePatterns('{mode}')\n@app:partitionCapacity({KEYS})\n"
+        f"@app:deviceSlots(32)\n" + APP)
+    counted = [0]
+    rt.add_batch_callback("M", lambda b: counted.__setitem__(0, counted[0] + b.n))
+    rt.start()
+    batches = make_batches(rt, n_events + batch, batch)
+
+    # warmup: covers all keys -> device kernel compiles / host clones build
+    rt._pending.append(("S", batches[0]))
     rt._drain()
+    warm = counted[0]
 
     t0 = time.perf_counter()
-    for b in batches:
-        rt._pending.append(("StockStream", b))
+    for b in batches[1:]:
+        rt._pending.append(("S", b))
         rt._drain()
     dt = time.perf_counter() - t0
-    assert counted[0] > 0
-    return n_events / dt
+    return n_events / dt, counted[0] - warm
 
 
 def main():
-    # Host<->device transfer through the tunnel is the bottleneck for this
-    # shallow query (~30 MB/s measured); use large micro-batches to amortize
-    # the ~200 ms per-call latency.
-    n = 2_000_000
-    tpu_rt = build_runtime(tpu=True)
-    tpu_eps = run(tpu_rt, n, 1 << 18)
-    cpu_rt = build_runtime(tpu=False)
-    cpu_eps = run(cpu_rt, min(n, 200_000), 8192)
+    # event counts are whole multiples of the batch size: a straggler batch
+    # would land in a fresh (T, M) jit bucket and pay a recompile mid-run
+    dev_eps, dev_matches = run("auto", 4 << 18, 1 << 18)
+    cpu_eps, cpu_matches = run("never", 1 << 16, 1 << 16)
+    assert dev_matches > 0 and cpu_matches > 0, \
+        f"no matches (dev={dev_matches}, cpu={cpu_matches}) — kernel broken?"
     print(json.dumps({
-        "metric": "filter_query_throughput",
-        "value": round(tpu_eps),
+        "metric": "partitioned_pattern_throughput_1k_keys",
+        "value": round(dev_eps),
         "unit": "events/sec",
-        "vs_baseline": round(tpu_eps / cpu_eps, 2),
+        "vs_baseline": round(dev_eps / cpu_eps, 2),
     }))
 
 

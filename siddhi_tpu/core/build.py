@@ -54,7 +54,22 @@ def plan_query(rt, q: ast.Query, default_name: str):
         from ..interp.engine import InterpSingleQueryPlan
         return InterpSingleQueryPlan(name, rt, q, inp, target)
 
+    if isinstance(inp, ast.JoinInputStream):
+        if inp.per is not None or inp.within is not None:
+            raise PlanError(f"query {name!r}: aggregation joins "
+                            f"(within/per) not yet supported")
+        from ..interp.joins import InterpJoinQueryPlan
+        return InterpJoinQueryPlan(name, rt, q, inp, target)
+
     if isinstance(inp, ast.StateInputStream):
+        mode = getattr(rt, "device_patterns", "auto")
+        if mode == "always":
+            from .pattern_plan import DevicePatternPlan
+            return DevicePatternPlan(name, rt, q, inp, target,
+                                     slots=rt.device_slots)
+        if mode == "auto":
+            pass   # P=1 on a remote chip loses to the host matcher; the
+                   # partition planner routes partitioned patterns here
         from ..interp.engine import InterpPatternQueryPlan
         return InterpPatternQueryPlan(name, rt, q, inp, target)
 
@@ -62,4 +77,5 @@ def plan_query(rt, q: ast.Query, default_name: str):
 
 
 def plan_partition(rt, p: ast.Partition, index: int) -> None:
-    raise PlanError("partitions not yet supported")
+    from .partition import plan_partition as _pp
+    _pp(rt, p, index)

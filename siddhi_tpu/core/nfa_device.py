@@ -1,0 +1,558 @@
+"""Batched device NFA — the TPU pattern/sequence matching kernel.
+
+The north-star component (SURVEY §3.3): the reference walks per-event
+pending-StateEvent lists through Pre/PostStateProcessor chains
+(reference: core:query/input/stream/state/StreamPreStateProcessor.java:292,
+StreamPostStateProcessor.java:53).  Here the whole matcher is ONE fused
+array program:
+
+  * the partition axis P (reference: core:partition/PartitionRuntime.java
+    clones the query graph per key) becomes a batch axis — thousands of
+    independent NFA instances evaluated in lockstep and shardable over a
+    `jax.sharding.Mesh`;
+  * pending partial matches become A fixed "slots" per partition:
+    `active/state_idx/first_ts` plus capture columns `ref.attr -> (P, A)`;
+  * a micro-batch becomes a dense (T, P) block — one event per partition
+    per `lax.scan` step, so in-partition order (the sequential semantics)
+    is preserved while all partitions and slots advance in parallel;
+  * `every` heads are an always-armed flag (re-arming is free — the
+    reference's trickiest corner, addEveryState + within expiry, reduces
+    to a mask);
+  * `within` expiry, sequence strictness, and match emission are masked
+    vector ops.  Completing slots park their match snapshot in slot
+    storage (sentinel state) and drain through E narrow emission lanes
+    per step (masked one-hot reductions — TPU scatters serialize), so
+    bursts of simultaneous completions lose nothing; after the scan, one
+    scatter per column compacts the lane grid into a flat match buffer
+    whose capacity the host doubles-and-retries on overflow (state is
+    functional, so a retry is exact), and slot capacity A grows the same
+    way when heads find no free slot.
+
+Supported device subset (everything else falls back to the sequential
+host matcher, interp/nfa.py): linear chains of single-count stream states
+with an optional `every` head and per-element/query `within`; predicates
+may reference any earlier capture (e2[price > e1.price]).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..query import ast
+from .expr import (CompiledExpr, ExprError, MultiStreamContext,
+                   compile_expression, jnp_dtype)
+from .schema import TIMESTAMP_DTYPE, StreamSchema, StringTable, dtype_of
+
+BIG_MS = np.int64(2**62)
+
+
+class DeviceNFAUnsupported(Exception):
+    """Raised when a pattern shape needs the sequential fallback."""
+
+
+class PatternFilterContext(MultiStreamContext):
+    """Filter compile context for one chain state: unqualified attributes
+    resolve to the state's own (arriving) event first — mirroring the
+    reference, where a condition's unqualified variables read the current
+    event (reference: core:util/parser/ExpressionParser variable binding
+    for state elements)."""
+
+    def __init__(self, schemas: dict, strings, own_ref: str):
+        super().__init__(schemas, strings)
+        self.own_ref = own_ref
+
+    def resolve(self, var: ast.Variable):
+        if var.stream_ref is None and var.index is None \
+                and var.attribute in self.schemas[self.own_ref].types:
+            return (f"{self.own_ref}.{var.attribute}",
+                    self.schemas[self.own_ref].type_of(var.attribute))
+        return super().resolve(var)
+
+
+@dataclass
+class ChainState:
+    ref: str
+    stream_id: str
+    scode: int                      # index into spec.stream_ids
+    filter: Optional[CompiledExpr]  # env -> bool array
+    within_ms: Optional[int]
+
+
+@dataclass
+class ChainSpec:
+    states: list                     # [ChainState]
+    stream_ids: list                 # distinct stream ids, scode order
+    schemas: dict                    # ref -> StreamSchema
+    is_sequence: bool
+    every_head: bool
+
+    @property
+    def S(self) -> int:
+        return len(self.states)
+
+
+def lower_chain(state_input, schemas_by_stream: dict, strings: StringTable,
+                filters_by_node: list) -> ChainSpec:
+    """Validate + lower a StateInputStream into a linear device chain.
+
+    Reuses the host NFACompiler lowering so device and host agree on
+    structure; anything non-linear raises DeviceNFAUnsupported.
+    """
+    from ..interp.nfa import NFACompiler
+    from ..query.ast import StateType
+
+    comp = NFACompiler()
+    entries, _exits = comp.lower(state_input.state)
+    nodes = comp.nodes
+    if len(entries) != 1 or entries[0].id != nodes[0].id:
+        raise DeviceNFAUnsupported("non-single-entry pattern")
+    order = []
+    nid = nodes[0].id
+    while nid is not None:
+        order.append(nodes[nid])
+        nid = nodes[nid].next_id
+    if len(order) != len(nodes):
+        raise DeviceNFAUnsupported("non-linear state graph")
+    qw = state_input.within.millis if state_input.within else None
+    stream_ids, scode_of = [], {}
+    states = []
+    for i, n in enumerate(order):
+        if n.kind != "stream" or n.partner_id is not None:
+            raise DeviceNFAUnsupported("absent/logical states")
+        if n.min_count != 1 or n.max_count != 1:
+            raise DeviceNFAUnsupported("count quantifiers")
+        if n.sticky and i != 0:
+            raise DeviceNFAUnsupported("`every` on a non-head state")
+        if n.stream_id not in schemas_by_stream:
+            raise DeviceNFAUnsupported(f"unknown stream {n.stream_id!r}")
+        if n.stream_id not in scode_of:
+            scode_of[n.stream_id] = len(stream_ids)
+            stream_ids.append(n.stream_id)
+        w = n.within_ms if n.within_ms is not None else qw
+        states.append(ChainState(n.ref, n.stream_id, scode_of[n.stream_id],
+                                 None, w))
+    spec = ChainSpec(states, stream_ids,
+                     {s.ref: schemas_by_stream[s.stream_id] for s in states},
+                     state_input.type == StateType.SEQUENCE,
+                     bool(order[0].sticky))
+    # compile filters (indices follow NFACompiler node creation order ==
+    # chain order for linear chains)
+    for st, elem_filters in zip(spec.states, filters_by_node):
+        if not elem_filters:
+            continue
+        f = elem_filters[0].expr
+        for g in elem_filters[1:]:
+            f = ast.And(f, g.expr)
+        ctx = PatternFilterContext(spec.schemas, strings, st.ref)
+        try:
+            ce = compile_expression(f, ctx)
+        except ExprError as e:
+            raise DeviceNFAUnsupported(f"filter not device-compilable: {e}")
+        if ce.type != ast.AttrType.BOOL:
+            raise DeviceNFAUnsupported("non-boolean filter")
+        st.filter = ce
+    return spec
+
+
+# ---------------------------------------------------------------------------
+# kernel builder
+# ---------------------------------------------------------------------------
+
+class NFAKernel:
+    """Builds the jitted block function for one ChainSpec.
+
+    state pytree (persistent across blocks):
+      active   (P, A) bool      slot holds a live partial match
+      sidx     (P, A) int32     chain state the slot waits at (1..S-1)
+      first_ts (P, A) int64     head-capture timestamp (within anchor)
+      slot_seq (P, A) int64     head-capture seq (emission ordering)
+      armed0   (P,)  bool       entry arm (always True for `every`)
+      caps     {"ref.attr": (P, A)}   captures for every ref + completion
+                                snapshot (final-ref attrs, __comp_seq__)
+      of_slots (P,)  int32      slot-exhaustion events (head drops; the
+                                host grows A and retries, so only nonzero
+                                once the A_CAP ceiling is hit)
+
+    block(state, ev) -> (state', out): ev holds (T, P) columns; out packs
+    the match buffer into an int64 matrix + f64 matrix (2 host transfers).
+    """
+
+    def __init__(self, spec: ChainSpec, sel_fns: dict, having: Optional[CompiledExpr],
+                 P: int, A: int, E: Optional[int] = None):
+        self.spec = spec
+        self.sel_fns = sel_fns          # out name -> CompiledExpr (over ref.attr env)
+        self.having = having
+        self.P, self.A = P, A
+        # emission lanes: max completions recorded per partition per step.
+        # TPU scatter is slow, so the scan emits into E dense lanes via
+        # masked reductions; ONE scatter per column compacts the (T, E)
+        # lane grid into the output ring after the scan.
+        # small defaults: the host retries a block exactly (functional state)
+        # with doubled E/A when the overflow counters move, so capacity
+        # adapts to the workload without ever losing a match
+        self.E = E if E is not None else (1 if spec.S == 1 else min(A, 2))
+        self.out_names = list(sel_fns) + ["__timestamp__", "__seq__",
+                                          "__head_seq__"]
+        self.f64_names = {name for name, ce in sel_fns.items()
+                          if ce.type == ast.AttrType.DOUBLE}
+        # match-row layout (order mirrors _emit_values) — used to pack the
+        # per-step scan outputs into two dense arrays (one dynamic-update-
+        # slice each per step instead of one per column)
+        self.emit_layout: list = [("__head_seq__", jnp.int64)]
+        for s in spec.states:
+            sch = spec.schemas[s.ref]
+            for a in sch.attributes:
+                self.emit_layout.append((f"{s.ref}.{a.name}", jnp_dtype(a.type)))
+            self.emit_layout.append((f"{s.ref}.__ts__", jnp.int64))
+        self.emit_layout += [("__timestamp__", jnp.int64), ("__seq__", jnp.int64)]
+        self._block_cache: dict = {}    # (T, M) -> jitted fn
+
+    # -- state ---------------------------------------------------------------
+
+    def init_state(self) -> dict:
+        spec, P, A = self.spec, self.P, self.A
+        caps = {}
+        # all states (incl. the final one) get capture storage: a completing
+        # slot parks its completion snapshot here (sidx == S sentinel) and
+        # drains through the emission lanes over following steps — bursts of
+        # simultaneous completions never drop matches nor need wide lanes
+        for s in spec.states:
+            sch = spec.schemas[s.ref]
+            for a in sch.attributes:
+                caps[f"{s.ref}.{a.name}"] = jnp.zeros((P, A), dtype=jnp_dtype(a.type))
+            caps[f"{s.ref}.__ts__"] = jnp.zeros((P, A), dtype=jnp.int64)
+        if spec.S > 1:
+            caps["__comp_seq__"] = jnp.zeros((P, A), dtype=jnp.int64)
+        return {
+            "active": jnp.zeros((P, A), dtype=bool),
+            "sidx": jnp.zeros((P, A), dtype=jnp.int32),
+            "first_ts": jnp.zeros((P, A), dtype=jnp.int64),
+            "slot_seq": jnp.zeros((P, A), dtype=jnp.int64),
+            "armed0": jnp.ones((P,), dtype=bool),
+            "caps": caps,
+            "of_slots": jnp.zeros((P,), dtype=jnp.int32),
+        }
+
+    # -- the per-event step --------------------------------------------------
+
+    def _event_env(self, x: dict, st: ChainState, caps: dict) -> dict:
+        """env for state st's predicate: captures (P,A) + current event (P,1)."""
+        env = dict(caps)
+        sch = self.spec.schemas[st.ref]
+        for a in sch.attributes:
+            env[f"{st.ref}.{a.name}"] = x[f"{st.scode}.{a.name}"][:, None]
+        env["__timestamp__"] = x["__ts__"][:, None]
+        return env
+
+    def _step(self, carry: dict, x: dict):
+        spec, P, A, E = self.spec, self.P, self.A, self.E
+        S = spec.S
+        active, sidx = carry["active"], carry["sidx"]
+        first_ts, slot_seq = carry["first_ts"], carry["slot_seq"]
+        armed0, caps = carry["armed0"], dict(carry["caps"])
+        of_slots = carry["of_slots"]
+
+        ts, seq = x["__ts__"], x["__seq__"]
+        scode, valid = x["__scode__"], x["__valid__"]
+        single_stream = len(spec.stream_ids) == 1
+
+        # 1+2. within expiry (now = event ts; lazy, reference
+        #    StreamPreStateProcessor.java:102-113) folded into the per-state
+        #    match pass; matches are against PRE-event state (two-phase
+        #    commit: one event can't climb two chained states)
+        age = ts[:, None] - first_ts
+        expired = jnp.zeros((P, A), dtype=bool)
+        total_match = jnp.zeros((P, A), dtype=bool)
+        complete = jnp.zeros((P, A), dtype=bool)
+        cap_writes = []    # (mask (P,A), state)
+        for si in range(1, S):
+            st = spec.states[si]
+            at_s = active & (sidx == si) & valid[:, None]
+            if st.within_ms is not None:
+                exp_s = at_s & (age > jnp.int64(st.within_ms))
+                expired = expired | exp_s
+                at_s = at_s & ~exp_s
+            ok = at_s if single_stream else at_s & (scode == st.scode)[:, None]
+            if st.filter is not None:
+                pred = st.filter.fn(self._event_env(x, st, caps))
+                ok = ok & jnp.broadcast_to(pred, (P, A))
+            total_match = total_match | ok
+            if si == S - 1:
+                complete = ok
+            else:
+                cap_writes.append((ok, st))
+        active = active & ~expired
+
+        # 3. head match (entry arm)
+        h = spec.states[0]
+        ok0 = armed0 & valid if single_stream \
+            else armed0 & (scode == h.scode) & valid
+        if h.filter is not None:
+            pred0 = h.filter.fn(self._event_env(x, h, caps))
+            if getattr(pred0, "ndim", 0) == 2:
+                if pred0.shape[1] != 1:
+                    raise DeviceNFAUnsupported(
+                        "head filter references later captures")
+                pred0 = pred0[:, 0]
+            ok0 = ok0 & jnp.broadcast_to(pred0, (P,))
+        if not spec.every_head:
+            armed0 = armed0 & ~ok0
+
+        # 4. apply advances + captures
+        sidx = jnp.where(total_match, sidx + 1, sidx)
+        for ok, st in cap_writes:
+            sch = spec.schemas[st.ref]
+            for a in sch.attributes:
+                k = f"{st.ref}.{a.name}"
+                caps[k] = jnp.where(ok, x[f"{st.scode}.{a.name}"][:, None], caps[k])
+            caps[f"{st.ref}.__ts__"] = jnp.where(ok, ts[:, None],
+                                                 caps[f"{st.ref}.__ts__"])
+
+        # 5. emission.  Completing slots advance to the sentinel state
+        #    sidx == S ("done": step 4 already moved them there) and park
+        #    their completion snapshot in slot storage; each step drains up
+        #    to E done slots through dense lanes (masked one-hot reductions,
+        #    scatter-free — TPU scatters serialize).  Bursts larger than E
+        #    stay parked and drain on later steps / the post-scan drain, so
+        #    no match is ever lost and lanes stay narrow.  The host
+        #    re-orders same-event ties by the emitted __head_seq__.
+        if S > 1:
+            last = spec.states[-1]
+            for a in spec.schemas[last.ref].attributes:
+                k = f"{last.ref}.{a.name}"
+                caps[k] = jnp.where(complete, x[f"{last.scode}.{a.name}"][:, None],
+                                    caps[k])
+            caps[f"{last.ref}.__ts__"] = jnp.where(complete, ts[:, None],
+                                                   caps[f"{last.ref}.__ts__"])
+            caps["__comp_seq__"] = jnp.where(complete, seq[:, None],
+                                             caps["__comp_seq__"])
+            active, y = self._drain_done(active, sidx, slot_seq, caps)
+        else:
+            # single-state chain: head match emits directly (one lane)
+            vals = self._emit_direct(x, ts, seq)
+            iy = [ok0.astype(jnp.int64)[:, None]]
+            fy = []
+            for nm, dt in self.emit_layout:
+                col = jnp.broadcast_to(vals[nm], (P,))[:, None]
+                (fy if dt == jnp.float64 else iy).append(
+                    col if dt == jnp.float64 else _pack_i64(col))
+            y = {"i": jnp.stack(iy, axis=0)}
+            if fy:
+                y["f"] = jnp.stack(fy, axis=0)
+
+        # 6. sequence strictness: any valid event kills non-transitioned
+        #    started slots (reference StreamPreStateProcessor.java:317-330);
+        #    parked completions (sidx == S) already matched — exempt
+        if spec.is_sequence:
+            active = active & (total_match | (sidx == S) | ~valid[:, None])
+
+        # 7. allocate a slot for the head match (at most one per step).
+        #    One-hot where-writes, not scatters: scatters each compile to
+        #    their own kernel and serialize the step; wheres fuse.
+        if S > 1:
+            free = ~active
+            has_free = free.any(axis=1)
+            slot = jnp.argmax(free, axis=1)                    # first free
+            do = ok0 & has_free
+            of_slots = of_slots + (ok0 & ~has_free).astype(jnp.int32)
+            hot = (jnp.arange(A)[None, :] == slot[:, None]) & do[:, None]  # (P,A)
+            active = active | hot
+            sidx = jnp.where(hot, 1, sidx)
+            first_ts = jnp.where(hot, ts[:, None], first_ts)
+            slot_seq = jnp.where(hot, seq[:, None], slot_seq)
+            sch = spec.schemas[h.ref]
+            for a in sch.attributes:
+                k = f"{h.ref}.{a.name}"
+                caps[k] = jnp.where(hot, x[f"{h.scode}.{a.name}"][:, None],
+                                    caps[k])
+            caps[f"{h.ref}.__ts__"] = jnp.where(hot, ts[:, None],
+                                                caps[f"{h.ref}.__ts__"])
+
+        carry = {"active": active, "sidx": sidx, "first_ts": first_ts,
+                 "slot_seq": slot_seq, "armed0": armed0, "caps": caps,
+                 "of_slots": of_slots}
+        return carry, y
+
+    def _drain_done(self, active, sidx, slot_seq, caps):
+        """Emit up to E parked completions per partition from slot storage;
+        returns (active', y) with y the packed (Ci/Cf, P, E) lane grids."""
+        spec, P, A, E = self.spec, self.P, self.A, self.E
+        done = active & (sidx == spec.S)
+        rank = jnp.cumsum(done, axis=1) - done
+        sels = [done & (rank == e) for e in range(E)]       # one-hot over A
+        lv = jnp.stack([s.any(axis=1) for s in sels], axis=1)   # (P, E)
+        vals = self._emit_from_storage(caps, slot_seq)
+        igrid = jnp.stack(
+            [_pack_i64(jnp.broadcast_to(vals[nm], (P, A)))
+             for nm, dt in self.emit_layout if dt != jnp.float64], axis=0)
+        fcols = [jnp.broadcast_to(vals[nm], (P, A))
+                 for nm, dt in self.emit_layout if dt == jnp.float64]
+        # whole-row grids: one masked reduction per LANE, not per column
+        ilanes = jnp.stack(
+            [jnp.where(s[None], igrid, 0).sum(axis=-1) for s in sels],
+            axis=-1)                                        # (Ci', P, E)
+        y = {"i": jnp.concatenate([lv.astype(jnp.int64)[None], ilanes], axis=0)}
+        if fcols:
+            fgrid = jnp.stack(fcols, axis=0)
+            y["f"] = jnp.stack(
+                [jnp.where(s[None], fgrid, 0.0).sum(axis=-1) for s in sels],
+                axis=-1)                                    # (Cf, P, E)
+        emitted = done & (rank < E)
+        return active & ~emitted, y
+
+    def _emit_from_storage(self, caps: dict, slot_seq) -> dict:
+        """Match-row (P,A) columns for parked completions (layout order)."""
+        spec = self.spec
+        last = spec.states[-1]
+        vals: dict = {"__head_seq__": slot_seq}
+        for s in spec.states:
+            sch = spec.schemas[s.ref]
+            for a in sch.attributes:
+                k = f"{s.ref}.{a.name}"
+                vals[k] = caps[k]
+            vals[f"{s.ref}.__ts__"] = caps[f"{s.ref}.__ts__"]
+        vals["__timestamp__"] = caps[f"{last.ref}.__ts__"]
+        vals["__seq__"] = caps["__comp_seq__"]
+        return vals
+
+    def _emit_direct(self, x: dict, ts, seq) -> dict:
+        """Match-row (P,) columns for single-state chains (layout order)."""
+        st = self.spec.states[0]
+        vals: dict = {"__head_seq__": seq}
+        for a in self.spec.schemas[st.ref].attributes:
+            vals[f"{st.ref}.{a.name}"] = x[f"{st.scode}.{a.name}"]
+        vals[f"{st.ref}.__ts__"] = ts
+        vals["__timestamp__"] = ts
+        vals["__seq__"] = seq
+        return vals
+
+    # -- block ---------------------------------------------------------------
+
+    def raw_block_fn(self, M: int) -> Callable:
+        """Unjitted block(state, ev) — the framework's 'forward step' for
+        compile checks and mesh-sharded execution."""
+        return self._make_block(M)
+
+    def block_fn(self, T: int, M: int) -> Callable:
+        key = (T, M)
+        fn = self._block_cache.get(key)
+        if fn is None:
+            fn = self._block_cache[key] = jax.jit(self._make_block(M))
+        return fn
+
+    def _make_block(self, M: int) -> Callable:
+        """M = flat match-buffer capacity for the whole block (host retries
+        with 2M on overflow; state is functional so a retry is exact)."""
+
+        def block(state, ev):
+            # unroll: the per-event body is latency-bound (small (P,A) ops);
+            # unrolling amortizes loop overhead across several events
+            carry, ys = lax.scan(self._step, dict(state), ev)
+            if self.spec.S > 1:
+                # drain parked completions so a flush returns every match
+                # produced by its events: ceil(A/E) lane rounds empty any
+                # backlog (each round frees E slots per partition)
+                def drain_step(c, _):
+                    act, y2 = self._drain_done(c["active"], c["sidx"],
+                                               c["slot_seq"], c["caps"])
+                    c2 = dict(c)
+                    c2["active"] = act
+                    return c2, y2
+                rounds = -(-self.A // self.E)
+                carry, ys2 = lax.scan(drain_step, carry, None, length=rounds)
+                ys = jax.tree_util.tree_map(
+                    lambda a, b: jnp.concatenate([a, b], axis=0), ys, ys2)
+            # compact the packed (T, C, P, E) lane grids into ONE flat (M,)
+            # buffer per column — a single scatter each, and the transfer
+            # carries only matches instead of a sparse ring
+            ys_i = ys["i"]                        # (T, Ci, P, E) int64
+            ys_f = ys.get("f")                    # (T, Cf, P, E) f64
+
+            def flatten(arr):                     # (T, P, E) -> (T*P*E,)
+                # time-major flat order, NO transpose (the grids are large);
+                # the host re-sorts matches by (__seq__, __head_seq__)
+                return arr.reshape(-1)
+
+            lv = flatten(ys_i[:, 0]) != 0
+            pos = jnp.cumsum(lv) - lv
+            wpos = jnp.where(lv & (pos < M), pos, M)
+            out = {}
+            ii, fi = 1, 0
+            for name, dt in self.emit_layout:
+                if dt == jnp.float64:
+                    flat = flatten(ys_f[:, fi]); fi += 1
+                    col = jnp.zeros((M,), dt).at[wpos].set(flat, mode="drop")
+                else:
+                    flat = flatten(ys_i[:, ii]); ii += 1
+                    col = _unpack_jnp(
+                        jnp.zeros((M,), jnp.int64).at[wpos].set(flat, mode="drop"),
+                        dt)
+                out[name] = col
+            n = lv.sum(dtype=jnp.int64)
+            # selector + having over the match buffer
+            sel = {name: ce.fn(out) for name, ce in self.sel_fns.items()}
+            valid = jnp.arange(M) < jnp.minimum(n, M)
+            if self.having is not None:
+                henv = dict(out)
+                henv.update(sel)
+                valid = valid & self.having.fn(henv)
+            sel["__timestamp__"] = out["__timestamp__"]
+            sel["__seq__"] = out["__seq__"]
+            sel["__head_seq__"] = out["__head_seq__"]
+            # pack the outputs into TWO matrices so the device->host pull is
+            # two transfers total (vs one RPC per column): an int64 pack
+            # (row 0 = [n, of_slots, ...], row 1 = valid, then the
+            # non-f64 columns) and an f64 stack (TPU's emulated f64 can't
+            # bitcast into the int pack)
+            meta = (jnp.zeros((M,), jnp.int64)
+                    .at[0].set(n)
+                    .at[1].set(carry["of_slots"].sum(dtype=jnp.int64)))
+            irows = [meta, valid.astype(jnp.int64)]
+            frows = []
+            for name in self.out_names:
+                col = sel[name]
+                if col.dtype == jnp.float64:
+                    frows.append(col)
+                else:
+                    irows.append(_pack_i64(col))
+            out2 = {"i": jnp.stack(irows, axis=0)}
+            if frows:
+                out2["f"] = jnp.stack(frows, axis=0)
+            return carry, out2
+        return block
+
+
+def pow2_at_least(n: int, lo: int = 8) -> int:
+    return max(lo, 1 << max(0, math.ceil(math.log2(max(1, n)))))
+
+
+def _pack_i64(col):
+    """Bitcast a non-f64 column dtype into an int64 lane (see _unpack_i64);
+    f64 travels in its own pack — TPU emulates f64 and can't bitcast it."""
+    if col.dtype == jnp.float32:
+        return lax.bitcast_convert_type(col, jnp.int32).astype(jnp.int64)
+    return col.astype(jnp.int64)
+
+
+def _unpack_jnp(col, dtype):
+    """Device-side inverse of _pack_i64."""
+    if dtype == jnp.float32:
+        return lax.bitcast_convert_type(col.astype(jnp.int32), jnp.float32)
+    if dtype == jnp.bool_:
+        return col != 0
+    return col.astype(dtype)
+
+
+def _unpack_i64(row: np.ndarray, dtype) -> np.ndarray:
+    dtype = np.dtype(dtype)
+    if dtype == np.float32:
+        return row.astype(np.int32).view(np.float32)
+    if dtype == np.bool_:
+        return row != 0
+    return row.astype(dtype)

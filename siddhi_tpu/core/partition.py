@@ -1,0 +1,310 @@
+"""Partitions: `partition with (expr of Stream, ...) begin ... end`.
+
+Two execution strategies, chosen per inner query:
+
+1. Device axis (the TPU-native one): pattern/sequence queries whose input
+   streams all carry value partition keys lower to ONE DevicePatternPlan
+   whose partition axis P holds every key — thousands of per-key NFA
+   instances advanced by one kernel, shardable across chips.  This is the
+   framework's data-parallelism story (reference instead lazily clones the
+   whole query graph per key: core:partition/PartitionRuntime.java:257-306,
+   PartitionStreamReceiver.java:81-199).
+
+2. Host clones (general fallback): the inner query's AST is rewritten per
+   key — input/output stream ids get a per-instance synthetic prefix
+   ("#p<idx>/<key#>/Stream") — and planned like any other query; a group
+   receiver splits arriving batches by key (preserving global seqs, which
+   carry cross-stream order into pattern instances) and republishes them
+   under the synthetic ids.  Inner `#streams` are instance-local by the
+   same renaming.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..query import ast
+from .batch import EventBatch
+from .planner import PlanError, QueryPlan
+from .schema import StreamSchema
+
+
+def _rewrite_state(elem, ren: Callable):
+    if isinstance(elem, ast.StreamStateElement):
+        return dataclasses.replace(elem, stream=ren(elem.stream))
+    if isinstance(elem, ast.AbsentStreamStateElement):
+        return dataclasses.replace(elem, stream=ren(elem.stream))
+    if isinstance(elem, ast.CountStateElement):
+        return dataclasses.replace(elem, stream=_rewrite_state(elem.stream, ren))
+    if isinstance(elem, ast.LogicalStateElement):
+        return dataclasses.replace(elem, left=_rewrite_state(elem.left, ren),
+                                   right=_rewrite_state(elem.right, ren))
+    if isinstance(elem, ast.NextStateElement):
+        return dataclasses.replace(elem, state=_rewrite_state(elem.state, ren),
+                                   next=_rewrite_state(elem.next, ren))
+    if isinstance(elem, ast.EveryStateElement):
+        return dataclasses.replace(elem, state=_rewrite_state(elem.state, ren))
+    raise PlanError(f"cannot rewrite state element {type(elem).__name__}")
+
+
+def rewrite_query(q: ast.Query, rename: dict) -> ast.Query:
+    """Clone a query AST with stream ids substituted (aliases preserved)."""
+
+    def ren_stream(s: ast.SingleInputStream) -> ast.SingleInputStream:
+        key = f"#{s.stream_id}" if s.is_inner else s.stream_id
+        new_id = rename.get(key)
+        if new_id is None:
+            return s
+        # keep references resolving against the original name
+        return dataclasses.replace(s, stream_id=new_id, is_inner=False,
+                                   ref_id=s.ref_id or s.stream_id)
+
+    inp = q.input
+    if isinstance(inp, ast.SingleInputStream):
+        inp = ren_stream(inp)
+    elif isinstance(inp, ast.StateInputStream):
+        inp = dataclasses.replace(inp, state=_rewrite_state(inp.state, ren_stream))
+    elif isinstance(inp, ast.JoinInputStream):
+        inp = dataclasses.replace(inp, left=ren_stream(inp.left),
+                                  right=ren_stream(inp.right))
+    else:
+        raise PlanError(f"partition: unsupported input {type(inp).__name__}")
+    out = q.output
+    tgt = _output_key(out)
+    if tgt is not None and tgt in rename:
+        kw = {"target": rename[tgt]}
+        if getattr(out, "is_inner", False):
+            kw["is_inner"] = False
+        out = dataclasses.replace(out, **kw)
+    return dataclasses.replace(q, input=inp, output=out)
+
+
+def _output_key(out) -> Optional[str]:
+    tgt = getattr(out, "target", None)
+    if tgt is None:
+        return None
+    return f"#{tgt}" if getattr(out, "is_inner", False) else tgt
+
+
+def input_stream_ids(q: ast.Query) -> list:
+    """Input stream ids; inner (#) streams come back with a '#' prefix."""
+    def sid_of(s: ast.SingleInputStream) -> str:
+        return f"#{s.stream_id}" if s.is_inner else s.stream_id
+
+    inp = q.input
+    if isinstance(inp, ast.SingleInputStream):
+        return [sid_of(inp)]
+    if isinstance(inp, ast.JoinInputStream):
+        return [sid_of(inp.left), sid_of(inp.right)]
+    if isinstance(inp, ast.StateInputStream):
+        out: list = []
+
+        def walk(e):
+            if isinstance(e, ast.StreamStateElement):
+                out.append(sid_of(e.stream))
+            elif isinstance(e, ast.AbsentStreamStateElement):
+                out.append(sid_of(e.stream))
+            elif isinstance(e, ast.CountStateElement):
+                walk(e.stream)
+            elif isinstance(e, ast.LogicalStateElement):
+                walk(e.left)
+                walk(e.right)
+            elif isinstance(e, ast.NextStateElement):
+                walk(e.state)
+                walk(e.next)
+            elif isinstance(e, ast.EveryStateElement):
+                walk(e.state)
+        walk(inp.state)
+        return out
+    raise PlanError(f"partition: unsupported input {type(inp).__name__}")
+
+
+class PartitionGroup(QueryPlan):
+    """Routes keyed events to per-key query instances (strategy 2) and owns
+    lazily-created clones.  Device-axis pattern plans (strategy 1) register
+    themselves as ordinary plans and bypass this group entirely."""
+
+    out_schema = None
+    output_target = None
+
+    def __init__(self, rt, part: ast.Partition, index: int,
+                 clone_queries: list):
+        from ..interp.expr import PyExprContext, compile_py
+        self.rt = rt
+        self.part = part
+        self.index = index
+        self.name = f"#partition_{index}"
+        self.clone_queries = clone_queries      # queries run via cloning
+        self.key_fns: dict = {}                 # sid -> fn(env) -> key | None
+        for pk in part.keys:
+            schema = rt.schemas.get(pk.stream_id)
+            if schema is None:
+                raise PlanError(f"partition: unknown stream {pk.stream_id!r}")
+            ctx = PyExprContext({pk.stream_id: schema}, default_ref=pk.stream_id)
+            if pk.expr is not None:
+                f, _t = compile_py(pk.expr, ctx)
+                self.key_fns[pk.stream_id] = f
+            else:
+                cases = [(compile_py(c.condition, ctx)[0], c.key)
+                         for c in pk.ranges]
+
+                def range_fn(env, _cases=cases):
+                    for cond, label in _cases:
+                        if cond(env):
+                            return label
+                    return None                  # no range -> dropped
+                self.key_fns[pk.stream_id] = range_fn
+
+        # only route streams the clone-strategy queries actually consume
+        needed = {sid for q in clone_queries for sid in input_stream_ids(q)
+                  if not sid.startswith("#")}
+        missing = needed - set(self.key_fns)
+        if missing:
+            raise PlanError(
+                f"partition: inner queries consume unkeyed streams {sorted(missing)}")
+        self.input_streams = tuple(sid for sid in self.key_fns if sid in needed)
+        self._key_index: dict = {}               # key -> instance number
+        self._instances: set = set()             # instance numbers built
+
+    # -- instance management -------------------------------------------------
+
+    def _syn(self, inst: int, sid: str) -> str:
+        base = sid[1:] if sid.startswith("#") else sid
+        return f"#p{self.index}/{inst}/{base}"
+
+    def _ensure_instance(self, inst: int) -> None:
+        if inst in self._instances:
+            return
+        self._instances.add(inst)
+        from .build import plan_query
+        rt = self.rt
+        # synthetic schemas for this instance's renamed streams
+        rename: dict = {}
+        inner_ids = set()
+        for q in self.clone_queries:
+            for sid in input_stream_ids(q):
+                inner_ids.add(sid)
+            tgt = _output_key(q.output)
+            if tgt is not None and tgt.startswith("#"):
+                inner_ids.add(tgt)
+        for sid in inner_ids:
+            if sid.startswith("#") or sid in self.key_fns:
+                rename[sid] = self._syn(inst, sid)
+        for sid, syn in rename.items():
+            if syn not in rt.schemas and sid in rt.schemas:
+                rt.schemas[syn] = StreamSchema(
+                    syn, rt.schemas[sid].attributes)
+        for qi, q in enumerate(self.clone_queries):
+            q2 = rewrite_query(q, rename)
+            base = q.name(f"query_p{self.index}_{qi}")
+            plan = plan_query(rt, q2, default_name=base)
+            plan.name = f"{base}#{inst}"
+            plan.callback_name = base
+            rt._register_plan(plan)
+
+    # -- QueryPlan interface -------------------------------------------------
+
+    def process(self, stream_id: str, batch: EventBatch) -> list:
+        if batch.n == 0:
+            return []
+        fn = self.key_fns[stream_id]
+        rows = batch.rows(self.rt.strings)
+        names = batch.schema.names
+        keys = []
+        for ts, row in zip(batch.timestamps, rows):
+            env = dict(zip(names, row))
+            env["__timestamp__"] = int(ts)
+            keys.append(fn(env))
+        arr = np.asarray([self._key_index.setdefault(k, len(self._key_index))
+                          if k is not None else -1 for k in keys],
+                         dtype=np.int64)
+        for inst in np.unique(arr):
+            if inst < 0:
+                continue
+            inst = int(inst)
+            self._ensure_instance(inst)
+            m = arr == inst
+            sub = EventBatch(
+                batch.schema, batch.timestamps[m],
+                {k: v[m] for k, v in batch.columns.items()}, int(m.sum()),
+                batch.seqs[m] if batch.seqs is not None else None)
+            # direct enqueue preserves original seqs (cross-stream order
+            # matters inside pattern instances); _emit would re-stamp them
+            self.rt._pending.append((self._syn(inst, stream_id), sub))
+        return []
+
+    def state_dict(self) -> dict:
+        # keys are plain hashables (str/int/float/bool) — store them as-is
+        return {"key_index": list(self._key_index.items())}
+
+    def load_state_dict(self, d: dict) -> None:
+        self._key_index = dict(d["key_index"])
+        for inst in set(self._key_index.values()):
+            self._ensure_instance(inst)
+
+
+def plan_partition(rt, part: ast.Partition, index: int) -> None:
+    """Split inner queries between the device partition axis and host
+    clones, then register the group receiver (if any clones remain)."""
+    from .build import output_target_of
+    from .pattern_plan import DevicePatternPlan
+    from .nfa_device import DeviceNFAUnsupported
+
+    mode = getattr(rt, "device_patterns", "auto")
+    value_keys = {pk.stream_id: pk.expr for pk in part.keys
+                  if pk.expr is not None}
+    clone_queries: list = []
+    for qi, q in enumerate(part.queries):
+        used = None
+        if isinstance(q.input, ast.StateInputStream) and mode != "never":
+            sids = set(input_stream_ids(q))
+            if all(s in value_keys for s in sids):
+                try:
+                    name = q.name(f"query_p{index}_{qi}")
+                    key_fns = {s: _columnar_key_fn(rt, s, value_keys[s])
+                               for s in sids}
+                    plan = DevicePatternPlan(
+                        name, rt, q, q.input, output_target_of(q),
+                        partitions=rt.partition_capacity,
+                        part_key_fns=key_fns, slots=rt.device_slots)
+                    rt._register_plan(plan)
+                    used = True
+                except (DeviceNFAUnsupported, PlanError):
+                    if mode == "always":   # device-or-error, no silent clone
+                        raise
+                    used = False
+            elif mode == "always":
+                raise PlanError(
+                    f"devicePatterns('always'): partition pattern consumes "
+                    f"streams without value keys ({sorted(sids - set(value_keys))})")
+        if not used:
+            clone_queries.append(q)
+    if clone_queries:
+        group = PartitionGroup(rt, part, index, clone_queries)
+        rt._plans.append(group)
+        rt._plan_by_name[group.name] = group
+        for sid in group.input_streams:
+            rt._subscribers[sid].append(group)
+        for qi, q in enumerate(clone_queries):
+            rt._known_query_names.add(q.name(f"query_p{index}_{qi}"))
+
+
+def _columnar_key_fn(rt, stream_id: str, expr: ast.Expression):
+    """batch -> np key codes; O(1) column grab for plain variables."""
+    schema = rt.schemas[stream_id]
+    if isinstance(expr, ast.Variable) and expr.stream_ref in (None, stream_id):
+        name = expr.attribute
+        if name not in schema.types:
+            raise PlanError(f"partition key: unknown attribute {name!r}")
+        return lambda batch: batch.columns[name]
+    from ..interp.expr import PyExprContext, compile_py
+    ctx = PyExprContext({stream_id: schema}, default_ref=stream_id)
+    f, _t = compile_py(expr, ctx)
+    names = schema.names
+
+    def fn(batch: EventBatch) -> np.ndarray:
+        rows = batch.rows(rt.strings)
+        return np.asarray([f(dict(zip(names, r))) for r in rows])
+    return fn

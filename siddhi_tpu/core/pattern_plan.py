@@ -1,0 +1,335 @@
+"""Device pattern/sequence query plan — host wrapper around NFAKernel.
+
+Buffers per-stream micro-batches, merges them by global arrival seq,
+buckets events into dense (T, P) blocks (one event per partition per scan
+step), runs the jitted batched-NFA block, and compacts emitted matches
+back into an output EventBatch.
+
+The partition axis is 1 for plain pattern queries; partitioned queries
+(`partition with (key of Stream) begin ... end`) set a key extractor and
+a partition capacity so thousands of per-key NFA instances run as one
+kernel (reference clones the whole query graph per key instead:
+core:partition/PartitionRuntime.java:257-306).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from ..query import ast
+from .batch import EventBatch
+from .expr import ExprError, MultiStreamContext, compile_expression
+from .nfa_device import (ChainSpec, DeviceNFAUnsupported, NFAKernel,
+                         lower_chain, pow2_at_least)
+from .planner import (AGGREGATOR_NAMES, OutputBatch, PlanError, QueryPlan,
+                      selector_has_aggregators)
+from .schema import StreamSchema, TIMESTAMP_DTYPE, dtype_of
+
+
+class DevicePatternPlan(QueryPlan):
+    """from [every] e1=A[...] -> e2=B[...] within T — batched device NFA."""
+
+    A_CAP = 512      # adaptive slot-growth ceiling
+
+    def __init__(self, name: str, rt, q: ast.Query, state_input,
+                 target: Optional[str], partitions: int = 1,
+                 part_key_fns: Optional[dict] = None, slots: int = 16):
+        from ..interp.engine import _collect_filters
+
+        self.name = name
+        self.rt = rt
+        self.output_target = target
+        self.events_for = getattr(q.output, "events_for",
+                                  ast.OutputEventsFor.CURRENT)
+        if q.rate is not None:
+            raise DeviceNFAUnsupported("output rate limiting")
+        if q.selector.group_by or q.selector.order_by \
+                or selector_has_aggregators(q.selector):
+            raise DeviceNFAUnsupported("group-by/order-by/aggregating selector")
+        self.limit, self.offset = q.selector.limit, q.selector.offset
+
+        self.spec: ChainSpec = lower_chain(
+            state_input, rt.schemas, rt.strings,
+            _collect_filters(state_input.state))
+        self.input_streams = tuple(self.spec.stream_ids)
+
+        # partitioning: key fn per input stream (row cols -> np int codes)
+        self.P = partitions
+        self.part_key_fns = part_key_fns        # stream_id -> fn(batch)->codes
+        self._key_to_part: dict = {}            # key value -> partition index
+
+        # selector over capture refs
+        sel = q.selector
+        sctx = MultiStreamContext(self.spec.schemas, rt.strings)
+        names, types, fns = [], [], []
+        if sel.select_all:
+            seen = set()
+            for s in self.spec.states:
+                for a in self.spec.schemas[s.ref].attributes:
+                    nm = a.name if a.name not in seen else f"{s.ref}_{a.name}"
+                    seen.add(nm)
+                    ce = compile_expression(
+                        ast.Variable(a.name, stream_ref=s.ref), sctx)
+                    names.append(nm)
+                    types.append(ce.type)
+                    fns.append(ce)
+        else:
+            for oa in sel.attributes:
+                try:
+                    ce = compile_expression(oa.expr, sctx)
+                except ExprError as e:
+                    raise DeviceNFAUnsupported(f"selector: {e}")
+                names.append(oa.name)
+                types.append(ce.type)
+                fns.append(ce)
+        self._names, self._types = names, types
+        having = None
+        if sel.having is not None:
+            import copy
+            hctx = copy.copy(sctx)
+            hctx.extra = {n: (n, t) for n, t in zip(names, types)}
+            try:
+                having = compile_expression(sel.having, hctx)
+            except ExprError as e:
+                raise DeviceNFAUnsupported(f"having: {e}")
+        self.out_schema = StreamSchema(target or f"#{name}", tuple(
+            ast.Attribute(n, t) for n, t in zip(names, types)))
+
+        self.kernel = NFAKernel(self.spec, dict(zip(names, fns)), having,
+                                self.P, slots)
+        self.state = self.kernel.init_state()
+        self._m_hint = 16           # last match-buffer capacity that sufficed
+        self._of_slots_seen = 0     # accepted (at-cap) overflow totals
+        self._buffered: list = []   # (stream_id, EventBatch)
+        self._scode = {sid: i for i, sid in enumerate(self.spec.stream_ids)}
+
+        # build-time validation: trace a tiny block so unsupported env keys
+        # fail here (-> sequential fallback) instead of at first flush
+        dummy = self._dense_dummy(T=2)
+        jax.eval_shape(self.kernel.block_fn(2, 8), self.state, dummy)
+
+    # -- helpers -------------------------------------------------------------
+
+    def _dense_dummy(self, T: int) -> dict:
+        import jax.numpy as jnp
+        from .expr import jnp_dtype
+        P = self.P
+        ev = {"__ts__": jnp.zeros((T, P), dtype=jnp.int64),
+              "__seq__": jnp.zeros((T, P), dtype=jnp.int64),
+              "__scode__": jnp.zeros((T, P), dtype=jnp.int32),
+              "__valid__": jnp.zeros((T, P), dtype=bool)}
+        for sid in self.spec.stream_ids:
+            si = self._scode[sid]
+            for a in self.rt.schemas[sid].attributes:
+                ev[f"{si}.{a.name}"] = jnp.zeros((T, P), dtype=jnp_dtype(a.type))
+        return ev
+
+    @property
+    def dropped(self) -> int:
+        """Partial matches / emissions lost to capacity exhaustion — only
+        possible once adaptive growth hits the A_CAP ceiling.  Carried in
+        device state, so snapshot-safe."""
+        return int(np.asarray(self.state["of_slots"]).sum())
+
+    def part_of(self, stream_id: str, batch: EventBatch) -> np.ndarray:
+        """Partition index per event; grows the key map (host side)."""
+        if self.part_key_fns is None:
+            return np.zeros(batch.n, dtype=np.int32)
+        keys = self.part_key_fns[stream_id](batch)
+        out = np.empty(batch.n, dtype=np.int32)
+        k2p = self._key_to_part
+        for i, k in enumerate(keys.tolist()):
+            p = k2p.get(k)
+            if p is None:
+                if len(k2p) >= self.P:
+                    self._grow(2 * self.P)
+                p = k2p[k] = len(k2p)
+            out[i] = p
+        return out
+
+    def _grow(self, new_p: int) -> None:
+        """Double the partition axis: pad state arrays, rebuild the kernel
+        (the next block jit-compiles at the new P)."""
+        import jax.numpy as jnp
+        old = jax.tree_util.tree_map(np.asarray, self.state)
+        kern = NFAKernel(self.spec, self.kernel.sel_fns, self.kernel.having,
+                         new_p, self.kernel.A, self.kernel.E)
+        fresh = kern.init_state()
+        self.state = jax.tree_util.tree_map(
+            lambda f, o: jnp.asarray(
+                np.concatenate([o, np.asarray(f)[o.shape[0]:]], axis=0)),
+            fresh, old)
+        self.kernel = kern
+        self.P = new_p
+
+    def _grow_slots(self, new_a: int) -> None:
+        """Pad the slot axis of all (P, A) state leaves and rebuild."""
+        import jax.numpy as jnp
+        old = jax.tree_util.tree_map(np.asarray, self.state)
+        kern = NFAKernel(self.spec, self.kernel.sel_fns, self.kernel.having,
+                         self.P, new_a, self.kernel.E)
+        fresh = kern.init_state()
+        self.state = jax.tree_util.tree_map(
+            lambda f, o: jnp.asarray(np.concatenate(
+                [o, np.asarray(f)[:, o.shape[1]:]], axis=1))
+            if o.ndim == 2 else jnp.asarray(o),
+            fresh, old)
+        self.kernel = kern
+
+    # -- QueryPlan interface -------------------------------------------------
+
+    def process(self, stream_id: str, batch: EventBatch) -> list:
+        if batch.n:
+            self._buffered.append((stream_id, batch))
+        return []
+
+    def finalize(self) -> list:
+        if not self._buffered:
+            return []
+        bufs, self._buffered = self._buffered, []
+
+        # 1. union columns over all buffered batches
+        N = sum(b.n for _s, b in bufs)
+        ts = np.empty(N, dtype=np.int64)
+        seq = np.empty(N, dtype=np.int64)
+        scode = np.empty(N, dtype=np.int32)
+        part = np.empty(N, dtype=np.int32)
+        cols: dict = {}
+        for sid in self.spec.stream_ids:
+            si = self._scode[sid]
+            for a in self.rt.schemas[sid].attributes:
+                cols[f"{si}.{a.name}"] = np.zeros(N, dtype=dtype_of(a.type))
+        o = 0
+        for sid, b in bufs:
+            si = self._scode[sid]
+            sl = slice(o, o + b.n)
+            ts[sl] = b.timestamps
+            seq[sl] = b.seqs if b.seqs is not None else np.arange(o, o + b.n)
+            scode[sl] = si
+            part[sl] = self.part_of(sid, b)
+            for a in self.rt.schemas[sid].attributes:
+                cols[f"{si}.{a.name}"][sl] = b.columns[a.name]
+            o += b.n
+
+        # 2. order by arrival, compute index-within-partition
+        order = np.lexsort((seq,))
+        ts, seq, scode, part = ts[order], seq[order], scode[order], part[order]
+        for k in cols:
+            cols[k] = cols[k][order]
+        by_part = np.lexsort((seq, part))
+        idx_within = np.empty(N, dtype=np.int64)
+        sp = part[by_part]
+        run_start = np.flatnonzero(np.r_[True, sp[1:] != sp[:-1]])
+        run_id = np.cumsum(np.r_[True, sp[1:] != sp[:-1]]) - 1
+        idx_within[by_part] = np.arange(N) - run_start[run_id]
+
+        # 3. run dense (T, P) blocks (chunked if one partition hogs the batch)
+        T_CAP = 512
+        rows_out: list = []
+        n_chunks = int(idx_within.max()) // T_CAP + 1
+        for c in range(n_chunks):
+            m = (idx_within >= c * T_CAP) & (idx_within < (c + 1) * T_CAP)
+            if not m.any():
+                continue
+            t_local = (idx_within[m] - c * T_CAP).astype(np.int64)
+            T = pow2_at_least(int(t_local.max()) + 1)
+            ev = {"__ts__": np.zeros((T, self.P), np.int64),
+                  "__seq__": np.zeros((T, self.P), np.int64),
+                  "__scode__": np.full((T, self.P), -1, np.int32),
+                  "__valid__": np.zeros((T, self.P), bool)}
+            for k, v in cols.items():
+                ev[k] = np.zeros((T, self.P), v.dtype)
+            pm = part[m]
+            ev["__ts__"][t_local, pm] = ts[m]
+            ev["__seq__"][t_local, pm] = seq[m]
+            ev["__scode__"][t_local, pm] = scode[m]
+            ev["__valid__"][t_local, pm] = True
+            for k, v in cols.items():
+                ev[k][t_local, pm] = v[m]
+            rows_out.extend(self._run_block(ev, T))
+
+        return self._rows_to_batches(rows_out)
+
+    def _run_block(self, ev: dict, T: int) -> list:
+        """Run one dense block; retry (exactly — state is functional) with
+        doubled match buffer / slots on overflow, so the kernel adapts to
+        the workload without ever losing a match (until the documented
+        A_CAP ceiling; emission lanes cannot overflow — completions park
+        in their slot and drain over subsequent steps)."""
+        from .nfa_device import _unpack_i64
+        M = max(self._m_hint, pow2_at_least(2 * T, lo=16))
+        while True:
+            fn = self.kernel.block_fn(T, M)
+            state2, out = fn(self.state, ev)
+            ipack = np.asarray(out["i"])     # two device->host transfers
+            fpack = np.asarray(out["f"]) if "f" in out else None
+            n, ofs = int(ipack[0, 0]), int(ipack[0, 1])
+            if n > M:
+                M = pow2_at_least(n)
+                continue
+            if ofs > self._of_slots_seen and self.kernel.A < self.A_CAP:
+                self._grow_slots(min(2 * self.kernel.A, self.A_CAP))
+                continue
+            break
+        self._m_hint = M           # avoid recompiling next flush
+        self._of_slots_seen = ofs
+        self.state = state2
+        valid = ipack[1] != 0                     # (M,)
+        if not valid.any():
+            return []
+        row = {}
+        ii, fi = 2, 0
+        for nm in self.kernel.out_names:
+            if fpack is not None and nm in self.kernel.f64_names:
+                row[nm] = fpack[fi]; fi += 1
+            else:
+                row[nm] = ipack[ii]; ii += 1
+        seqs = row["__seq__"][valid]
+        hseqs = row["__head_seq__"][valid]
+        tss = row["__timestamp__"][valid]
+        data = {nm: _unpack_i64(row[nm], dtype_of(t))[valid]
+                for nm, t in zip(self._names, self._types)}
+        # same-event completions tie on seq; order them by head arrival
+        # (reference emits pending-list == arrival order)
+        o = np.lexsort((hseqs, seqs))
+        return [(int(tss[i]), int(seqs[i]),
+                 tuple(data[nm][i] for nm in self._names)) for i in o]
+
+    def _rows_to_batches(self, rows: list) -> list:
+        if not rows or self.events_for == ast.OutputEventsFor.EXPIRED:
+            return []
+        rows.sort(key=lambda r: r[1])
+        if self.offset:
+            rows = rows[self.offset:]
+        if self.limit is not None:
+            rows = rows[:self.limit]
+        if not rows:
+            return []
+        n = len(rows)
+        cols = {}
+        for j, (nm, t) in enumerate(zip(self._names, self._types)):
+            cols[nm] = np.asarray([r[2][j] for r in rows], dtype=dtype_of(t))
+        batch = EventBatch(self.out_schema,
+                           np.asarray([r[0] for r in rows], dtype=TIMESTAMP_DTYPE),
+                           cols, n)
+        return [OutputBatch(self.output_target, batch)]
+
+    # -- snapshot ------------------------------------------------------------
+
+    def state_dict(self) -> dict:
+        st = jax.tree_util.tree_map(np.asarray, self.state)
+        return {"state": st, "key_to_part": dict(self._key_to_part)}
+
+    def load_state_dict(self, d: dict) -> None:
+        import jax.numpy as jnp
+        st = d["state"]
+        p, a = st["active"].shape
+        if p != self.P or a != self.kernel.A:  # snapshot taken after growth
+            self.kernel = NFAKernel(self.spec, self.kernel.sel_fns,
+                                    self.kernel.having, p, a, self.kernel.E)
+            self.P = p
+        self.state = jax.tree_util.tree_map(jnp.asarray, st)
+        self._key_to_part = dict(d["key_to_part"])
+        self._of_slots_seen = int(np.asarray(st["of_slots"]).sum())

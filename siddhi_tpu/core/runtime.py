@@ -57,6 +57,18 @@ class SiddhiAppRuntime:
         self._started = False
         self._playback = qast.find_annotation(app.annotations, "app:playback") is not None
         self._clock_ms: Optional[int] = None   # virtual/playback clock
+        # device pattern matching: "auto" (device when partitioned),
+        # "always" (device or error), "never" (sequential host matcher)
+        dp = qast.find_annotation(app.annotations, "app:devicePatterns")
+        self.device_patterns = dp.element() if dp is not None else "auto"
+        # starting partition-axis capacity for device pattern plans (grows
+        # by doubling as new keys arrive; each growth recompiles the kernel)
+        pc = qast.find_annotation(app.annotations, "app:partitionCapacity")
+        self.partition_capacity = int(pc.element()) if pc is not None else 1024
+        # starting pending-match slots per partition for device pattern
+        # plans (grows adaptively; pre-sizing skips a growth recompile)
+        ds = qast.find_annotation(app.annotations, "app:deviceSlots")
+        self.device_slots = int(ds.element()) if ds is not None else 16
 
         # stream schemas: defined + inferred from query outputs
         self.schemas: dict = {}
@@ -73,6 +85,7 @@ class SiddhiAppRuntime:
         self._batch_callbacks: dict = defaultdict(list)
         self._query_callbacks: dict = defaultdict(list)
         self._plan_by_name: dict = {}
+        self._known_query_names: set = set()   # incl. lazily-cloned partition queries
 
         self._builders: dict = {}
         self._pending: list = []      # FIFO of (stream_id, EventBatch) awaiting dispatch
@@ -89,6 +102,7 @@ class SiddhiAppRuntime:
     def _register_plan(self, plan: QueryPlan) -> None:
         self._plans.append(plan)
         self._plan_by_name[plan.name] = plan
+        self._known_query_names.add(getattr(plan, "callback_name", plan.name))
         for sid in plan.input_streams:
             self._subscribers[sid].append(plan)
         tgt = plan.output_target
@@ -124,8 +138,9 @@ class SiddhiAppRuntime:
 
     def add_query_callback(self, query_name: str, fn: Callable) -> None:
         """QueryCallback: fn(timestamp_ms, in_events, removed_events)."""
-        if query_name not in self._plan_by_name:
-            raise KeyError(f"unknown query {query_name!r}; have {list(self._plan_by_name)}")
+        if query_name not in self._known_query_names:
+            raise KeyError(f"unknown query {query_name!r}; "
+                           f"have {sorted(self._known_query_names)}")
         self._query_callbacks[query_name].append(fn)
 
     def start(self) -> None:
@@ -243,7 +258,8 @@ class SiddhiAppRuntime:
     def _emit(self, plan: QueryPlan, ob: OutputBatch) -> None:
         if ob.batch.n == 0:
             return
-        for cb in self._query_callbacks.get(plan.name, ()):
+        cb_name = getattr(plan, "callback_name", plan.name)
+        for cb in self._query_callbacks.get(cb_name, ()):
             events = self._decode(ob.batch)
             if ob.is_expired:
                 cb(int(ob.batch.timestamps[-1]), None, events)
@@ -278,7 +294,11 @@ class SiddhiAppRuntime:
 
     def restore(self, snap: dict) -> None:
         self.strings.restore(snap["strings"])
-        for name, st in snap["plans"].items():
+        # partition groups first: they re-create lazily-cloned instance plans
+        # that later entries of the snapshot refer to
+        items = sorted(snap["plans"].items(),
+                       key=lambda kv: not kv[0].startswith("#partition_"))
+        for name, st in items:
             if name in self._plan_by_name:
                 self._plan_by_name[name].load_state_dict(st)
         for k, st in snap.get("tables", {}).items():

@@ -1,0 +1,253 @@
+"""Stream-stream window joins — sequential backend.
+
+Reference semantics (core:query/input/stream/join/JoinProcessor.java:62-126,
+built by core:util/parser/JoinInputStreamParser.java): each side owns a
+window; an arriving event (after its side's filters) probes the OPPOSITE
+side's current window content with the compiled `on` condition and emits
+one joined event per match.  Left/right/full outer joins emit the arriving
+event with nulls for the other side when nothing matches; `unidirectional`
+restricts which side's arrivals trigger output.
+
+Implementation detail: instead of reaching into each window's internals,
+every side keeps a `retained` list driven by the window's own
+current/expired/reset emission protocol — so ALL window types compose with
+joins for free.  The arriving event probes the opposite side BEFORE being
+retained on its own side (self-joins don't match an event with itself).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..query import ast
+from ..core.batch import BatchBuilder, EventBatch
+from ..core.planner import OutputBatch, PlanError, QueryPlan
+from ..core.runtime import Event
+from .expr import PyExprContext, compile_py
+from . import windows as W
+
+CURRENT, EXPIRED, RESET = W.CURRENT, W.EXPIRED, W.RESET
+
+
+class JoinSide:
+    def __init__(self, inp: ast.SingleInputStream, rt):
+        from .engine import make_window
+        if inp.stream_id not in rt.schemas:
+            raise PlanError(f"join: unknown stream {inp.stream_id!r}")
+        self.ref = inp.alias
+        self.stream_id = inp.stream_id
+        self.schema = rt.schemas[inp.stream_id]
+        ctx = PyExprContext({inp.alias: self.schema,
+                             inp.stream_id: self.schema},
+                            default_ref=inp.alias)
+        self.filters = [compile_py(f.expr, ctx)[0] for f in inp.filters]
+        for h in inp.handlers:
+            if isinstance(h, ast.StreamFunction):
+                raise PlanError("join: stream functions on join sides "
+                                "not supported")
+        self.window: Optional[W.Window] = None
+        if inp.window is not None:
+            self.window = make_window(inp.window, ctx, self.schema)
+        self.retained: list[Event] = []
+
+    def passes(self, env: dict) -> bool:
+        return all(f(env) for f in self.filters)
+
+    def env_of(self, ev: Event) -> dict:
+        env = {f"{self.ref}.{n}": v for n, v in zip(self.schema.names, ev.data)}
+        for n, v in zip(self.schema.names, ev.data):
+            env[n] = v
+        env["__timestamp__"] = ev.timestamp
+        return env
+
+    def apply_emissions(self, emissions: list) -> None:
+        for kind, ev in emissions:
+            if kind == CURRENT:
+                self.retained.append(ev)
+            elif kind == EXPIRED:
+                # windows re-stamp expired events with their expiry time
+                # (reference current/expired protocol) — match on data,
+                # FIFO, which mirrors window expiry order
+                for i, r in enumerate(self.retained):
+                    if r.data == ev.data:
+                        del self.retained[i]
+                        break
+            elif kind == RESET:
+                self.retained.clear()
+
+    def retain(self, ev: Event, now_ms: int) -> None:
+        if self.window is None:
+            return                    # windowless side keeps nothing
+        self.apply_emissions(self.window.process(ev, now_ms))
+
+    def on_timer(self, now_ms: int) -> None:
+        if self.window is not None:
+            self.apply_emissions(self.window.on_timer(now_ms))
+
+    def next_wakeup(self):
+        return self.window.next_wakeup() if self.window is not None else None
+
+    def state(self) -> dict:
+        return {"window": self.window.state() if self.window else None,
+                "retained": [(e.timestamp, e.data) for e in self.retained]}
+
+    def restore(self, st: dict) -> None:
+        if self.window is not None and st.get("window") is not None:
+            self.window.restore(st["window"])
+        self.retained = [Event(t, tuple(d)) for t, d in st["retained"]]
+
+
+class InterpJoinQueryPlan(QueryPlan):
+    """`from A#win as a join B#win as b on a.x == b.y select ...`"""
+
+    def __init__(self, name: str, rt, q: ast.Query,
+                 inp: ast.JoinInputStream, target: Optional[str]):
+        from .engine import InterpSelector, make_rate_limiter
+        self.name = name
+        self.rt = rt
+        self.output_target = target
+        self.events_for = getattr(q.output, "events_for",
+                                  ast.OutputEventsFor.CURRENT)
+        self.left = JoinSide(inp.left, rt)
+        self.right = JoinSide(inp.right, rt)
+        if self.left.ref == self.right.ref:
+            raise PlanError(f"join {name!r}: both sides named "
+                            f"{self.left.ref!r}; alias one with `as`")
+        self.join_type = inp.join_type
+        self.trigger = inp.trigger       # "all" | "left" | "right"
+        schemas = {self.left.ref: self.left.schema,
+                   self.right.ref: self.right.schema}
+        ctx = PyExprContext(schemas)
+        self.on = compile_py(inp.on, ctx)[0] if inp.on is not None else None
+        self.sel = InterpSelector(_join_selector(q.selector, self), ctx,
+                                  None, target or f"#{name}")
+        self.out_schema = self.sel.out_schema
+        self.rate = make_rate_limiter(q.rate)
+        self.input_streams = tuple({self.left.stream_id, self.right.stream_id})
+        self._buffer: list = []          # (seq, stream_id, Event)
+
+    # -- QueryPlan interface -------------------------------------------------
+
+    def process(self, stream_id: str, batch: EventBatch) -> list:
+        rows = batch.rows(self.rt.strings)
+        seqs = batch.seqs if batch.seqs is not None else range(batch.n)
+        for seq, ts, row in zip(seqs, batch.timestamps, rows):
+            self._buffer.append((int(seq), stream_id, Event(int(ts), row)))
+        return []
+
+    def finalize(self) -> list:
+        if not self._buffer:
+            return []
+        buf = sorted(self._buffer, key=lambda t: t[0])
+        self._buffer = []
+        out_rows: list = []
+        for _seq, sid, ev in buf:
+            now = ev.timestamp if self.rt._playback else self.rt.now_ms()
+            # self-join: one arrival drives both sides — all probes run
+            # before either side retains, so an event never joins itself
+            arrivals = []
+            if sid == self.left.stream_id:
+                arrivals.append((self.left, self.right, "left"))
+            if sid == self.right.stream_id:
+                arrivals.append((self.right, self.left, "right"))
+            passed = []
+            for side, other, side_name in arrivals:
+                if side.passes(side.env_of(ev)):
+                    passed.append((side, other, side_name))
+                    out_rows.extend(self._probe(side, other, side_name, ev))
+            for side, _other, _sn in passed:
+                side.retain(ev, now)
+        out_rows = self._post(out_rows)
+        return self._to_batches(out_rows)
+
+    def _probe(self, side: JoinSide, other: JoinSide, side_name: str,
+               ev: Event) -> list:
+        if self.trigger not in ("all", side_name):
+            return []
+        rows = []
+        base = {f"{side.ref}.{n}": v
+                for n, v in zip(side.schema.names, ev.data)}
+        base["__timestamp__"] = ev.timestamp
+        matched = False
+        for oev in other.retained:
+            env = dict(base)
+            for n, v in zip(other.schema.names, oev.data):
+                env[f"{other.ref}.{n}"] = v
+            if self.on is not None and not self.on(env):
+                continue
+            matched = True
+            row = self.sel.process(CURRENT, env)
+            if row is not None:
+                rows.append((CURRENT, ev.timestamp, row))
+        outer = (self.join_type == ast.JoinType.FULL_OUTER
+                 or (self.join_type == ast.JoinType.LEFT_OUTER
+                     and side_name == "left")
+                 or (self.join_type == ast.JoinType.RIGHT_OUTER
+                     and side_name == "right"))
+        if not matched and outer:
+            env = dict(base)
+            for n in other.schema.names:
+                env[f"{other.ref}.{n}"] = None
+            row = self.sel.process(CURRENT, env)
+            if row is not None:
+                rows.append((CURRENT, ev.timestamp, row))
+        return rows
+
+    def _post(self, rows: list) -> list:
+        if self.sel.order_by or self.sel.selector.limit is not None \
+                or self.sel.selector.offset:
+            cur = [(t, r) for _k, t, r in rows]
+            rows = [(CURRENT, t, r) for t, r in self.sel.order_limit(cur)]
+        if self.rate is not None:
+            rows = [r for k, t, row in rows for r in self.rate.feed(k, t, row)]
+        return rows
+
+    def on_timer(self, now_ms: int) -> list:
+        self.left.on_timer(now_ms)
+        self.right.on_timer(now_ms)
+        rows = []
+        if self.rate is not None:
+            rows = self.rate.on_timer(now_ms)
+        return self._to_batches(rows)
+
+    def next_wakeup(self):
+        cands = [w for w in (self.left.next_wakeup(), self.right.next_wakeup(),
+                             self.rate.next_wakeup() if self.rate else None)
+                 if w is not None]
+        return min(cands) if cands else None
+
+    def _to_batches(self, rows: list) -> list:
+        if not rows or self.events_for == ast.OutputEventsFor.EXPIRED:
+            return []
+        bb = BatchBuilder(self.out_schema, self.rt.strings)
+        for _k, t, r in rows:
+            bb.append(t, tuple(r))
+        return [OutputBatch(self.output_target, bb.freeze())]
+
+    def state_dict(self) -> dict:
+        return {"left": self.left.state(), "right": self.right.state(),
+                "selector": self.sel.state(),
+                "rate": self.rate.state() if self.rate else None}
+
+    def load_state_dict(self, d: dict) -> None:
+        self.left.restore(d["left"])
+        self.right.restore(d["right"])
+        self.sel.restore(d["selector"])
+        if self.rate is not None and d.get("rate") is not None:
+            self.rate.restore(d["rate"])
+
+
+def _join_selector(sel: ast.Selector, plan: InterpJoinQueryPlan) -> ast.Selector:
+    """Expand `select *` to both sides' attributes (left then right;
+    duplicate names get a ref prefix — reference raises instead, we rename)."""
+    if not sel.select_all:
+        return sel
+    attrs = []
+    seen = set()
+    for side in (plan.left, plan.right):
+        for a in side.schema.attributes:
+            nm = a.name if a.name not in seen else f"{side.ref}_{a.name}"
+            seen.add(nm)
+            attrs.append(ast.OutputAttribute(
+                ast.Variable(a.name, stream_ref=side.ref), nm))
+    return ast.Selector(False, tuple(attrs), sel.group_by, sel.having,
+                        sel.order_by, sel.limit, sel.offset)

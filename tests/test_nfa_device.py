@@ -1,0 +1,209 @@
+"""Device (batched) NFA vs sequential host matcher — differential tests.
+
+The batched kernel must produce exactly the reference-semantics match set
+(zero false matches / zero misses) on every supported pattern shape; the
+sequential matcher (tests/test_patterns.py pins its semantics against the
+reference) is the oracle.
+"""
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+DEV = "@app:devicePatterns('always')"
+SEQ = "@app:devicePatterns('never')"
+
+
+@pytest.fixture
+def mgr():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def run_app(mgr, app, sends, out_stream="M"):
+    """sends: [(stream_id, row, ts)] — returns list of output data tuples."""
+    rt = mgr.create_app_runtime(app)
+    out = []
+    rt.add_callback(out_stream, lambda evs: out.extend(e.data for e in evs))
+    handlers = {}
+    rt.start()
+    for sid, row, ts in sends:
+        h = handlers.get(sid) or handlers.setdefault(sid, rt.input_handler(sid))
+        h.send(row, timestamp=ts)
+    rt.flush()
+    return out, rt
+
+
+BODY_EVERY = """
+define stream S (sym string, p double);
+@info(name='q') from every e1=S[p > 100] -> e2=S[p > e1.p]
+select e1.p as p1, e2.p as p2 insert into M;
+"""
+
+
+def test_every_pattern_basic(mgr):
+    sends = [("S", ("A", p), 1000 + i) for i, p in
+             enumerate((101.0, 50.0, 102.0, 103.0))]
+    dev, rt = run_app(mgr, DEV + BODY_EVERY, sends)
+    host, _ = run_app(mgr, SEQ + BODY_EVERY, sends)
+    assert dev == host
+    assert (101.0, 102.0) in dev and (102.0, 103.0) in dev
+    from siddhi_tpu.core.pattern_plan import DevicePatternPlan
+    assert isinstance(rt._plan_by_name["q"], DevicePatternPlan)
+
+
+def test_non_every_single_match(mgr):
+    body = """
+    define stream S (p double);
+    @info(name='q') from e1=S[p > 100] -> e2=S[p > e1.p]
+    select e1.p as p1, e2.p as p2 insert into M;
+    """
+    sends = [("S", (p,), 1000 + i) for i, p in
+             enumerate((101.0, 102.0, 103.0, 104.0))]
+    dev, _ = run_app(mgr, DEV + body, sends)
+    host, _ = run_app(mgr, SEQ + body, sends)
+    assert dev == host == [(101.0, 102.0)]
+
+
+def test_within_expiry(mgr):
+    body = """
+    define stream S (p double);
+    @info(name='q') from every e1=S[p > 100] -> e2=S[p > e1.p] within 1 sec
+    select e1.p as p1, e2.p as p2 insert into M;
+    """
+    sends = [("S", (101.0,), 1000), ("S", (102.0,), 2500),
+             ("S", (150.0,), 2600), ("S", (151.0,), 2700)]
+    dev, _ = run_app(mgr, DEV + body, sends)
+    host, _ = run_app(mgr, SEQ + body, sends)
+    assert dev == host
+    assert (101.0, 102.0) not in dev          # expired (1500ms > 1s)
+    assert (102.0, 150.0) in dev
+
+
+def test_sequence_strictness(mgr):
+    body = """
+    define stream S (p double);
+    @info(name='q') from every e1=S[p > 100], e2=S[p > e1.p]
+    select e1.p as p1, e2.p as p2 insert into M;
+    """
+    # 101, 50 (breaks contiguity), 102, 103 -> only (102,103)
+    sends = [("S", (p,), 1000 + i) for i, p in
+             enumerate((101.0, 50.0, 102.0, 103.0))]
+    dev, _ = run_app(mgr, DEV + body, sends)
+    host, _ = run_app(mgr, SEQ + body, sends)
+    assert dev == host == [(102.0, 103.0)]
+
+
+def test_two_streams_three_states(mgr):
+    body = """
+    define stream A (x int);
+    define stream B (y int);
+    @info(name='q') from every e1=A[x > 0] -> e2=B[y > e1.x] -> e3=A[x > e2.y]
+    select e1.x as a, e2.y as b, e3.x as c insert into M;
+    """
+    sends = [("A", (1,), 1000), ("B", (5,), 1001), ("A", (7,), 1002),
+             ("B", (9,), 1003), ("A", (20,), 1004)]
+    dev, _ = run_app(mgr, DEV + body, sends)
+    host, _ = run_app(mgr, SEQ + body, sends)
+    assert dev == host
+    assert (1, 5, 7) in dev and (7, 9, 20) in dev
+
+
+def test_single_state_every(mgr):
+    body = """
+    define stream S (p double);
+    @info(name='q') from every e1=S[p > 100]
+    select e1.p as p1 insert into M;
+    """
+    sends = [("S", (p,), 1000 + i) for i, p in
+             enumerate((101.0, 50.0, 150.0))]
+    dev, _ = run_app(mgr, DEV + body, sends)
+    host, _ = run_app(mgr, SEQ + body, sends)
+    assert dev == host == [(101.0,), (150.0,)]
+
+
+def test_string_predicates(mgr):
+    body = """
+    define stream S (sym string, p double);
+    @info(name='q') from every e1=S[sym == 'IBM'] -> e2=S[sym == e1.sym and p > e1.p]
+    select e1.p as p1, e2.p as p2 insert into M;
+    """
+    sends = [("S", ("IBM", 10.0), 1000), ("S", ("WSO2", 99.0), 1001),
+             ("S", ("IBM", 12.0), 1002)]
+    dev, _ = run_app(mgr, DEV + body, sends)
+    host, _ = run_app(mgr, SEQ + body, sends)
+    assert dev == host == [(10.0, 12.0)]
+
+
+def test_having_and_limit(mgr):
+    body = """
+    define stream S (p double);
+    @info(name='q') from every e1=S[p > 0] -> e2=S[p > e1.p]
+    select e1.p as p1, e2.p as p2 having p2 - p1 > 5 insert into M;
+    """
+    # e1=1.0 consumes e2=2.0 (first match) and retires -> having drops it;
+    # e1=2.0 completes with 10.0 and passes having
+    sends = [("S", (p,), 1000 + i) for i, p in
+             enumerate((1.0, 2.0, 10.0))]
+    dev, _ = run_app(mgr, DEV + body, sends)
+    host, _ = run_app(mgr, SEQ + body, sends)
+    assert dev == host == [(2.0, 10.0)]
+
+
+def test_snapshot_restore_device(mgr):
+    app = DEV + BODY_EVERY
+    rt = mgr.create_app_runtime(app)
+    h = rt.input_handler("S")
+    rt.start()
+    h.send(("A", 101.0), timestamp=1000)
+    rt.flush()
+    snap = rt.snapshot()
+
+    rt2 = mgr.create_app_runtime(app)
+    out = []
+    rt2.add_callback("M", lambda evs: out.extend(e.data for e in evs))
+    rt2.restore(snap)
+    rt2.input_handler("S").send(("A", 102.0), timestamp=1001)
+    rt2.flush()
+    assert out == [(101.0, 102.0)]
+
+
+def test_differential_random(mgr):
+    """Fuzz: random event tapes through device and host matchers."""
+    rng = np.random.default_rng(7)
+    bodies = [
+        ("pattern", DEV + BODY_EVERY, SEQ + BODY_EVERY),
+        ("sequence",
+         DEV + """
+         define stream S (sym string, p double);
+         @info(name='q') from every e1=S[p > 100], e2=S[p > e1.p]
+         select e1.p as p1, e2.p as p2 insert into M;
+         """,
+         SEQ + """
+         define stream S (sym string, p double);
+         @info(name='q') from every e1=S[p > 100], e2=S[p > e1.p]
+         select e1.p as p1, e2.p as p2 insert into M;
+         """),
+        ("within",
+         DEV + """
+         define stream S (sym string, p double);
+         @info(name='q') from every e1=S[p > 100] -> e2=S[p > e1.p] within 50 milliseconds
+         select e1.p as p1, e2.p as p2 insert into M;
+         """,
+         SEQ + """
+         define stream S (sym string, p double);
+         @info(name='q') from every e1=S[p > 100] -> e2=S[p > e1.p] within 50 milliseconds
+         select e1.p as p1, e2.p as p2 insert into M;
+         """),
+    ]
+    for name, dev_app, seq_app in bodies:
+        for trial in range(3):
+            n = 40
+            ps = rng.uniform(90, 110, size=n).round(1)
+            ts = 1000 + np.cumsum(rng.integers(1, 30, size=n))
+            sends = [("S", ("A", float(p)), int(t)) for p, t in zip(ps, ts)]
+            dev, _ = run_app(mgr, dev_app, sends)
+            host, _ = run_app(mgr, seq_app, sends)
+            assert dev == host, f"{name} trial {trial}: {dev} != {host}"
