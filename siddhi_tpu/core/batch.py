@@ -28,6 +28,10 @@ class EventBatch:
     # ordering for patterns/sequences/joins (the reference gets this for free
     # from synchronous per-event dispatch)
     seqs: Optional[np.ndarray] = None
+    # validity: name -> (n,) bool where True marks a NULL value (outer-join
+    # misses, absent-pattern refs).  None when the batch has no nulls; device
+    # kernels see the neutral fill value, host decode restores real None.
+    nulls: Optional[dict] = None
 
     def column(self, name: str) -> np.ndarray:
         return self.columns[name]
@@ -35,11 +39,15 @@ class EventBatch:
     def rows(self, strings: Optional[StringTable] = None) -> list[tuple]:
         """Decode back to row tuples (strings decoded if table given)."""
         out = []
+        nulls = self.nulls or {}
         for i in range(self.n):
             row = []
             for a in self.schema.attributes:
+                a_nulls = nulls.get(a.name)
                 v = self.columns[a.name][i]
-                if a.type == AttrType.STRING and strings is not None:
+                if a_nulls is not None and a_nulls[i]:
+                    row.append(None)
+                elif a.type == AttrType.STRING and strings is not None:
                     row.append(strings.decode(int(v)))
                 elif a.type == AttrType.BOOL:
                     row.append(bool(v))
@@ -79,6 +87,7 @@ class BatchBuilder:
         self._ts: list[int] = []
         self._seqs: list[int] = []
         self._cols: dict[str, list] = {a.name: [] for a in schema.attributes}
+        self._nulls: dict[str, list] = {}   # name -> [row indices], lazily
 
     def __len__(self) -> int:
         return len(self._ts)
@@ -97,11 +106,14 @@ class BatchBuilder:
         self._ts.append(int(timestamp))
         self._seqs.append(seq if seq is not None else len(self._seqs))
         for a, v in zip(attrs, row):
+            if v is None:
+                # null value (outer-join miss, absent-pattern ref): typed
+                # columns carry a neutral fill; the null mask preserves
+                # true None through host decode (reference emits null)
+                self._nulls.setdefault(a.name, []).append(len(self._ts) - 1)
             if a.type == AttrType.STRING:
                 v = self.strings.encode(v)
             elif v is None:
-                # null capture (e.g. absent-pattern refs): typed columns carry
-                # a neutral value (nan for floats, 0 for ints, False for bool)
                 v = (float("nan") if a.type in (AttrType.FLOAT, AttrType.DOUBLE)
                      else False if a.type == AttrType.BOOL
                      else 0 if a.type in (AttrType.INT, AttrType.LONG)
@@ -113,6 +125,7 @@ class BatchBuilder:
         self._ts = []
         self._seqs = []
         self._cols = {a.name: [] for a in self.schema.attributes}
+        self._nulls = {}
         return b
 
     def freeze(self) -> EventBatch:
@@ -124,5 +137,12 @@ class BatchBuilder:
                 cols[a.name] = np.asarray(self._cols[a.name], dtype=object)
             else:
                 cols[a.name] = np.asarray(self._cols[a.name], dtype=dt)
+        nulls = None
+        if self._nulls:
+            nulls = {}
+            for name, idxs in self._nulls.items():
+                m = np.zeros(n, dtype=bool)
+                m[idxs] = True
+                nulls[name] = m
         return EventBatch(self.schema, np.asarray(self._ts, dtype=TIMESTAMP_DTYPE),
-                          cols, n, np.asarray(self._seqs, dtype=np.int64))
+                          cols, n, np.asarray(self._seqs, dtype=np.int64), nulls)

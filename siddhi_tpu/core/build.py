@@ -1,7 +1,9 @@
 """App builder: walks the AST's execution elements and instantiates plans.
 
 Analog of the reference's SiddhiAppParser.parse loop (reference:
-core:util/parser/SiddhiAppParser.java:225-254) + QueryParser dispatch.
+core:util/parser/SiddhiAppParser.java:225-254) + QueryParser dispatch +
+DefinitionParserHelper table/trigger instantiation
+(core:util/parser/helper/DefinitionParserHelper.java:160).
 Kept separate from runtime.py so the runtime facade stays small.
 """
 from __future__ import annotations
@@ -12,8 +14,32 @@ from .planner import (FilterProjectPlan, PlanError, output_target_of,
 
 
 def build_app(rt) -> None:
-    """Populate rt (SiddhiAppRuntime) with plans from rt.app."""
+    """Populate rt (SiddhiAppRuntime) with tables and plans from rt.app."""
+    from .table import InMemoryTable, TableError
+
     app = rt.app
+    for tid, td in app.table_definitions.items():
+        if tid in rt.schemas:
+            raise PlanError(f"{tid!r} defined as both stream and table")
+        try:
+            rt.tables[tid] = InMemoryTable(td, rt.strings)
+        except TableError as e:
+            raise PlanError(str(e)) from None
+
+    from ..interp.named_window import NamedWindowRuntime
+    from .schema import StreamSchema
+    for wid, wd in app.window_definitions.items():
+        if wid in rt.schemas or wid in rt.tables:
+            raise PlanError(f"{wid!r} defined as both window and stream/table")
+        nw = NamedWindowRuntime(rt, wd)
+        rt.named_windows[wid] = nw
+        rt.schemas[wid] = nw.schema
+        rt._register_plan(nw)
+
+    from .trigger import TriggerRuntime
+    for tid, td in app.trigger_definitions.items():
+        rt._register_plan(TriggerRuntime(rt, td))
+
     for i, elem in enumerate(app.execution_elements):
         if isinstance(elem, ast.Query):
             plan = plan_query(rt, elem, default_name=f"query_{i}")
@@ -24,54 +50,85 @@ def build_app(rt) -> None:
             raise PlanError(f"unknown execution element {type(elem).__name__}")
 
 
+def attach_table_writer(rt, plan, q: ast.Query, name: str):
+    """If the query's target is a table, build the matching write-side
+    callback (reference: OutputParser.java:117-220 chooses the
+    Insert/Update/Delete/UpdateOrInsert table callback)."""
+    from .table import TableError, make_table_writer
+
+    target = plan.output_target
+    if isinstance(q.output, (ast.UpdateTable, ast.DeleteFrom,
+                             ast.UpdateOrInsertTable)):
+        if target not in rt.tables:
+            raise PlanError(
+                f"query {name!r}: {type(q.output).__name__} target "
+                f"{target!r} is not a defined table")
+    if target is not None and target in rt.tables:
+        try:
+            plan.table_writer = make_table_writer(
+                q.output, rt.tables[target], plan.out_schema)
+        except TableError as e:
+            raise PlanError(f"query {name!r}: {e}") from None
+    return plan
+
+
 def plan_query(rt, q: ast.Query, default_name: str):
     name = q.name(default_name)
     target = output_target_of(q)
     inp = q.input
 
     if isinstance(inp, ast.SingleInputStream):
+        if inp.stream_id in rt.tables:
+            raise PlanError(
+                f"query {name!r}: cannot stream from table "
+                f"{inp.stream_id!r}; use a join or an on-demand (store) query")
         if inp.stream_id not in rt.schemas:
             raise PlanError(f"query {name!r}: unknown input stream {inp.stream_id!r}")
-        if isinstance(q.output, (ast.UpdateTable, ast.DeleteFrom,
-                                 ast.UpdateOrInsertTable)) \
-                and target not in rt.tables:
-            raise PlanError(f"query {name!r}: unknown table {target!r}")
         schema = rt.schemas[inp.stream_id]
         has_window = inp.window is not None
         has_agg = selector_has_aggregators(q.selector) or q.selector.group_by
+        # reading from a named window with expired/all output needs the
+        # host path's expired-stream subscription
+        nw_needs_host = (inp.stream_id in rt.named_windows
+                         and q.output.events_for != ast.OutputEventsFor.CURRENT)
         # TPU fast path: stateless filter/project with device-typed columns
-        if (not has_window and not has_agg and q.rate is None
+        if (not has_window and not has_agg and q.rate is None and not nw_needs_host
                 and isinstance(q.output, (ast.InsertInto, ast.ReturnAction))
                 and not any(isinstance(h, ast.StreamFunction) for h in inp.handlers)):
             try:
                 filters = [f.expr for f in inp.filters]
-                return FilterProjectPlan(
+                return attach_table_writer(rt, FilterProjectPlan(
                     name, schema, inp.alias, filters, q.selector, rt.strings,
                     target, q.selector.limit, q.selector.offset,
-                    events_for=q.output.events_for)
+                    events_for=q.output.events_for), q, name)
+            except PlanError:
+                raise
             except Exception:
                 pass   # host-only functions etc. -> sequential backend
         from ..interp.engine import InterpSingleQueryPlan
-        return InterpSingleQueryPlan(name, rt, q, inp, target)
+        return attach_table_writer(
+            rt, InterpSingleQueryPlan(name, rt, q, inp, target), q, name)
 
     if isinstance(inp, ast.JoinInputStream):
         if inp.per is not None or inp.within is not None:
             raise PlanError(f"query {name!r}: aggregation joins "
                             f"(within/per) not yet supported")
         from ..interp.joins import InterpJoinQueryPlan
-        return InterpJoinQueryPlan(name, rt, q, inp, target)
+        return attach_table_writer(
+            rt, InterpJoinQueryPlan(name, rt, q, inp, target), q, name)
 
     if isinstance(inp, ast.StateInputStream):
         mode = getattr(rt, "device_patterns", "auto")
         if mode == "always":
             from .pattern_plan import DevicePatternPlan
-            return DevicePatternPlan(name, rt, q, inp, target,
-                                     slots=rt.device_slots)
+            return attach_table_writer(rt, DevicePatternPlan(
+                name, rt, q, inp, target, slots=rt.device_slots), q, name)
         if mode == "auto":
             pass   # P=1 on a remote chip loses to the host matcher; the
                    # partition planner routes partitioned patterns here
         from ..interp.engine import InterpPatternQueryPlan
-        return InterpPatternQueryPlan(name, rt, q, inp, target)
+        return attach_table_writer(
+            rt, InterpPatternQueryPlan(name, rt, q, inp, target), q, name)
 
     raise PlanError(f"query {name!r}: input type {type(inp).__name__} not yet supported")
 

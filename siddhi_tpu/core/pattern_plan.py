@@ -31,7 +31,7 @@ from .schema import StreamSchema, TIMESTAMP_DTYPE, dtype_of
 class DevicePatternPlan(QueryPlan):
     """from [every] e1=A[...] -> e2=B[...] within T — batched device NFA."""
 
-    A_CAP = 512      # adaptive slot-growth ceiling
+    A_CAP = 512      # default adaptive slot-growth ceiling (@app:deviceSlotCap)
 
     def __init__(self, name: str, rt, q: ast.Query, state_input,
                  target: Optional[str], partitions: int = 1,
@@ -40,6 +40,9 @@ class DevicePatternPlan(QueryPlan):
 
         self.name = name
         self.rt = rt
+        cap = ast.find_annotation(rt.app.annotations, "app:deviceSlotCap")
+        if cap is not None:
+            self.A_CAP = int(cap.element())
         self.output_target = target
         self.events_for = getattr(q.output, "events_for",
                                   ast.OutputEventsFor.CURRENT)
@@ -272,6 +275,13 @@ class DevicePatternPlan(QueryPlan):
             if ofs > self._of_slots_seen and self.kernel.A < self.A_CAP:
                 self._grow_slots(min(2 * self.kernel.A, self.A_CAP))
                 continue
+            if ofs > self._of_slots_seen:
+                import warnings
+                warnings.warn(
+                    f"pattern {self.name!r}: pending-match slots hit the "
+                    f"deviceSlotCap ceiling ({self.A_CAP}); {ofs} partial "
+                    f"matches dropped so far (raise @app:deviceSlotCap)",
+                    RuntimeWarning, stacklevel=2)
             break
         self._m_hint = M           # avoid recompiling next flush
         self._of_slots_seen = ofs

@@ -114,6 +114,8 @@ class OutputBatch:
     target: Optional[str]          # stream id, or None for `return`
     batch: EventBatch
     is_expired: bool = False       # expired-events output (timestamp = expiry)
+    is_signal: bool = False        # zero-event control signal (window reset):
+                                   # must be dispatched despite n == 0
 
 
 class QueryPlan:
@@ -123,6 +125,7 @@ class QueryPlan:
     input_streams: tuple          # stream ids this plan subscribes to
     output_target: Optional[str]
     out_schema: Optional[StreamSchema]
+    table_writer = None           # set when output_target is a table
 
     def process(self, stream_id: str, batch: EventBatch) -> list:
         raise NotImplementedError

@@ -28,9 +28,15 @@ from .schema import StreamSchema, StringTable
 
 @dataclass
 class Event:
-    """Host-side decoded event (reference: core:event/Event.java)."""
+    """Host-side decoded event (reference: core:event/Event.java).
+
+    `uid` is an optional per-instance identity (0 = unassigned) used by
+    consumers that must pair CURRENT/EXPIRED emissions of the same event
+    instance (join retained-lists); windows preserve it when re-stamping
+    expired events."""
     timestamp: int
     data: tuple
+    uid: int = 0
 
     def __iter__(self):
         return iter(self.data)
@@ -90,6 +96,14 @@ class SiddhiAppRuntime:
         self._builders: dict = {}
         self._pending: list = []      # FIFO of (stream_id, EventBatch) awaiting dispatch
         self._seq = 0                 # global arrival order counter
+        self._store_cache: dict = {}  # store-query text -> StoreQueryExec
+        # ingest/timer mutual exclusion (the reference's ThreadBarrier +
+        # per-query locks collapse to one runtime lock: state is columnar
+        # and single-writer by design)
+        import threading
+        self._lock = threading.RLock()
+        self._sched_thread = None
+        self._sched_stop = None
 
         self._build()
 
@@ -122,6 +136,9 @@ class SiddhiAppRuntime:
     def input_handler(self, stream_id: str) -> InputHandler:
         if stream_id not in self.schemas:
             raise KeyError(f"unknown stream {stream_id!r}")
+        if stream_id in self.named_windows:
+            raise KeyError(f"{stream_id!r} is a named window; feed it with "
+                           f"a query (`insert into {stream_id}`)")
         return InputHandler(self, stream_id)
 
     # alias matching the reference name
@@ -144,9 +161,78 @@ class SiddhiAppRuntime:
         self._query_callbacks[query_name].append(fn)
 
     def start(self) -> None:
+        """Start the runtime: fire `at 'start'` triggers, anchor periodic/
+        cron triggers, and (in real-time mode) start the wall-clock
+        scheduler pump (reference: SiddhiAppRuntime.start:370 starts
+        sources + trigger schedulers; Scheduler.java:89 timer service)."""
+        from .trigger import TriggerRuntime
         self._started = True
+        now = self.now_ms()
+        with self._lock:
+            for p in self._plans:
+                if isinstance(p, TriggerRuntime):
+                    # playback apps anchor at the first virtual-clock value
+                    # instead (set_time), not at the wall clock
+                    if not p.anchored and not (self._playback
+                                               and self._clock_ms is None):
+                        p.anchor(now)
+                    for ob in p.fire_start(now):
+                        self._emit(p, ob)
+            self._drain()
+        if not self._playback:
+            self._start_scheduler()
+
+    def _start_scheduler(self) -> None:
+        """Wall-clock timer pump: fires due timers (time windows, rate
+        limits, triggers, absent patterns) without requiring set_time()."""
+        import threading
+        if self._sched_thread is not None:
+            return
+        self._sched_stop = threading.Event()
+
+        def pump():
+            while not self._sched_stop.wait(0.02):
+                with self._lock:
+                    if self._clock_ms is not None:
+                        continue            # virtual clock took over
+                    due = [w for p in self._plans
+                           for w in [p.next_wakeup()] if w is not None]
+                    now = int(time.time() * 1000)
+                    if due and min(due) <= now:
+                        self._fire_timers(now)
+                        self._clock_ms = None    # stay in wall-clock mode
+
+        self._sched_thread = threading.Thread(
+            target=pump, name="siddhi-scheduler", daemon=True)
+        self._sched_thread.start()
+
+    # -- on-demand (store) queries (reference: SiddhiAppRuntime.query:272) ---
+
+    def query(self, text: str) -> list:
+        """Execute an on-demand query against tables / named windows /
+        aggregations; returns [(timestamp_ms, row_tuple)].  Compiled form
+        is cached per query text (reference LRU-caches similarly)."""
+        from ..query.parser import parse_store_query
+        from .store import StoreQueryExec
+        with self._lock:
+            exec_ = self._store_cache.get(text)
+            if exec_ is None:
+                if len(self._store_cache) >= 64:   # bounded like the
+                    # reference's LRU (SiddhiAppRuntime.java:286)
+                    self._store_cache.pop(next(iter(self._store_cache)))
+                exec_ = StoreQueryExec(self, parse_store_query(text))
+                self._store_cache[text] = exec_
+            else:
+                self._store_cache[text] = self._store_cache.pop(text)  # LRU touch
+            self.flush()
+            return exec_.execute()
 
     def shutdown(self) -> None:
+        if self._sched_stop is not None:
+            self._sched_stop.set()
+            self._sched_thread.join(timeout=2)
+            self._sched_thread = None
+            self._sched_stop = None
         self.flush()
         self._started = False
 
@@ -161,10 +247,19 @@ class SiddhiAppRuntime:
         """Advance the virtual clock (playback/test mode), firing due timers
         in wakeup order so timer-driven emissions interleave deterministically
         (reference: core:util/Scheduler.java:89 notifyAt semantics)."""
-        self.flush()
-        self._fire_timers(ms)
-        self._clock_ms = ms
-        self._drain()
+        from .trigger import TriggerRuntime
+        with self._lock:
+            self.flush()
+            # entering virtual time (clock was wall) re-anchors all triggers
+            # at the new timeline — a wall-clock anchor from start() would
+            # otherwise put their next fire ~50 years out
+            for p in self._plans:
+                if isinstance(p, TriggerRuntime) and \
+                        (self._clock_ms is None or not p.anchored):
+                    p.anchor(self._clock_ms if self._clock_ms is not None else ms)
+            self._fire_timers(ms)
+            self._clock_ms = ms
+            self._drain()
 
     def _fire_timers(self, upto_ms: int) -> None:
         guard = 0
@@ -187,6 +282,10 @@ class SiddhiAppRuntime:
     # -- ingest --------------------------------------------------------------
 
     def send(self, stream_id: str, data, timestamp: Optional[int] = None) -> None:
+        with self._lock:
+            self._send_locked(stream_id, data, timestamp)
+
+    def _send_locked(self, stream_id: str, data, timestamp: Optional[int]) -> None:
         schema = self.schemas[stream_id]
         b = self._builders.get(stream_id)
         if b is None:
@@ -223,10 +322,11 @@ class SiddhiAppRuntime:
 
     def flush(self) -> None:
         """Drain all pending builders through the compiled plans."""
-        for sid, b in self._builders.items():
-            if len(b):
-                self._pending.append((sid, b.freeze_and_clear()))
-        self._drain()
+        with self._lock:
+            for sid, b in self._builders.items():
+                if len(b):
+                    self._pending.append((sid, b.freeze_and_clear()))
+            self._drain()
 
     def _drain(self) -> None:
         guard = 0
@@ -256,7 +356,7 @@ class SiddhiAppRuntime:
                     self._emit(plan, ob)
 
     def _emit(self, plan: QueryPlan, ob: OutputBatch) -> None:
-        if ob.batch.n == 0:
+        if ob.batch.n == 0 and not ob.is_signal:
             return
         cb_name = getattr(plan, "callback_name", plan.name)
         for cb in self._query_callbacks.get(cb_name, ()):
@@ -265,6 +365,19 @@ class SiddhiAppRuntime:
                 cb(int(ob.batch.timestamps[-1]), None, events)
             else:
                 cb(int(ob.batch.timestamps[-1]), events, None)
+        # table targets route through the plan's table writer (reference:
+        # OutputParser-chosen Insert/Update/Delete/UpdateOrInsert callbacks)
+        if plan.table_writer is not None:
+            plan.table_writer.apply(ob.batch)
+            return
+        # named-window targets feed the shared window, whose republished
+        # emissions recurse through _emit as plain stream batches
+        # (reference: InsertIntoWindowCallback -> Window.add)
+        nw = self.named_windows.get(ob.target)
+        if nw is not None and plan is not nw:
+            for ob2 in nw.insert(ob.batch):
+                self._emit(nw, ob2)
+            return
         # plans emit only what events_for selects; everything with a target is
         # inserted (expired events become current on entering the next stream,
         # reference: InsertIntoStreamCallback)
@@ -284,6 +397,10 @@ class SiddhiAppRuntime:
     # -- persistence (full snapshot; reference SiddhiAppRuntime.persist:595) --
 
     def snapshot(self) -> dict:
+        with self._lock:
+            return self._snapshot_locked()
+
+    def _snapshot_locked(self) -> dict:
         self.flush()
         return {
             "strings": self.strings.state(),

@@ -1,0 +1,142 @@
+"""On-demand (store) queries: `runtime.query("from T on price > 10 select …")`.
+
+Reference: core:util/parser/StoreQueryParser.java:548 builds
+Select/Find/Update/Delete/UpdateOrInsert StoreQueryRuntimes executed by
+core:query/StoreQueryRuntime.java:48; SiddhiAppRuntime.query LRU-caches
+compiled queries (SiddhiAppRuntime.java:280-316).
+
+Sources: tables (index-aware find), named windows (contents scan), and
+incremental aggregations (within/per bucket selection).  An optional
+trailing action applies the selected rows to a target table through the
+same writers the streaming path uses.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..query import ast
+from .batch import BatchBuilder
+from .planner import PlanError
+from .schema import StreamSchema
+
+
+class StoreQueryExec:
+    """One compiled store query, re-executable against live state."""
+
+    def __init__(self, rt, sq: ast.StoreQuery):
+        from ..interp.engine import InterpSelector
+        from ..interp.expr import PyExprContext, compile_py
+
+        self.rt = rt
+        self.sq = sq
+        sid = sq.input.stream_id
+        self.source_id = sid
+        self.table = rt.tables.get(sid)
+        self.named_window = rt.named_windows.get(sid)
+        self.aggregation = rt.aggregations.get(sid)
+        if (self.table is None and self.named_window is None
+                and self.aggregation is None):
+            raise PlanError(f"store query: {sid!r} is not a table, named "
+                            f"window, or aggregation")
+        if self.aggregation is not None:
+            # delegated entirely to the aggregation runtime (within/per)
+            self._agg_exec = self.aggregation.compile_store_query(sq)
+            self.out_schema = self._agg_exec.out_schema
+            self.writer = None
+            return
+        self._agg_exec = None
+
+        schema = (self.table.schema if self.table is not None
+                  else self.named_window.schema)
+        self.schema = schema
+        ctx = PyExprContext({sid: schema}, default_ref=sid, tables=rt.tables)
+        on = None
+        for f in sq.input.filters:
+            on = f.expr if on is None else ast.And(on, f.expr)
+        if self.table is not None:
+            from .table import compile_table_condition
+            # probe env is empty (no stream side) — conditions reference
+            # only table columns and constants
+            empty_ctx = PyExprContext({}, tables=rt.tables)
+            self.cond = compile_table_condition(on, self.table, (sid,),
+                                                empty_ctx)
+            self.filter = None
+        else:
+            self.cond = None
+            self.filter = compile_py(on, ctx)[0] if on is not None else None
+
+        self.sel = InterpSelector(sq.selector, ctx, schema, f"#store_{sid}")
+        self.out_schema = self.sel.out_schema
+        self.writer = self._make_writer(sq.action)
+
+    def _make_writer(self, action) -> Optional[object]:
+        if action is None or isinstance(action, ast.ReturnAction):
+            return None
+        from .table import TableError, make_table_writer
+        target = action.target
+        table = self.rt.tables.get(target)
+        if table is None:
+            raise PlanError(f"store query action target {target!r} is not a "
+                            f"defined table")
+        try:
+            return make_table_writer(action, table, self.out_schema)
+        except TableError as e:
+            raise PlanError(str(e)) from None
+
+    # -- execution -----------------------------------------------------------
+
+    def _source_envs(self) -> list:
+        """(timestamp, env) per matching source row."""
+        out = []
+        names = self.schema.names
+        sid = self.source_id
+        if self.table is not None:
+            t = self.table
+            for i in self.cond.find({}):
+                i = int(i)
+                row = t.row_tuple(i)
+                env = dict(zip(names, row))
+                for n, v in zip(names, row):
+                    env[f"{sid}.{n}"] = v
+                env["__timestamp__"] = int(t._ts[i])
+                out.append((int(t._ts[i]), env))
+            return out
+        for ev in self.named_window.contents():
+            env = dict(zip(names, ev.data))
+            for n, v in zip(names, ev.data):
+                env[f"{sid}.{n}"] = v
+            env["__timestamp__"] = ev.timestamp
+            if self.filter is None or self.filter(env):
+                out.append((ev.timestamp, env))
+        return out
+
+    def execute(self) -> list:
+        """Returns decoded output rows [(timestamp, tuple)], after applying
+        any trailing table action."""
+        if self._agg_exec is not None:
+            return self._agg_exec.execute()
+        sel = self.sel
+        aggregated = bool(sel.sites) or bool(sel.group_fns)
+        rows: list = []
+        last_per_group: dict = {}
+        for ts, env in self._source_envs():
+            key = (tuple(f(env) for f in sel.group_fns)
+                   if sel.group_fns else ())
+            row = sel.process("current", env)
+            if row is None:
+                continue
+            if aggregated:
+                last_per_group[key] = (ts, row)
+            else:
+                rows.append((ts, row))
+        if aggregated:
+            rows = list(last_per_group.values())
+            # one-shot execution: clear aggregate banks for the next call
+            sel._groups.clear()
+        rows = [(t, r) for t, r in sel.order_limit(rows)]
+        if self.writer is not None and rows:
+            bb = BatchBuilder(self.out_schema, self.rt.strings)
+            for t, r in rows:
+                bb.append(t, tuple(r))
+            self.writer.apply(bb.freeze())
+        return [(t, tuple(r)) for t, r in rows]

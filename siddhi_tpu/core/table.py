@@ -1,0 +1,813 @@
+"""In-memory tables: columnar storage + index-aware condition planner.
+
+The TPU framework's analog of the reference table tier (reference:
+core:table/InMemoryTable.java:225, core:table/holder/IndexEventHolder.java:59-120,
+core:util/parser/CollectionExpressionParser.java:843,
+core:util/collection/operator/IndexOperator.java).
+
+Design differences from the reference, by design:
+  * storage is struct-of-arrays (one numpy array per attribute, capacity-
+    doubled, tombstoned `valid` mask) instead of pooled row events in a
+    HashMap — scans are vectorized numpy compares over whole columns;
+  * the "compiled condition" splits into (a) primary-key O(1) dict seek,
+    (b) secondary-index equality seeks (dict value -> row-id set), and
+    (c) a vectorized residual mask evaluated only over candidate rows —
+    the same seek-vs-scan planning CollectionExpressionParser does with
+    executor objects, done here at compile time over columns;
+  * strings live as int32 dictionary codes (equality = int compare;
+    ordering decodes through the shared StringTable).
+
+Duplicate primary keys are dropped with a warning, matching
+IndexEventHolder.add (reference: IndexEventHolder.java:177-186).
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..query import ast
+from ..query.ast import AttrType, CompareOp
+from .schema import StreamSchema, StringTable, TIMESTAMP_DTYPE, dtype_of
+
+
+class TableError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# storage
+# ---------------------------------------------------------------------------
+
+class InMemoryTable:
+    """Columnar in-memory table with primary-key map + secondary indexes."""
+
+    def __init__(self, defn: ast.TableDefinition, strings: StringTable):
+        self.defn = defn
+        self.id = defn.id
+        self.schema = StreamSchema(defn.id, tuple(defn.attributes))
+        self.strings = strings
+        self.pk_attrs: tuple[str, ...] = tuple(defn.primary_keys())
+        self.index_attrs: tuple[str, ...] = tuple(
+            a for a in defn.indexes() if a not in self.pk_attrs)
+        for a in (*self.pk_attrs, *self.index_attrs):
+            if a not in self.schema.types:
+                raise TableError(f"table {self.id!r}: indexed attribute {a!r} "
+                                 f"not in schema {self.schema.names}")
+        self._cap = 64
+        self._cols: dict[str, np.ndarray] = {
+            a.name: np.zeros(self._cap, dtype=dtype_of(a.type))
+            for a in defn.attributes}
+        self._nulls: dict[str, np.ndarray] = {
+            a.name: np.zeros(self._cap, dtype=bool) for a in defn.attributes}
+        self._ts = np.zeros(self._cap, dtype=TIMESTAMP_DTYPE)
+        self._valid = np.zeros(self._cap, dtype=bool)
+        self._n = 0                  # high-water mark (includes tombstones)
+        self._live = 0               # live row count
+        self._pk: dict = {}          # pk value tuple/scalar -> row idx
+        self._index: dict[str, dict] = {a: {} for a in self.index_attrs}
+
+    # -- geometry ------------------------------------------------------------
+
+    def __len__(self) -> int:
+        return self._live
+
+    def live_idx(self) -> np.ndarray:
+        return np.flatnonzero(self._valid[:self._n])
+
+    def _ensure(self, extra: int) -> None:
+        need = self._n + extra
+        if need <= self._cap:
+            return
+        while self._cap < need:
+            self._cap *= 2
+        for d in (self._cols, self._nulls):
+            for k, v in d.items():
+                g = np.zeros(self._cap, dtype=v.dtype)
+                g[:self._n] = v[:self._n]
+                d[k] = g
+        for nm in ("_ts", "_valid"):
+            v = getattr(self, nm)
+            g = np.zeros(self._cap, dtype=v.dtype)
+            g[:self._n] = v[:self._n]
+            setattr(self, nm, g)
+
+    def _maybe_compact(self) -> None:
+        if self._n > 256 and self._live < self._n // 2:
+            keep = self.live_idx()
+            m = len(keep)
+            for d in (self._cols, self._nulls):
+                for k in d:
+                    d[k][:m] = d[k][keep]
+            self._ts[:m] = self._ts[keep]
+            self._valid[:m] = True
+            self._valid[m:self._n] = False
+            self._n = m
+            self._rebuild_indexes()
+
+    def _rebuild_indexes(self) -> None:
+        self._pk = {}
+        self._index = {a: {} for a in self.index_attrs}
+        for i in self.live_idx():
+            i = int(i)
+            if self.pk_attrs:
+                self._pk[self._pk_key(i)] = i
+            for a in self.index_attrs:
+                self._index[a].setdefault(self._key_val(a, i), set()).add(i)
+
+    # -- keys ----------------------------------------------------------------
+
+    def _key_val(self, attr: str, row: int):
+        if self._nulls[attr][row]:
+            return None
+        return self._cols[attr][row].item()
+
+    def _pk_key(self, row: int):
+        if len(self.pk_attrs) == 1:
+            return self._key_val(self.pk_attrs[0], row)
+        return tuple(self._key_val(a, row) for a in self.pk_attrs)
+
+    # -- mutation ------------------------------------------------------------
+
+    def insert_batch(self, batch) -> None:
+        """Append an EventBatch (same positional types as the table schema).
+        Column names may differ; mapping is positional like the reference's
+        stream->table event conversion."""
+        if batch.n == 0:
+            return
+        self._ensure(batch.n)
+        s = self._n
+        src_attrs = batch.schema.attributes
+        bn = batch.nulls or {}
+        for src, dst in zip(src_attrs, self.defn.attributes):
+            self._cols[dst.name][s:s + batch.n] = batch.columns[src.name]
+            m = bn.get(src.name)
+            self._nulls[dst.name][s:s + batch.n] = m if m is not None else False
+        self._ts[s:s + batch.n] = batch.timestamps
+        self._n += batch.n
+        for i in range(s, s + batch.n):
+            self._add_row_to_indexes(i)
+
+    def _add_row_to_indexes(self, i: int) -> None:
+        if self.pk_attrs:
+            key = self._pk_key(i)
+            if key in self._pk:
+                warnings.warn(
+                    f"table {self.id!r}: dropping row with duplicate primary "
+                    f"key {key!r} (reference: IndexEventHolder.add)",
+                    RuntimeWarning, stacklevel=2)
+                self._valid[i] = False
+                return
+            self._pk[key] = i
+        self._valid[i] = True
+        self._live += 1
+        for a in self.index_attrs:
+            self._index[a].setdefault(self._key_val(a, i), set()).add(i)
+
+    def _remove_row_from_indexes(self, i: int) -> None:
+        if self.pk_attrs:
+            self._pk.pop(self._pk_key(i), None)
+        for a in self.index_attrs:
+            s = self._index[a].get(self._key_val(a, i))
+            if s is not None:
+                s.discard(i)
+
+    def delete_rows(self, idx) -> int:
+        cnt = 0
+        for i in np.atleast_1d(np.asarray(idx, dtype=np.int64)):
+            i = int(i)
+            if self._valid[i]:
+                self._remove_row_from_indexes(i)
+                self._valid[i] = False
+                self._live -= 1
+                cnt += 1
+        self._maybe_compact()
+        return cnt
+
+    def set_row_value(self, row: int, attr: str, value) -> None:
+        """Write one attribute of a live row, maintaining indexes."""
+        t = self.schema.type_of(attr)
+        reindex = attr in self.pk_attrs or attr in self.index_attrs
+        if reindex:
+            self._remove_row_from_indexes(row)
+        if value is None:
+            self._nulls[attr][row] = True
+            self._cols[attr][row] = 0
+        else:
+            self._nulls[attr][row] = False
+            if t == AttrType.STRING:
+                value = self.strings.encode(value)
+            self._cols[attr][row] = value
+        if reindex:
+            if self.pk_attrs:
+                key = self._pk_key(row)
+                other = self._pk.get(key)
+                if other is not None and other != row:
+                    warnings.warn(
+                        f"table {self.id!r}: update collides with existing "
+                        f"primary key {key!r}; dropping updated row",
+                        RuntimeWarning, stacklevel=2)
+                    self._valid[row] = False
+                    self._live -= 1
+                    for a in self.index_attrs:
+                        self._index[a].setdefault(
+                            self._key_val(a, row), set()).discard(row)
+                    return
+                self._pk[key] = row
+            for a in self.index_attrs:
+                self._index[a].setdefault(self._key_val(a, row), set()).add(row)
+
+    # -- reads ---------------------------------------------------------------
+
+    def row_env(self, row: int, refs: tuple[str, ...] = ()) -> dict:
+        """Decode one live row into a host-interp env fragment."""
+        env = {}
+        for a in self.defn.attributes:
+            if self._nulls[a.name][row]:
+                v = None
+            else:
+                v = self._cols[a.name][row].item()
+                if a.type == AttrType.STRING:
+                    v = self.strings.decode(int(v))
+            for r in refs:
+                env[f"{r}.{a.name}"] = v
+        return env
+
+    def row_tuple(self, row: int) -> tuple:
+        out = []
+        for a in self.defn.attributes:
+            if self._nulls[a.name][row]:
+                out.append(None)
+                continue
+            v = self._cols[a.name][row].item()
+            if a.type == AttrType.STRING:
+                v = self.strings.decode(int(v))
+            elif a.type == AttrType.BOOL:
+                v = bool(v)
+            out.append(v)
+        return tuple(out)
+
+    def all_rows(self) -> list[tuple]:
+        return [self.row_tuple(int(i)) for i in self.live_idx()]
+
+    # -- snapshot (reference: InMemoryTable implements Snapshotable) ---------
+
+    def state_dict(self) -> dict:
+        keep = self.live_idx()
+        return {
+            "cols": {k: v[keep] for k, v in self._cols.items()},
+            "nulls": {k: v[keep] for k, v in self._nulls.items()},
+            "ts": self._ts[keep],
+        }
+
+    def load_state_dict(self, st: dict) -> None:
+        n = len(st["ts"])
+        self._cap = max(64, int(2 ** np.ceil(np.log2(max(n, 1) + 1))))
+        self._cols = {k: np.zeros(self._cap, dtype=v.dtype)
+                      for k, v in st["cols"].items()}
+        self._nulls = {k: np.zeros(self._cap, dtype=bool) for k in st["nulls"]}
+        self._ts = np.zeros(self._cap, dtype=TIMESTAMP_DTYPE)
+        self._valid = np.zeros(self._cap, dtype=bool)
+        for k, v in st["cols"].items():
+            self._cols[k][:n] = v
+        for k, v in st["nulls"].items():
+            self._nulls[k][:n] = v
+        self._ts[:n] = st["ts"]
+        self._valid[:n] = True
+        self._n = n
+        self._live = n
+        self._rebuild_indexes()
+
+
+# ---------------------------------------------------------------------------
+# condition planner (reference: CollectionExpressionParser.java:843)
+# ---------------------------------------------------------------------------
+
+class CompiledTableCondition:
+    """Index-aware compiled lookup: `candidates()` narrows via PK/secondary
+    index seeks, the vectorized residual mask filters the rest."""
+
+    def __init__(self, table: InMemoryTable,
+                 pk_fns: Optional[list],          # value_fn per pk attr, or None
+                 index_seeks: list,               # [(attr, value_fn)]
+                 residual: Optional[Callable],    # fn(idx, env) -> bool mask
+                 always_false: bool = False):
+        self.table = table
+        self.pk_fns = pk_fns
+        self.index_seeks = index_seeks
+        self.residual = residual
+        self.always_false = always_false
+
+    @property
+    def uses_index(self) -> bool:
+        return self.pk_fns is not None or bool(self.index_seeks)
+
+    def find(self, env: dict) -> np.ndarray:
+        """Matching live row indices for one probe env (table order)."""
+        t = self.table
+        if self.always_false or t._live == 0:
+            return np.empty(0, dtype=np.int64)
+        if self.pk_fns is not None:
+            vals = [f(env) for f in self.pk_fns]
+            # null probe matches nothing (null == null is false), matching
+            # the residual-scan path's semantics
+            if any(v is None for v in vals):
+                return np.empty(0, dtype=np.int64)
+            key = vals[0] if len(vals) == 1 else tuple(vals)
+            row = t._pk.get(_normalize_key(key))
+            idx = (np.empty(0, dtype=np.int64) if row is None
+                   else np.asarray([row], dtype=np.int64))
+        elif self.index_seeks:
+            sets = []
+            for attr, f in self.index_seeks:
+                v = f(env)
+                if v is None:
+                    return np.empty(0, dtype=np.int64)
+                s = t._index[attr].get(_normalize_key(v))
+                if not s:
+                    return np.empty(0, dtype=np.int64)
+                sets.append(s)
+            sets.sort(key=len)
+            hit = set(sets[0])
+            for s in sets[1:]:
+                hit &= s
+            idx = np.sort(np.fromiter(hit, dtype=np.int64, count=len(hit)))
+        else:
+            idx = t.live_idx()
+        if len(idx) and self.residual is not None:
+            m = self.residual(idx, env)
+            idx = idx[np.asarray(m, dtype=bool)]
+        return idx
+
+    def contains(self, env: dict) -> bool:
+        return len(self.find(env)) > 0
+
+
+def _normalize_key(k):
+    # numpy scalars -> python scalars so dict probes match stored keys
+    if isinstance(k, tuple):
+        return tuple(_normalize_key(x) for x in k)
+    if isinstance(k, np.generic):
+        return k.item()
+    if isinstance(k, bool):
+        return k
+    return k
+
+
+def compile_table_condition(expr: Optional[ast.Expression],
+                            table: InMemoryTable,
+                            table_refs: tuple[str, ...],
+                            stream_ctx) -> CompiledTableCondition:
+    """Split `on` condition into PK seek / index seeks / vectorized residual.
+
+    table_refs: names that resolve to the table (its id plus any alias).
+    stream_ctx: PyExprContext for the probing side (compile_py-compatible);
+    unqualified attributes resolve stream-first, then table (reference
+    resolution order for table match conditions).
+    """
+    from ..interp.expr import compile_py
+
+    if expr is None or isinstance(expr, ast.Constant) and expr.value is True:
+        return CompiledTableCondition(table, None, [], None)
+
+    refs = set(table_refs) | {table.id}
+    conjuncts = _flatten_and(expr)
+
+    def is_table_var(e) -> Optional[str]:
+        if not isinstance(e, ast.Variable):
+            return None
+        if e.stream_ref is not None:
+            return e.attribute if e.stream_ref in refs else None
+        # unqualified: stream side wins if it resolves there
+        try:
+            stream_ctx.resolve(e)
+            return None
+        except Exception:
+            pass
+        return e.attribute if e.attribute in table.schema.types else None
+
+    def is_stream_only(e) -> bool:
+        if isinstance(e, ast.Variable):
+            return is_table_var(e) is None
+        if isinstance(e, (ast.Math, ast.Compare, ast.And, ast.Or)):
+            return is_stream_only(e.left) and is_stream_only(e.right)
+        if isinstance(e, ast.Not):
+            return is_stream_only(e.expr)
+        if isinstance(e, ast.FunctionCall):
+            return all(is_stream_only(a) for a in e.args)
+        if isinstance(e, ast.IsNull):
+            return e.expr is not None and is_stream_only(e.expr)
+        if isinstance(e, (ast.Constant, ast.TimeConstant)):
+            return True
+        return False
+
+    eq_pairs: list[tuple[str, Callable]] = []      # (table attr, value_fn)
+    residual_conjs: list[ast.Expression] = []
+    for c in conjuncts:
+        placed = False
+        if isinstance(c, ast.Compare) and c.op == CompareOp.EQ:
+            for tv, sv in ((c.left, c.right), (c.right, c.left)):
+                attr = is_table_var(tv)
+                if attr is not None and is_stream_only(sv):
+                    f, ft = compile_py(sv, stream_ctx)
+                    at = table.schema.type_of(attr)
+                    eq_pairs.append((attr, _key_caster(f, ft, at, table.strings)))
+                    placed = True
+                    break
+        if not placed:
+            residual_conjs.append(c)
+
+    # PK seek only when every PK attribute is pinned by an equality
+    pk_fns = None
+    if table.pk_attrs:
+        by_attr = {a: f for a, f in eq_pairs}
+        if all(a in by_attr for a in table.pk_attrs):
+            pk_fns = [by_attr[a] for a in table.pk_attrs]
+            used = set(table.pk_attrs)
+            leftovers = [(a, f) for a, f in eq_pairs if a not in used]
+        else:
+            leftovers = eq_pairs
+    else:
+        leftovers = eq_pairs
+
+    index_seeks, residual_eqs = [], []
+    if pk_fns is None:
+        for a, f in leftovers:
+            if a in table.index_attrs:
+                index_seeks.append((a, f))
+            else:
+                residual_eqs.append((a, f))
+    # non-indexed equalities fold into the vectorized residual
+    residual = _compile_residual(residual_conjs, residual_eqs, table,
+                                 refs, stream_ctx)
+    return CompiledTableCondition(table, pk_fns, index_seeks, residual)
+
+
+def _key_caster(f, ft: AttrType, at: AttrType, strings: StringTable):
+    """Cast probe values to the table column's stored representation."""
+    if at == AttrType.STRING:
+        to_code = strings._to_code
+        return lambda env: to_code.get(f(env), -1)
+    if at in (AttrType.INT, AttrType.LONG):
+        return lambda env: (None if (v := f(env)) is None else int(v))
+    if at in (AttrType.FLOAT, AttrType.DOUBLE):
+        if at == AttrType.FLOAT:
+            return lambda env: (None if (v := f(env)) is None
+                                else float(np.float32(v)))
+        return lambda env: (None if (v := f(env)) is None else float(v))
+    if at == AttrType.BOOL:
+        return lambda env: (None if (v := f(env)) is None else bool(v))
+    return f
+
+
+def _flatten_and(e: ast.Expression) -> list:
+    if isinstance(e, ast.And):
+        return _flatten_and(e.left) + _flatten_and(e.right)
+    return [e]
+
+
+# -- vectorized residual -----------------------------------------------------
+
+def _compile_residual(conjuncts: list, eq_pairs: list, table: InMemoryTable,
+                      refs: set, stream_ctx) -> Optional[Callable]:
+    fns = []
+    for attr, vf in eq_pairs:
+        fns.append(_eq_mask(table, attr, vf))
+    for c in conjuncts:
+        try:
+            fns.append(_vec(c, table, refs, stream_ctx)[0])
+        except _NotVectorizable:
+            fns.append(_row_fallback(c, table, refs, stream_ctx))
+    if not fns:
+        return None
+
+    def residual(idx, env):
+        m = np.ones(len(idx), dtype=bool)
+        for f in fns:
+            vals, nulls = f(idx, env)
+            v = np.asarray(vals, dtype=bool) if not np.isscalar(vals) \
+                else np.full(len(idx), bool(vals))
+            if nulls is not None:
+                v = v & ~np.asarray(nulls, dtype=bool)
+            m &= v
+            if not m.any():
+                break
+        return m
+    return residual
+
+
+def _eq_mask(table: InMemoryTable, attr: str, value_fn):
+    def f(idx, env):
+        v = value_fn(env)
+        if v is None:
+            return np.zeros(len(idx), dtype=bool), None
+        col = table._cols[attr][idx]
+        return (col == v) & ~table._nulls[attr][idx], None
+    return f
+
+
+class _NotVectorizable(Exception):
+    pass
+
+
+def _vec(e: ast.Expression, table: InMemoryTable, refs: set, stream_ctx):
+    """Compile expr -> fn(idx, env) -> (values, null_mask|None); table
+    variables become column slices, stream-only parts scalar closures."""
+    from ..interp.expr import compile_py
+    from .expr import promote
+
+    if isinstance(e, ast.Constant):
+        v, t = e.value, e.type
+        if t == AttrType.STRING:
+            code = table.strings.encode(v)
+            return (lambda idx, env: (code, None)), t, True
+        return (lambda idx, env: (v, None)), t, False
+    if isinstance(e, ast.TimeConstant):
+        return (lambda idx, env: (e.millis, None)), AttrType.LONG, False
+
+    if isinstance(e, ast.Variable):
+        if e.stream_ref in refs or (e.stream_ref is None
+                                    and not _resolves_in_stream(e, stream_ctx)
+                                    and e.attribute in table.schema.types):
+            attr = e.attribute
+            if attr not in table.schema.types:
+                raise _NotVectorizable(attr)
+            t = table.schema.type_of(attr)
+            def f(idx, env, attr=attr):
+                nm = table._nulls[attr][idx]
+                return table._cols[attr][idx], (nm if nm.any() else None)
+            return f, t, True
+        # stream side: scalar
+        sf, st_ = compile_py(e, stream_ctx)
+        if st_ == AttrType.STRING:
+            to_code = table.strings._to_code
+            def f(idx, env):
+                v = sf(env)
+                return (to_code.get(v, -1), None) if v is not None else (0, True)
+            return f, st_, True    # code-typed
+        def f(idx, env):
+            v = sf(env)
+            return (v, None) if v is not None else (0, True)
+        return f, st_, False
+
+    if isinstance(e, ast.Compare):
+        lf, lt, _ = _vec(e.left, table, refs, stream_ctx)
+        rf, rt, _ = _vec(e.right, table, refs, stream_ctx)
+        op = e.op
+        if AttrType.STRING in (lt, rt) and op not in (CompareOp.EQ, CompareOp.NEQ):
+            raise _NotVectorizable("string ordering")   # row fallback decodes
+        npop = {CompareOp.LT: np.less, CompareOp.LE: np.less_equal,
+                CompareOp.GT: np.greater, CompareOp.GE: np.greater_equal,
+                CompareOp.EQ: np.equal, CompareOp.NEQ: np.not_equal}[op]
+        def f(idx, env):
+            lv, ln = lf(idx, env)
+            rv, rn = rf(idx, env)
+            vals = npop(lv, rv)
+            return vals, _merge_nulls(ln, rn)
+        return f, AttrType.BOOL, False
+
+    if isinstance(e, ast.And) or isinstance(e, ast.Or):
+        lf, _, _ = _vec(e.left, table, refs, stream_ctx)
+        rf, _, _ = _vec(e.right, table, refs, stream_ctx)
+        npop = np.logical_and if isinstance(e, ast.And) else np.logical_or
+        def f(idx, env):
+            lv, ln = lf(idx, env)
+            rv, rn = rf(idx, env)
+            lv = _false_nulls(lv, ln)
+            rv = _false_nulls(rv, rn)
+            return npop(lv, rv), None
+        return f, AttrType.BOOL, False
+
+    if isinstance(e, ast.Not):
+        xf, _, _ = _vec(e.expr, table, refs, stream_ctx)
+        def f(idx, env):
+            v, nmask = xf(idx, env)
+            return np.logical_not(_false_nulls(v, nmask)), None
+        return f, AttrType.BOOL, False
+
+    if isinstance(e, ast.Math):
+        lf, lt, _ = _vec(e.left, table, refs, stream_ctx)
+        rf, rt, _ = _vec(e.right, table, refs, stream_ctx)
+        if AttrType.STRING in (lt, rt):
+            raise _NotVectorizable("string math")
+        t = promote(lt, rt)
+        fn = {ast.MathOp.ADD: np.add, ast.MathOp.SUB: np.subtract,
+              ast.MathOp.MUL: np.multiply, ast.MathOp.DIV: np.divide,
+              ast.MathOp.MOD: np.mod}[e.op]
+        int_div = e.op == ast.MathOp.DIV and t in (AttrType.INT, AttrType.LONG)
+        def f(idx, env):
+            lv, ln = lf(idx, env)
+            rv, rn = rf(idx, env)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                v = fn(lv, rv)
+                if int_div:
+                    v = np.trunc(np.true_divide(lv, rv)).astype(np.int64)
+            nmask = _merge_nulls(ln, rn)
+            zero = (np.asarray(rv) == 0) if e.op in (ast.MathOp.DIV, ast.MathOp.MOD) else None
+            return v, _merge_nulls(nmask, zero if zero is not None and np.any(zero) else None)
+        return f, t, False
+
+    if isinstance(e, ast.IsNull) and e.expr is not None \
+            and isinstance(e.expr, ast.Variable):
+        v = e.expr
+        attr = v.attribute
+        # same stream-first resolution as the Variable branch
+        if v.stream_ref in refs or (v.stream_ref is None
+                                    and not _resolves_in_stream(v, stream_ctx)
+                                    and attr in table.schema.types):
+            def f(idx, env, attr=attr):
+                return table._nulls[attr][idx], None
+            return f, AttrType.BOOL, False
+        sf, _ = compile_py(e, stream_ctx)      # stream-side null test
+        return (lambda idx, env: (bool(sf(env)), None)), AttrType.BOOL, False
+
+    raise _NotVectorizable(type(e).__name__)
+
+
+def _resolves_in_stream(var, stream_ctx) -> bool:
+    try:
+        stream_ctx.resolve(var)
+        return True
+    except Exception:
+        return False
+
+
+def _merge_nulls(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return np.logical_or(a, b)
+
+
+def _false_nulls(v, nulls):
+    v = np.asarray(v, dtype=bool)
+    if nulls is not None:
+        v = v & ~np.asarray(nulls, dtype=bool)
+    return v
+
+
+# ---------------------------------------------------------------------------
+# output-side table writers (reference: core:query/output/callback/
+# InsertIntoTableCallback / UpdateTableCallback / DeleteTableCallback /
+# UpdateOrInsertTableCallback, chosen by OutputParser.java:117-220)
+# ---------------------------------------------------------------------------
+
+class TableWriter:
+    """Applies a query's output batch to a table."""
+
+    def apply(self, batch) -> None:
+        raise NotImplementedError
+
+
+class TableInsertWriter(TableWriter):
+    def __init__(self, table: InMemoryTable, out_schema: StreamSchema):
+        ts, os_ = table.schema, out_schema
+        if len(ts.attributes) != len(os_.attributes) or any(
+                a.type != b.type for a, b in zip(os_.attributes, ts.attributes)):
+            raise TableError(
+                f"insert into table {table.id!r}: output schema "
+                f"{[(a.name, a.type.value) for a in os_.attributes]} does not "
+                f"match table schema "
+                f"{[(a.name, a.type.value) for a in ts.attributes]}")
+        self.table = table
+
+    def apply(self, batch) -> None:
+        self.table.insert_batch(batch)
+
+
+class _ConditionedWriter(TableWriter):
+    """Shared machinery: per output row, evaluate the compiled `on`
+    condition and act on matched table rows."""
+
+    def __init__(self, table: InMemoryTable, out_schema: StreamSchema,
+                 on: ast.Expression, set_clauses=(), strings=None):
+        from ..interp.expr import PyExprContext, compile_py
+
+        self.table = table
+        self.out_schema = out_schema
+        self.strings = strings or table.strings
+        # stream side of the condition = the query's output row, under a
+        # synthetic ref so the table id can't shadow it
+        self._out_ref = f"#out#{out_schema.id}"
+        sctx = PyExprContext({self._out_ref: out_schema},
+                             default_ref=self._out_ref)
+        self.cond = compile_table_condition(on, table, (table.id,), sctx)
+        # set clauses: value exprs may reference output attrs (unqualified)
+        # and table columns (qualified by table id)
+        vctx = PyExprContext({self._out_ref: out_schema,
+                              table.id: table.schema},
+                             default_ref=self._out_ref)
+        self.sets: list[tuple[str, Callable]] = []
+        for sc in set_clauses:
+            attr = sc.attribute.attribute
+            if attr not in table.schema.types:
+                raise TableError(f"set: table {table.id!r} has no "
+                                 f"attribute {attr!r}")
+            f, ft = compile_py(sc.value, vctx)
+            self.sets.append((attr, f))
+        if not set_clauses:
+            # bare `update T on ...`: overwrite attributes whose names match
+            # (reference: UpdateTableCallback with implicit full-row set)
+            self.sets = [
+                (a.name, (lambda env, _n=a.name: env.get(_n)))
+                for a in table.schema.attributes if a.name in out_schema.types]
+
+    def _row_envs(self, batch):
+        names = [a.name for a in self.out_schema.attributes]
+        rows = batch.rows(self.strings)
+        for ts, row in zip(batch.timestamps, rows):
+            env = dict(zip(names, row))
+            env["__timestamp__"] = int(ts)
+            yield env, row
+
+    def _update_rows(self, idx, env) -> None:
+        t = self.table
+        for i in idx:
+            i = int(i)
+            renv = dict(env)
+            renv.update(t.row_env(i, (t.id,)))
+            for attr, f in self.sets:
+                t.set_row_value(i, attr, f(renv))
+
+
+class TableUpdateWriter(_ConditionedWriter):
+    def apply(self, batch) -> None:
+        for env, _row in self._row_envs(batch):
+            idx = self.cond.find(env)
+            self._update_rows(idx, env)
+
+
+class TableDeleteWriter(_ConditionedWriter):
+    def apply(self, batch) -> None:
+        for env, _row in self._row_envs(batch):
+            self.table.delete_rows(self.cond.find(env))
+
+
+class TableUpdateOrInsertWriter(_ConditionedWriter):
+    """update or insert into T: update matches, insert the arriving row
+    when nothing matched (reference: UpdateOrInsertTableCallback)."""
+
+    def __init__(self, table, out_schema, on, set_clauses=(), strings=None):
+        super().__init__(table, out_schema, on, set_clauses, strings)
+        # the insert half needs a schema-compatible row
+        self._insertable = (
+            len(table.schema.attributes) == len(out_schema.attributes)
+            and all(a.type == b.type for a, b in
+                    zip(out_schema.attributes, table.schema.attributes)))
+
+    def apply(self, batch) -> None:
+        from .batch import BatchBuilder
+        for env, row in self._row_envs(batch):
+            idx = self.cond.find(env)
+            if len(idx):
+                self._update_rows(idx, env)
+            else:
+                if not self._insertable:
+                    raise TableError(
+                        f"update or insert into {self.table.id!r}: output "
+                        f"schema incompatible with table schema for insert")
+                bb = BatchBuilder(self.table.schema, self.strings)
+                bb.append(env["__timestamp__"], row)
+                self.table.insert_batch(bb.freeze())
+
+
+def make_table_writer(action: ast.OutputStreamAction, table: InMemoryTable,
+                      out_schema: StreamSchema) -> TableWriter:
+    if isinstance(action, ast.InsertInto):
+        return TableInsertWriter(table, out_schema)
+    if isinstance(action, ast.UpdateTable):
+        return TableUpdateWriter(table, out_schema, action.on,
+                                 action.set_clauses)
+    if isinstance(action, ast.DeleteFrom):
+        return TableDeleteWriter(table, out_schema, action.on)
+    if isinstance(action, ast.UpdateOrInsertTable):
+        return TableUpdateOrInsertWriter(table, out_schema, action.on,
+                                         action.set_clauses)
+    raise TableError(f"unsupported table action {type(action).__name__}")
+
+
+def _row_fallback(c: ast.Expression, table: InMemoryTable, refs: set,
+                  stream_ctx):
+    """Per-row evaluation through the host interpreter for expression forms
+    the vectorizer doesn't cover (functions, string ordering, ...)."""
+    from ..interp.expr import PyExprContext, compile_py
+
+    schemas = dict(getattr(stream_ctx, "schemas", {}))
+    for r in refs:
+        schemas[r] = table.schema
+    ctx = PyExprContext(schemas, getattr(stream_ctx, "extra", {}),
+                        getattr(stream_ctx, "default_ref", None))
+    ctx.tables = getattr(stream_ctx, "tables", {})
+    fn, _ = compile_py(c, ctx)
+    refs_t = tuple(refs)
+
+    def f(idx, env):
+        out = np.empty(len(idx), dtype=bool)
+        for j, i in enumerate(idx):
+            renv = dict(env)
+            renv.update(table.row_env(int(i), refs_t))
+            out[j] = bool(fn(renv))
+        return out, None
+    return f

@@ -96,7 +96,7 @@ class InterpSelector:
                 rewritten = extract_aggregators(oa.expr, self.sites, ctx)
                 site_extra = {s.key: (s.key, s.out_type) for s in self.sites}
                 ctx2 = PyExprContext(ctx.schemas, {**ctx.extra, **site_extra},
-                                     ctx.default_ref)
+                                     ctx.default_ref, tables=ctx.tables)
                 f, t = compile_py(rewritten, ctx2)
                 names.append(oa.name)
                 types.append(t)
@@ -107,10 +107,12 @@ class InterpSelector:
         if selector.having is not None:
             extra = {n: (n, t) for n, t in zip(names, types)}
             extra.update({s.key: (s.key, s.out_type) for s in self.sites})
-            hctx = PyExprContext(ctx.schemas, {**ctx.extra, **extra}, ctx.default_ref)
+            hctx = PyExprContext(ctx.schemas, {**ctx.extra, **extra},
+                                 ctx.default_ref, tables=ctx.tables)
             h_rewritten = extract_aggregators(selector.having, self.sites, hctx)
             extra.update({s.key: (s.key, s.out_type) for s in self.sites})
-            hctx = PyExprContext(ctx.schemas, {**ctx.extra, **extra}, ctx.default_ref)
+            hctx = PyExprContext(ctx.schemas, {**ctx.extra, **extra},
+                                 ctx.default_ref, tables=ctx.tables)
             self.having, _ = compile_py(h_rewritten, hctx)
         self.order_by = [(compile_py(ob.var, PyExprContext(
             ctx.schemas, {n: (n, t) for n, t in zip(names, types)},
@@ -165,16 +167,20 @@ class InterpSelector:
         return rows
 
     def state(self):
-        return {repr(k): [a.state() for a in bank]
-                for k, bank in self._groups.items()}
+        # group keys are tuples of scalars — serialize structurally (never
+        # repr/eval: snapshots must not be able to execute code on restore)
+        return [(k, [a.state() for a in bank])
+                for k, bank in self._groups.items()]
 
     def restore(self, st):
         self._groups.clear()
-        for k, states in st.items():
+        if isinstance(st, dict):     # legacy snapshot format: drop aggregates
+            st = []
+        for k, states in st:
             bank = self._new_bank()
             for a, s in zip(bank, states):
                 a.restore(s)
-            self._groups[eval(k)] = bank   # keys are repr of simple tuples
+            self._groups[tuple(k)] = bank
 
 
 # ---------------------------------------------------------------------------
@@ -421,15 +427,28 @@ class InterpSingleQueryPlan(QueryPlan):
 
     def __init__(self, name: str, rt, q: ast.Query, inp: ast.SingleInputStream,
                  target: Optional[str]):
+        from .named_window import expired_stream_of, reset_stream_of
         self.name = name
         self.rt = rt
         schema = rt.schemas[inp.stream_id]
         self.in_schema = schema
         self.input_streams = (inp.stream_id,)
+        # reading from a named window: also consume its expired/reset
+        # republications so aggregates track window contents (reference:
+        # the Window forwards current+expired chunks to reading queries)
+        self._nw_expired = self._nw_reset = None
+        if inp.stream_id in rt.named_windows:
+            if inp.window is not None:
+                raise PlanError(f"query {name!r}: cannot apply a window to "
+                                f"named window {inp.stream_id!r}")
+            self._nw_expired = expired_stream_of(inp.stream_id)
+            self._nw_reset = reset_stream_of(inp.stream_id)
+            self.input_streams = (inp.stream_id, self._nw_expired,
+                                  self._nw_reset)
         self.output_target = target
         self.events_for = getattr(q.output, "events_for", ast.OutputEventsFor.CURRENT)
         ctx = PyExprContext({inp.alias: schema, inp.stream_id: schema},
-                            default_ref=inp.alias)
+                            default_ref=inp.alias, tables=rt.tables)
         self.ctx = ctx
         self.filters = [compile_py(f.expr, ctx)[0] for f in inp.filters]
         for h in inp.handlers:
@@ -502,6 +521,10 @@ class InterpSingleQueryPlan(QueryPlan):
     # -- QueryPlan interface -------------------------------------------------
 
     def process(self, stream_id: str, batch: EventBatch) -> list:
+        if stream_id == self._nw_reset:
+            self.sel.process(RESET, {})
+            return []
+        kind = EXPIRED if stream_id == self._nw_expired else CURRENT
         rows = batch.rows(self.rt.strings)
         emitted: list = []
         for ts, row in zip(batch.timestamps, rows):
@@ -513,7 +536,7 @@ class InterpSingleQueryPlan(QueryPlan):
                 print(f"{self.name}: {ev.timestamp}, {ev.data}")
             now = self.rt.now_ms() if not self.rt._playback else ev.timestamp
             if self.window is None:
-                emitted.append((CURRENT, ev))
+                emitted.append((kind, ev))
             else:
                 emitted.extend(self.window.process(ev, now))
         if isinstance(self.window, W.BatchWindow):
@@ -595,7 +618,8 @@ class InterpPatternQueryPlan(QueryPlan):
         for n, elem_filters in zip(self.nodes, _collect_filters(state_input.state)):
             if elem_filters:
                 own = rt.schemas[n.stream_id]
-                ctx = PyExprContext({**schemas, n.ref: own}, default_ref=n.ref)
+                ctx = PyExprContext({**schemas, n.ref: own}, default_ref=n.ref,
+                                    tables=rt.tables)
                 fns = [compile_py(f.expr, ctx)[0] for f in elem_filters]
                 if len(fns) == 1:
                     n.filter_fn = fns[0]
@@ -617,7 +641,7 @@ class InterpPatternQueryPlan(QueryPlan):
             sel_ast = ast.Selector(False, tuple(attrs), sel_ast.group_by,
                                    sel_ast.having, sel_ast.order_by,
                                    sel_ast.limit, sel_ast.offset)
-        ctx = PyExprContext(schemas)
+        ctx = PyExprContext(schemas, tables=rt.tables)
         self.sel = InterpSelector(sel_ast, ctx, None, target or f"#{name}")
         self.out_schema = self.sel.out_schema
         self.rate = make_rate_limiter(q.rate)

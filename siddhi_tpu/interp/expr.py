@@ -32,11 +32,14 @@ class PyExprContext:
     contexts but string constants stay strings."""
 
     def __init__(self, schemas: dict, extra: Optional[dict] = None,
-                 default_ref: Optional[str] = None):
-        # schemas: ref -> StreamSchema; default_ref: unqualified attr home
+                 default_ref: Optional[str] = None,
+                 tables: Optional[dict] = None):
+        # schemas: ref -> StreamSchema; default_ref: unqualified attr home;
+        # tables: id -> InMemoryTable for `in Table` membership conditions
         self.schemas = schemas
         self.extra = extra or {}
         self.default_ref = default_ref
+        self.tables = tables or {}
 
     def resolve(self, var: ast.Variable) -> tuple[str, AttrType]:
         ref = var.stream_ref
@@ -59,6 +62,10 @@ class PyExprContext:
         s = self.schemas[ref]
         if var.index is not None:
             return f"{ref}[{var.index}].{var.attribute}", s.type_of(var.attribute)
+        if ref == self.default_ref:
+            # qualified self-reference (`S.x` in `from S[...]`): the single-
+            # stream env carries unqualified keys
+            return var.attribute, s.type_of(var.attribute)
         return f"{ref}.{var.attribute}", s.type_of(var.attribute)
 
 

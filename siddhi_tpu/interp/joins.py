@@ -36,9 +36,13 @@ class JoinSide:
         self.ref = inp.alias
         self.stream_id = inp.stream_id
         self.schema = rt.schemas[inp.stream_id]
+        # named-window side: probe the shared window's live contents (the
+        # find facade, reference: WindowWindowProcessor) instead of keeping
+        # a retained copy; its current-event republications still trigger
+        self.named_window = rt.named_windows.get(inp.stream_id)
         ctx = PyExprContext({inp.alias: self.schema,
                              inp.stream_id: self.schema},
-                            default_ref=inp.alias)
+                            default_ref=inp.alias, tables=rt.tables)
         self.filters = [compile_py(f.expr, ctx)[0] for f in inp.filters]
         for h in inp.handlers:
             if isinstance(h, ast.StreamFunction):
@@ -46,8 +50,19 @@ class JoinSide:
                                 "not supported")
         self.window: Optional[W.Window] = None
         if inp.window is not None:
+            if self.named_window is not None:
+                raise PlanError(f"join: cannot apply a window to named "
+                                f"window {inp.stream_id!r}")
             self.window = make_window(inp.window, ctx, self.schema)
         self.retained: list[Event] = []
+
+    def probe_events(self) -> list:
+        if self.named_window is not None:
+            evs = self.named_window.contents()
+            if self.filters:
+                return [e for e in evs if self.passes(self.env_of(e))]
+            return evs
+        return self.retained
 
     def passes(self, env: dict) -> bool:
         return all(f(env) for f in self.filters)
@@ -64,13 +79,22 @@ class JoinSide:
             if kind == CURRENT:
                 self.retained.append(ev)
             elif kind == EXPIRED:
-                # windows re-stamp expired events with their expiry time
-                # (reference current/expired protocol) — match on data,
-                # FIFO, which mirrors window expiry order
-                for i, r in enumerate(self.retained):
-                    if r.data == ev.data:
-                        del self.retained[i]
-                        break
+                # windows re-stamp expired events with their expiry time but
+                # preserve uid — remove the exact retained instance; data-FIFO
+                # fallback covers uid-less events (post-restore window state)
+                hit = None
+                if ev.uid:
+                    for i, r in enumerate(self.retained):
+                        if r.uid == ev.uid:
+                            hit = i
+                            break
+                if hit is None:
+                    for i, r in enumerate(self.retained):
+                        if r.data == ev.data:
+                            hit = i
+                            break
+                if hit is not None:
+                    del self.retained[hit]
             elif kind == RESET:
                 self.retained.clear()
 
@@ -93,36 +117,100 @@ class JoinSide:
     def restore(self, st: dict) -> None:
         if self.window is not None and st.get("window") is not None:
             self.window.restore(st["window"])
+        # uid intentionally dropped: restored window state emits uid-less
+        # expirations, so removal falls back to data matching either way
         self.retained = [Event(t, tuple(d)) for t, d in st["retained"]]
 
 
+class TableJoinSide:
+    """A table participating in a join (reference: TableWindowProcessor
+    adapter inside JoinInputStreamParser — the stream side probes the
+    table's compiled condition via `find`; the table never triggers)."""
+
+    is_table = True
+
+    def __init__(self, inp: ast.SingleInputStream, rt, table):
+        if inp.window is not None or inp.filters or inp.handlers:
+            raise PlanError(f"join: table {inp.stream_id!r} side cannot have "
+                            f"windows/filters")
+        self.ref = inp.alias
+        self.stream_id = inp.stream_id
+        self.table = table
+        self.schema = table.schema
+
+    def on_timer(self, now_ms: int) -> None:
+        pass
+
+    def next_wakeup(self):
+        return None
+
+    def state(self) -> dict:
+        return {}          # table contents snapshot with rt.tables
+
+    def restore(self, st: dict) -> None:
+        pass
+
+
 class InterpJoinQueryPlan(QueryPlan):
-    """`from A#win as a join B#win as b on a.x == b.y select ...`"""
+    """`from A#win as a join B#win as b on a.x == b.y select ...`
+    Either side may be a table (probed via its index-aware compiled
+    condition instead of a retained window list)."""
 
     def __init__(self, name: str, rt, q: ast.Query,
                  inp: ast.JoinInputStream, target: Optional[str]):
         from .engine import InterpSelector, make_rate_limiter
+        from ..core.table import compile_table_condition
         self.name = name
         self.rt = rt
         self.output_target = target
         self.events_for = getattr(q.output, "events_for",
                                   ast.OutputEventsFor.CURRENT)
-        self.left = JoinSide(inp.left, rt)
-        self.right = JoinSide(inp.right, rt)
+
+        def side_of(sinp):
+            if sinp.stream_id in rt.tables:
+                return TableJoinSide(sinp, rt, rt.tables[sinp.stream_id])
+            return JoinSide(sinp, rt)
+
+        self.left = side_of(inp.left)
+        self.right = side_of(inp.right)
         if self.left.ref == self.right.ref:
             raise PlanError(f"join {name!r}: both sides named "
                             f"{self.left.ref!r}; alias one with `as`")
+        left_t = isinstance(self.left, TableJoinSide)
+        right_t = isinstance(self.right, TableJoinSide)
+        if left_t and right_t:
+            raise PlanError(f"join {name!r}: cannot join two tables in a "
+                            f"streaming query; use a store query")
         self.join_type = inp.join_type
         self.trigger = inp.trigger       # "all" | "left" | "right"
+        # a table never triggers output (reference: table joins are
+        # implicitly unidirectional from the stream side)
+        if left_t:
+            self.trigger = "right"
+        elif right_t:
+            self.trigger = "left"
         schemas = {self.left.ref: self.left.schema,
                    self.right.ref: self.right.schema}
-        ctx = PyExprContext(schemas)
+        ctx = PyExprContext(schemas, tables=rt.tables)
         self.on = compile_py(inp.on, ctx)[0] if inp.on is not None else None
+        # index-aware probe plan for the table side (reference:
+        # CollectionExpressionParser compiled condition)
+        self.table_cond = None
+        if left_t or right_t:
+            tside = self.left if left_t else self.right
+            sside = self.right if left_t else self.left
+            sctx = PyExprContext({sside.ref: sside.schema,
+                                  sside.stream_id: sside.schema},
+                                 default_ref=sside.ref, tables=rt.tables)
+            self.table_cond = compile_table_condition(
+                inp.on, tside.table, (tside.ref, tside.stream_id), sctx)
         self.sel = InterpSelector(_join_selector(q.selector, self), ctx,
                                   None, target or f"#{name}")
         self.out_schema = self.sel.out_schema
         self.rate = make_rate_limiter(q.rate)
-        self.input_streams = tuple({self.left.stream_id, self.right.stream_id})
+        self.input_streams = tuple(
+            {s.stream_id for s in (self.left, self.right)
+             if not isinstance(s, TableJoinSide)})
         self._buffer: list = []          # (seq, stream_id, Event)
 
     # -- QueryPlan interface -------------------------------------------------
@@ -131,7 +219,9 @@ class InterpJoinQueryPlan(QueryPlan):
         rows = batch.rows(self.rt.strings)
         seqs = batch.seqs if batch.seqs is not None else range(batch.n)
         for seq, ts, row in zip(seqs, batch.timestamps, rows):
-            self._buffer.append((int(seq), stream_id, Event(int(ts), row)))
+            # global arrival seq doubles as instance uid (nonzero)
+            self._buffer.append((int(seq), stream_id,
+                                 Event(int(ts), row, uid=int(seq) + 1)))
         return []
 
     def finalize(self) -> list:
@@ -159,7 +249,7 @@ class InterpJoinQueryPlan(QueryPlan):
         out_rows = self._post(out_rows)
         return self._to_batches(out_rows)
 
-    def _probe(self, side: JoinSide, other: JoinSide, side_name: str,
+    def _probe(self, side: JoinSide, other, side_name: str,
                ev: Event) -> list:
         if self.trigger not in ("all", side_name):
             return []
@@ -168,7 +258,18 @@ class InterpJoinQueryPlan(QueryPlan):
                 for n, v in zip(side.schema.names, ev.data)}
         base["__timestamp__"] = ev.timestamp
         matched = False
-        for oev in other.retained:
+        if isinstance(other, TableJoinSide):
+            # index-aware seek: `on` is already folded into table_cond
+            idx = self.table_cond.find(side.env_of(ev))
+            for i in idx:
+                env = dict(base)
+                env.update(other.table.row_env(int(i), (other.ref,)))
+                matched = True
+                row = self.sel.process(CURRENT, env)
+                if row is not None:
+                    rows.append((CURRENT, ev.timestamp, row))
+            return rows + self._outer_miss(side, other, side_name, base, matched)
+        for oev in other.probe_events():
             env = dict(base)
             for n, v in zip(other.schema.names, oev.data):
                 env[f"{other.ref}.{n}"] = v
@@ -178,19 +279,26 @@ class InterpJoinQueryPlan(QueryPlan):
             row = self.sel.process(CURRENT, env)
             if row is not None:
                 rows.append((CURRENT, ev.timestamp, row))
+        return rows + self._outer_miss(side, other, side_name, base, matched)
+
+    def _outer_miss(self, side, other, side_name: str, base: dict,
+                    matched: bool) -> list:
+        """Outer-join miss: emit the arriving event with nulls for the
+        absent side (reference: JoinProcessor outer handling)."""
         outer = (self.join_type == ast.JoinType.FULL_OUTER
                  or (self.join_type == ast.JoinType.LEFT_OUTER
                      and side_name == "left")
                  or (self.join_type == ast.JoinType.RIGHT_OUTER
                      and side_name == "right"))
-        if not matched and outer:
-            env = dict(base)
-            for n in other.schema.names:
-                env[f"{other.ref}.{n}"] = None
-            row = self.sel.process(CURRENT, env)
-            if row is not None:
-                rows.append((CURRENT, ev.timestamp, row))
-        return rows
+        if matched or not outer:
+            return []
+        env = dict(base)
+        for n in other.schema.names:
+            env[f"{other.ref}.{n}"] = None
+        row = self.sel.process(CURRENT, env)
+        if row is None:
+            return []
+        return [(CURRENT, int(env["__timestamp__"]), row)]
 
     def _post(self, rows: list) -> list:
         if self.sel.order_by or self.sel.selector.limit is not None \

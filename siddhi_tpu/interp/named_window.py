@@ -1,0 +1,136 @@
+"""Named windows: `define window W (…) length(5) output all events`.
+
+A shared window instance living outside any single query (reference:
+core:window/Window.java:63-154).  Queries insert into it like a stream
+target; its emissions are republished so that any number of queries can
+consume them:
+
+    current events  -> stream  "W"
+    expired events  -> stream  "#W.expired"
+    reset signals   -> stream  "#W.reset"   (empty batch)
+
+Queries reading `from W` subscribe to all three (see engine.py) so their
+aggregates track window contents exactly; joins probe `contents()` — the
+find facade — instead (reference: WindowWindowProcessor adapter).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..query import ast
+from ..core.batch import BatchBuilder, EventBatch
+from ..core.planner import OutputBatch, PlanError, QueryPlan
+from ..core.runtime import Event
+from ..core.schema import StreamSchema
+from .expr import PyExprContext
+from . import windows as W
+
+CURRENT, EXPIRED, RESET = W.CURRENT, W.EXPIRED, W.RESET
+
+
+def expired_stream_of(wid: str) -> str:
+    return f"#{wid}.expired"
+
+
+def reset_stream_of(wid: str) -> str:
+    return f"#{wid}.reset"
+
+
+class NamedWindowRuntime(QueryPlan):
+    """Holds the shared window; registered in rt._plans for timer service
+    and snapshotting, but subscribes to nothing — writes arrive through
+    the runtime's insert routing (like table writers)."""
+
+    def __init__(self, rt, wd: ast.WindowDefinition):
+        from .engine import make_window
+        self.rt = rt
+        self.wid = wd.id
+        self.name = f"#window_{wd.id}"
+        self.schema = StreamSchema(wd.id, tuple(wd.attributes))
+        self.output_events = wd.output_events
+        ctx = PyExprContext({wd.id: self.schema}, default_ref=wd.id,
+                            tables=rt.tables)
+        self.window = make_window(wd.window, ctx, self.schema)
+        self.input_streams = ()
+        self.output_target = None
+        self.out_schema = self.schema
+        self._uid = 0
+
+    # -- write side ----------------------------------------------------------
+
+    def insert(self, batch: EventBatch) -> list:
+        """Run an inserted batch through the window; return the republished
+        emissions as OutputBatches (contiguous same-kind runs preserve the
+        reference's expired-before-displacing-current interleaving)."""
+        rows = batch.rows(self.rt.strings)
+        emissions: list = []
+        for ts, row in zip(batch.timestamps, rows):
+            self._uid += 1
+            ev = Event(int(ts), row, uid=self._uid)
+            now = ev.timestamp if self.rt._playback else self.rt.now_ms()
+            emissions.extend(self.window.process(ev, now))
+        if isinstance(self.window, W.BatchWindow):
+            emissions.extend(self.window.end_chunk(self.rt.now_ms()))
+        return self._republish(emissions)
+
+    def on_timer(self, now_ms: int) -> list:
+        return self._republish(self.window.on_timer(now_ms))
+
+    def next_wakeup(self) -> Optional[int]:
+        return self.window.next_wakeup()
+
+    def _republish(self, emissions: list) -> list:
+        want_cur = self.output_events in (ast.OutputEventsFor.CURRENT,
+                                          ast.OutputEventsFor.ALL)
+        want_exp = self.output_events in (ast.OutputEventsFor.EXPIRED,
+                                          ast.OutputEventsFor.ALL)
+        out: list = []
+        run_kind, bb = None, None
+
+        def flush_run():
+            nonlocal bb, run_kind
+            if run_kind is None:
+                return
+            if run_kind == RESET:
+                out.append(OutputBatch(reset_stream_of(self.wid),
+                                       EventBatch.empty(self.schema),
+                                       is_signal=True))
+            elif bb is not None and len(bb):
+                if run_kind == CURRENT:
+                    out.append(OutputBatch(self.wid, bb.freeze()))
+                else:
+                    out.append(OutputBatch(expired_stream_of(self.wid),
+                                           bb.freeze(), is_expired=True))
+            bb, run_kind = None, None
+
+        for kind, ev in emissions:
+            if kind == CURRENT and not want_cur:
+                continue
+            if kind == EXPIRED and not want_exp:
+                continue
+            if kind != run_kind:
+                flush_run()
+                run_kind = kind
+                if kind != RESET:
+                    bb = BatchBuilder(self.schema, self.rt.strings)
+            if kind != RESET:
+                bb.append(ev.timestamp, ev.data)
+        flush_run()
+        return out
+
+    # -- read side (find facade, reference: Window.find) ---------------------
+
+    def contents(self) -> list:
+        return self.window.contents()
+
+    # -- QueryPlan interface -------------------------------------------------
+
+    def process(self, stream_id: str, batch: EventBatch) -> list:
+        return []       # writes come via runtime insert routing
+
+    def state_dict(self) -> dict:
+        return {"window": self.window.state(), "uid": self._uid}
+
+    def load_state_dict(self, d: dict) -> None:
+        self.window.restore(d["window"])
+        self._uid = d.get("uid", 0)

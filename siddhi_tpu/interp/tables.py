@@ -1,7 +1,7 @@
-"""Host in-memory tables (reference: core:table/InMemoryTable.java:225 over
-EventHolders, core:table/holder/IndexEventHolder.java:59 primary-key map +
-secondary indexes).  Filled in by the tables milestone; `compile_in_table`
-lowers `expr in Table` membership tests."""
+"""`expr in Table` membership conditions (reference: the In expression is
+compiled into a table condition + containsEvent probe —
+core:util/parser/ExpressionParser.java:451-461,
+core:executor/condition/InConditionExpressionExecutor.java:58)."""
 from __future__ import annotations
 
 from ..core.expr import ExprError
@@ -12,6 +12,6 @@ def compile_in_table(expr, ctx):
     table = getattr(ctx, "tables", {}).get(expr.table_id)
     if table is None:
         raise ExprError(f"'in {expr.table_id}': unknown table")
-    from .expr import compile_py
-    f, t = compile_py(expr.expr, ctx)
-    return (lambda env: table.contains_value(f(env))), AttrType.BOOL
+    from ..core.table import compile_table_condition
+    cond = compile_table_condition(expr.expr, table, (table.id,), ctx)
+    return (lambda env: cond.contains(env)), AttrType.BOOL

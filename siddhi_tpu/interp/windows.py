@@ -59,10 +59,10 @@ class LengthWindow(Window):
         out = []
         if self.length == 0:
             # zero-length: event expires immediately
-            return [(CURRENT, ev), (EXPIRED, Event(now_ms, ev.data)), (RESET, ev)]
+            return [(CURRENT, ev), (EXPIRED, Event(now_ms, ev.data, ev.uid)), (RESET, ev)]
         if len(self.buf) >= self.length:
             old = self.buf.popleft()
-            out.append((EXPIRED, Event(now_ms, old.data)))
+            out.append((EXPIRED, Event(now_ms, old.data, old.uid)))
         out.append((CURRENT, ev))
         self.buf.append(ev)
         return out
@@ -92,7 +92,7 @@ class LengthBatchWindow(Window):
             return []
         out = []
         for old in self.prev:
-            out.append((EXPIRED, Event(now_ms, old.data)))
+            out.append((EXPIRED, Event(now_ms, old.data, old.uid)))
         if out:
             out.append((RESET, ev))
         for e in self.cur:
@@ -132,7 +132,7 @@ class TimeWindow(Window):
         out = []
         while self.buf and self.buf[0].timestamp + self.duration <= now_ms:
             old = self.buf.popleft()
-            out.append((EXPIRED, Event(old.timestamp + self.duration, old.data)))
+            out.append((EXPIRED, Event(old.timestamp + self.duration, old.data, old.uid)))
         return out
 
     def on_timer(self, now_ms):
@@ -176,7 +176,7 @@ class TimeBatchWindow(Window):
         while self.start is not None and now_ms >= self.start + self.duration:
             end = self.start + self.duration
             for old in self.prev:
-                out.append((EXPIRED, Event(end, old.data)))
+                out.append((EXPIRED, Event(end, old.data, old.uid)))
             if self.prev:
                 out.append((RESET, None))
             for e in self.cur:
@@ -225,7 +225,7 @@ class ExternalTimeWindow(Window):
         out = []
         while self.buf and self.get_ts(self.buf[0]) + self.duration <= t:
             old = self.buf.popleft()
-            out.append((EXPIRED, Event(self.get_ts(old) + self.duration, old.data)))
+            out.append((EXPIRED, Event(self.get_ts(old) + self.duration, old.data, old.uid)))
         out.append((CURRENT, ev))
         self.buf.append(ev)
         return out
@@ -261,7 +261,7 @@ class ExternalTimeBatchWindow(Window):
             end = self.start + self.duration
             if self.cur or self.prev:
                 for old in self.prev:
-                    out.append((EXPIRED, Event(end, old.data)))
+                    out.append((EXPIRED, Event(end, old.data, old.uid)))
                 if self.prev:
                     out.append((RESET, None))
                 for e in self.cur:
@@ -300,7 +300,7 @@ class TimeLengthWindow(Window):
         out = self._expire(now_ms)
         if len(self.buf) >= self.length:
             old = self.buf.popleft()
-            out.append((EXPIRED, Event(now_ms, old.data)))
+            out.append((EXPIRED, Event(now_ms, old.data, old.uid)))
         out.append((CURRENT, ev))
         self.buf.append(ev)
         return out
@@ -309,7 +309,7 @@ class TimeLengthWindow(Window):
         out = []
         while self.buf and self.buf[0].timestamp + self.duration <= now_ms:
             old = self.buf.popleft()
-            out.append((EXPIRED, Event(old.timestamp + self.duration, old.data)))
+            out.append((EXPIRED, Event(old.timestamp + self.duration, old.data, old.uid)))
         return out
 
     def on_timer(self, now_ms):
@@ -346,7 +346,7 @@ class BatchWindow(Window):
             return []
         out = []
         for old in self.prev:
-            out.append((EXPIRED, Event(now_ms, old.data)))
+            out.append((EXPIRED, Event(now_ms, old.data, old.uid)))
         if self.prev:
             out.append((RESET, None))
         for e in self._chunk:
@@ -392,7 +392,7 @@ class SessionWindow(Window):
         for k in list(self.sessions):
             if self.last_ts[k] + self.gap + self.latency <= now_ms:
                 for e in self.sessions[k]:
-                    out.append((EXPIRED, Event(now_ms, e.data)))
+                    out.append((EXPIRED, Event(now_ms, e.data, e.uid)))
                 out.append((RESET, None))
                 del self.sessions[k]
                 del self.last_ts[k]
@@ -442,7 +442,7 @@ class SortWindow(Window):
         if len(self.evs) > self.length:
             evicted = self.evs.pop()
             self.keys.pop()
-            out.append((EXPIRED, Event(now_ms, evicted.data)))
+            out.append((EXPIRED, Event(now_ms, evicted.data, evicted.uid)))
         return out
 
     def contents(self):
@@ -489,7 +489,7 @@ class DelayWindow(Window):
         out = []
         while self.buf and self.buf[0].timestamp + self.duration <= now_ms:
             old = self.buf.popleft()
-            out.append((CURRENT, Event(old.timestamp, old.data)))
+            out.append((CURRENT, Event(old.timestamp, old.data, old.uid)))
         return out
 
     def on_timer(self, now_ms):
@@ -524,7 +524,7 @@ class FrequentWindow(Window):
         out = []
         if k in self.counts:
             self.counts[k] += 1
-            out.append((EXPIRED, Event(now_ms, self.events[k].data)))
+            out.append((EXPIRED, Event(now_ms, self.events[k].data, self.events[k].uid)))
             self.events[k] = ev
             out.append((CURRENT, ev))
         elif len(self.counts) < self.count:
@@ -536,7 +536,7 @@ class FrequentWindow(Window):
             for kk in list(self.counts):
                 self.counts[kk] -= 1
                 if self.counts[kk] == 0:
-                    out.append((EXPIRED, Event(now_ms, self.events[kk].data)))
+                    out.append((EXPIRED, Event(now_ms, self.events[kk].data, self.events[kk].uid)))
                     del self.counts[kk]
                     del self.events[kk]
         return out
@@ -573,7 +573,7 @@ class LossyFrequentWindow(Window):
         out = []
         if k in self.counts:
             self.counts[k][0] += 1
-            out.append((EXPIRED, Event(now_ms, self.events[k].data)))
+            out.append((EXPIRED, Event(now_ms, self.events[k].data, self.events[k].uid)))
         else:
             self.counts[k] = [1, bucket - 1]
         self.events[k] = ev
@@ -582,7 +582,7 @@ class LossyFrequentWindow(Window):
             for kk in list(self.counts):
                 c, d = self.counts[kk]
                 if c + d <= bucket:
-                    out.append((EXPIRED, Event(now_ms, self.events[kk].data)))
+                    out.append((EXPIRED, Event(now_ms, self.events[kk].data, self.events[kk].uid)))
                     del self.counts[kk]
                     del self.events[kk]
         return out
@@ -625,7 +625,7 @@ class CronWindow(Window):
             return []
         out = []
         for old in self.prev:
-            out.append((EXPIRED, Event(now_ms, old.data)))
+            out.append((EXPIRED, Event(now_ms, old.data, old.uid)))
         if self.prev:
             out.append((RESET, None))
         for e in self.cur:

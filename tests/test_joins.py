@@ -68,7 +68,7 @@ def test_left_outer_join(mgr):
         ("L", ("A", 3), 1002),       # matches
         ("R", ("B", 9), 1003),       # right arrival unmatched: NOT emitted
     ])
-    assert ("A", 1, 0) in got        # null int decodes as 0
+    assert ("A", 1, None) in got     # outer-join miss emits real null
     assert ("A", 3, 2) in got
     assert not any(g[0] == "B" for g in got)
 

@@ -1,0 +1,254 @@
+"""Named windows (`define window`) + on-demand (store) queries.
+
+Reference test surface: modules/siddhi-core/src/test/java/org/wso2/siddhi/
+core/window/ (WindowTestCase etc.) and query/storequery/StoreQueryTableTestCase.
+"""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.planner import PlanError
+
+
+@pytest.fixture
+def mgr():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def collect(rt, sid):
+    out = []
+    rt.add_callback(sid, lambda evs: out.extend(e.data for e in evs))
+    return out
+
+
+# -- named windows -----------------------------------------------------------
+
+APP_W = """
+    define stream S (sym string, price double);
+    define window W (sym string, price double) length(2) output all events;
+    from S select sym, price insert into W;
+    from W select sym, price insert into O;
+"""
+
+
+def test_named_window_passthrough(mgr):
+    rt = mgr.create_app_runtime(APP_W)
+    out = collect(rt, "O")
+    rt.input_handler("S").send([("A", 1.0), ("B", 2.0)])
+    rt.flush()
+    assert out == [("A", 1.0), ("B", 2.0)]
+
+
+def test_named_window_aggregate_tracks_contents(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (sym string, price double);
+        define window W (sym string, price double) length(2) output all events;
+        from S select sym, price insert into W;
+        from W select sum(price) as total insert into O;
+    """)
+    out = collect(rt, "O")
+    h = rt.input_handler("S")
+    h.send(("A", 1.0))
+    h.send(("B", 2.0))
+    h.send(("C", 10.0))     # displaces A -> sum over {B, C}
+    rt.flush()
+    # rows after each add/remove; final value must reflect window contents
+    assert out[-1] == (12.0,)
+
+
+def test_named_window_expired_output(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (x int);
+        define window W (x int) length(1) output all events;
+        from S select x insert into W;
+        from W select x insert expired events into O;
+    """)
+    out = collect(rt, "O")
+    h = rt.input_handler("S")
+    h.send((1,))
+    h.send((2,))     # 1 expires
+    rt.flush()
+    assert out == [(1,)]
+
+
+def test_two_queries_share_window(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (x int);
+        define window W (x int) lengthBatch(2);
+        from S select x insert into W;
+        from W select sum(x) as s insert into O1;
+        from W[x > 1] select x insert into O2;
+    """)
+    o1, o2 = collect(rt, "O1"), collect(rt, "O2")
+    rt.input_handler("S").send([(1,), (2,)])
+    rt.flush()
+    assert o1[-1] == (3,)
+    assert o2 == [(2,)]
+
+
+def test_named_window_reset_clears_aggregates(mgr):
+    """lengthBatch with `output current events`: readers get no expired
+    events, so the RESET signal must clear their aggregate banks."""
+    rt = mgr.create_app_runtime("""
+        define stream S (x int);
+        define window W (x int) lengthBatch(2) output current events;
+        from S select x insert into W;
+        from W select sum(x) as s insert into O;
+    """)
+    out = collect(rt, "O")
+    rt.input_handler("S").send([(1,), (2,)])
+    rt.flush()
+    rt.input_handler("S").send([(3,), (4,)])
+    rt.flush()
+    # per-batch sums: (1),(3) then reset, (3),(7) — not cumulative (6),(10)
+    assert out == [(1,), (3,), (3,), (7,)]
+
+
+def test_join_against_named_window(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (sym string, price double);
+        define stream Q (sym string);
+        define window W (sym string, price double) length(10);
+        from S select sym, price insert into W;
+        from Q join W on W.sym == Q.sym
+            select Q.sym as sym, W.price as price insert into O;
+    """)
+    out = collect(rt, "O")
+    rt.input_handler("S").send([("A", 1.0), ("B", 2.0)])
+    rt.flush()
+    rt.input_handler("Q").send(("B",))
+    rt.flush()
+    assert out == [("B", 2.0)]
+
+
+def test_no_input_handler_for_window(mgr):
+    rt = mgr.create_app_runtime(APP_W)
+    with pytest.raises(KeyError):
+        rt.input_handler("W")
+
+
+def test_window_on_named_window_rejected(mgr):
+    with pytest.raises(PlanError):
+        mgr.create_app_runtime("""
+            define stream S (x int);
+            define window W (x int) length(5);
+            from S select x insert into W;
+            from W#window.length(2) select x insert into O;
+        """)
+
+
+def test_named_window_snapshot(mgr):
+    app = """
+        define stream S (x int);
+        define window W (x int) length(3);
+        from S select x insert into W;
+        from W select sum(x) as s insert into O;
+    """
+    rt = mgr.create_app_runtime(app)
+    collect(rt, "O")
+    rt.input_handler("S").send([(1,), (2,)])
+    rt.flush()
+    snap = rt.snapshot()
+
+    m2 = SiddhiManager()
+    rt2 = m2.create_app_runtime(app)
+    rt2.restore(snap)
+    assert [e.data for e in rt2.named_windows["W"].contents()] == [(1,), (2,)]
+    m2.shutdown()
+
+
+# -- store queries -----------------------------------------------------------
+
+APP_STORE = """
+    define stream S (sym string, price double, vol long);
+    @PrimaryKey('sym')
+    define table T (sym string, price double, vol long);
+    from S select sym, price, vol insert into T;
+"""
+
+
+def _fill(rt):
+    rt.input_handler("S").send([("A", 10.0, 100), ("B", 20.0, 200),
+                                ("C", 30.0, 300)])
+    rt.flush()
+
+
+def test_store_query_find_all(mgr):
+    rt = mgr.create_app_runtime(APP_STORE)
+    _fill(rt)
+    rows = sorted(r for _t, r in rt.query("from T select sym, price"))
+    assert rows == [("A", 10.0), ("B", 20.0), ("C", 30.0)]
+
+
+def test_store_query_on_condition(mgr):
+    rt = mgr.create_app_runtime(APP_STORE)
+    _fill(rt)
+    rows = sorted(r for _t, r in
+                  rt.query("from T on price > 15 select sym"))
+    assert rows == [("B",), ("C",)]
+
+
+def test_store_query_pk_seek(mgr):
+    rt = mgr.create_app_runtime(APP_STORE)
+    _fill(rt)
+    rows = [r for _t, r in rt.query("from T on T.sym == 'B' select sym, vol")]
+    assert rows == [("B", 200)]
+
+
+def test_store_query_aggregate(mgr):
+    rt = mgr.create_app_runtime(APP_STORE)
+    _fill(rt)
+    rows = [r for _t, r in rt.query("from T select sum(vol) as total")]
+    assert rows == [(600,)]
+    # re-execution starts fresh (no carried aggregate state)
+    rows = [r for _t, r in rt.query("from T select sum(vol) as total")]
+    assert rows == [(600,)]
+
+
+def test_store_query_group_by(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (grp string, v int);
+        define table T (grp string, v int);
+        from S select grp, v insert into T;
+    """)
+    rt.input_handler("S").send([("a", 1), ("b", 2), ("a", 3)])
+    rt.flush()
+    rows = sorted(r for _t, r in rt.query(
+        "from T select grp, sum(v) as s group by grp"))
+    assert rows == [("a", 4), ("b", 2)]
+
+
+def test_store_query_delete_action(mgr):
+    rt = mgr.create_app_runtime(APP_STORE)
+    _fill(rt)
+    rt.query("from T on price > 15 select sym delete T on T.sym == sym")
+    rows = sorted(r[0] for _t, r in rt.query("from T select sym"))
+    assert rows == ["A"]
+
+
+def test_store_query_update_action(mgr):
+    rt = mgr.create_app_runtime(APP_STORE)
+    _fill(rt)
+    rt.query("from T on sym == 'A' select sym, price "
+             "update T set T.price = 99.0 on T.sym == sym")
+    rows = [r for _t, r in rt.query("from T on sym == 'A' select price")]
+    assert rows == [(99.0,)]
+
+
+def test_store_query_from_named_window(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (x int);
+        define window W (x int) length(5);
+        from S select x insert into W;
+    """)
+    rt.input_handler("S").send([(1,), (2,), (3,)])
+    rt.flush()
+    rows = sorted(r for _t, r in rt.query("from W on x > 1 select x"))
+    assert rows == [(2,), (3,)]
+
+
+def test_store_query_unknown_source(mgr):
+    rt = mgr.create_app_runtime(APP_STORE)
+    with pytest.raises(PlanError):
+        rt.query("from NoSuch select x")

@@ -130,8 +130,8 @@ def test_logical_or_pattern(mgr):
     hb = rt.input_handler("B")
     hb.send((42,))
     rt.flush()
-    # e1 absent -> null -> int column neutral 0
-    assert [e.data for e in got] == [(0, 42)]
+    # e1 absent -> real null in decoded output
+    assert [e.data for e in got] == [(None, 42)]
 
 
 def test_absent_pattern_timer(mgr):

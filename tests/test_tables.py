@@ -1,0 +1,380 @@
+"""Tables: define/insert/update/delete/update-or-insert, PK + secondary
+indexes, table joins, `in Table` membership, snapshots.
+
+Mirrors the reference's table test surface (reference:
+modules/siddhi-core/src/test/java/org/wso2/siddhi/core/query/table/ —
+InsertIntoTableTestCase, UpdateFromTableTestCase, DeleteFromTableTestCase,
+UpdateOrInsertTableTestCase, JoinTableTestCase, IndexedTableTestCase).
+"""
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.planner import PlanError
+
+
+@pytest.fixture
+def mgr():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def table_rows(rt, tid):
+    return sorted(rt.tables[tid].all_rows())
+
+
+# -- insert ------------------------------------------------------------------
+
+def test_insert_into_table(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (symbol string, price double, volume long);
+        define table T (symbol string, price double, volume long);
+        from S select symbol, price, volume insert into T;
+    """)
+    h = rt.input_handler("S")
+    h.send(("WSO2", 55.6, 100))
+    h.send(("IBM", 75.6, 10))
+    rt.flush()
+    assert table_rows(rt, "T") == [("IBM", 75.6, 10), ("WSO2", 55.6, 100)]
+    assert len(rt.tables["T"]) == 2
+
+
+def test_insert_with_filter_and_projection(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (symbol string, price double);
+        define table T (symbol string, price double);
+        from S[price > 50] select symbol, price insert into T;
+    """)
+    h = rt.input_handler("S")
+    h.send([("A", 10.0), ("B", 60.0), ("C", 70.0)])
+    rt.flush()
+    assert table_rows(rt, "T") == [("B", 60.0), ("C", 70.0)]
+
+
+def test_insert_schema_mismatch_rejected(mgr):
+    with pytest.raises(PlanError):
+        mgr.create_app_runtime("""
+            define stream S (a int, b string);
+            define table T (x string, y int);
+            from S select a, b insert into T;
+        """)
+
+
+def test_stream_from_table_rejected(mgr):
+    with pytest.raises(PlanError):
+        mgr.create_app_runtime("""
+            define table T (a int);
+            from T select a insert into O;
+        """)
+
+
+def test_duplicate_primary_key_dropped(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (k string, v int);
+        @PrimaryKey('k')
+        define table T (k string, v int);
+        from S select k, v insert into T;
+    """)
+    h = rt.input_handler("S")
+    h.send(("a", 1))
+    with pytest.warns(RuntimeWarning):
+        h.send(("a", 2))
+        rt.flush()
+    assert table_rows(rt, "T") == [("a", 1)]
+
+
+# -- update / delete / update or insert --------------------------------------
+
+APP_UPD = """
+    define stream S (symbol string, price double);
+    define stream U (symbol string, price double);
+    define table T (symbol string, price double);
+    from S select symbol, price insert into T;
+    from U select symbol, price update T on T.symbol == symbol;
+"""
+
+
+def test_update_table(mgr):
+    rt = mgr.create_app_runtime(APP_UPD)
+    rt.input_handler("S").send([("A", 1.0), ("B", 2.0)])
+    rt.flush()
+    rt.input_handler("U").send(("A", 9.0))
+    rt.flush()
+    assert table_rows(rt, "T") == [("A", 9.0), ("B", 2.0)]
+
+
+def test_update_with_set_clause(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (symbol string, price double);
+        define stream U (symbol string, delta double);
+        define table T (symbol string, price double);
+        from S select symbol, price insert into T;
+        from U select symbol, delta
+            update T set T.price = T.price + delta on T.symbol == symbol;
+    """)
+    rt.input_handler("S").send([("A", 1.0), ("B", 2.0)])
+    rt.flush()
+    rt.input_handler("U").send(("B", 10.0))
+    rt.flush()
+    assert table_rows(rt, "T") == [("A", 1.0), ("B", 12.0)]
+
+
+def test_delete_from_table(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (symbol string, price double);
+        define stream D (symbol string);
+        define table T (symbol string, price double);
+        from S select symbol, price insert into T;
+        from D select symbol delete T on T.symbol == symbol;
+    """)
+    rt.input_handler("S").send([("A", 1.0), ("B", 2.0), ("C", 3.0)])
+    rt.flush()
+    rt.input_handler("D").send(("B",))
+    rt.flush()
+    assert table_rows(rt, "T") == [("A", 1.0), ("C", 3.0)]
+
+
+def test_update_or_insert(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream U (symbol string, price double);
+        define table T (symbol string, price double);
+        from U select symbol, price
+            update or insert into T on T.symbol == symbol;
+    """)
+    h = rt.input_handler("U")
+    h.send(("A", 1.0))
+    h.send(("B", 2.0))
+    h.send(("A", 5.0))
+    rt.flush()
+    assert table_rows(rt, "T") == [("A", 5.0), ("B", 2.0)]
+
+
+def test_update_on_unknown_table_rejected(mgr):
+    with pytest.raises(PlanError):
+        mgr.create_app_runtime("""
+            define stream S (a int);
+            from S select a update NoSuchTable on NoSuchTable.a == a;
+        """)
+
+
+# -- indexes -----------------------------------------------------------------
+
+def test_primary_key_seek_used(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (k string, v int);
+        define stream P (k string);
+        @PrimaryKey('k')
+        define table T (k string, v int);
+        from S select k, v insert into T;
+        from P join T on T.k == P.k select P.k as k, T.v as v insert into O;
+    """)
+    out = []
+    rt.add_callback("O", lambda evs: out.extend(e.data for e in evs))
+    rt.input_handler("S").send([(f"k{i}", i) for i in range(100)])
+    rt.flush()
+    # the join's compiled condition must be a PK seek, not a scan
+    plan = [p for p in rt._plans if getattr(p, "table_cond", None) is not None][0]
+    assert plan.table_cond.pk_fns is not None
+    rt.input_handler("P").send(("k42",))
+    rt.flush()
+    assert out == [("k42", 42)]
+
+
+def test_secondary_index_seek(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (k string, grp string, v int);
+        define stream P (grp string);
+        @Index('grp')
+        define table T (k string, grp string, v int);
+        from S select k, grp, v insert into T;
+        from P join T on T.grp == P.grp select T.k as k insert into O;
+    """)
+    out = []
+    rt.add_callback("O", lambda evs: out.extend(e.data for e in evs))
+    rt.input_handler("S").send(
+        [(f"k{i}", f"g{i % 3}", i) for i in range(9)])
+    rt.flush()
+    plan = [p for p in rt._plans if getattr(p, "table_cond", None) is not None][0]
+    assert plan.table_cond.index_seeks
+    rt.input_handler("P").send(("g1",))
+    rt.flush()
+    assert sorted(out) == [("k1",), ("k4",), ("k7",)]
+
+
+def test_update_maintains_index(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (k string, v int);
+        define stream U (k string, v int);
+        define stream P (k string);
+        @PrimaryKey('k')
+        define table T (k string, v int);
+        from S select k, v insert into T;
+        from U select k, v update T on T.k == k;
+        from P join T on T.k == P.k select T.v as v insert into O;
+    """)
+    out = []
+    rt.add_callback("O", lambda evs: out.extend(e.data for e in evs))
+    rt.input_handler("S").send(("a", 1))
+    rt.flush()
+    rt.input_handler("U").send(("a", 99))
+    rt.flush()
+    rt.input_handler("P").send(("a",))
+    rt.flush()
+    assert out == [(99,)]
+
+
+# -- table joins -------------------------------------------------------------
+
+def test_table_join_basic(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream CheckStream (symbol string, qty int);
+        define stream StockStream (symbol string, price double);
+        define table StockTable (symbol string, price double);
+        from StockStream select symbol, price insert into StockTable;
+        from CheckStream join StockTable on StockTable.symbol == CheckStream.symbol
+            select CheckStream.symbol as symbol, StockTable.price as price,
+                   CheckStream.qty as qty
+            insert into OutStream;
+    """)
+    out = []
+    rt.add_callback("OutStream", lambda evs: out.extend(e.data for e in evs))
+    rt.input_handler("StockStream").send([("WSO2", 55.0), ("IBM", 75.0)])
+    rt.flush()
+    rt.input_handler("CheckStream").send(("WSO2", 10))
+    rt.flush()
+    assert out == [("WSO2", 55.0, 10)]
+
+
+def test_table_join_residual_condition(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream C (sym string, minp double);
+        define table T (sym string, price double);
+        define stream S (sym string, price double);
+        from S select sym, price insert into T;
+        from C join T on T.sym == C.sym and T.price > C.minp
+            select T.sym as sym, T.price as price insert into O;
+    """)
+    out = []
+    rt.add_callback("O", lambda evs: out.extend(e.data for e in evs))
+    rt.input_handler("S").send([("A", 10.0), ("A", 20.0)])
+    rt.flush()
+    rt.input_handler("C").send(("A", 15.0))
+    rt.flush()
+    assert out == [("A", 20.0)]
+
+
+def test_table_left_outer_join_emits_nulls(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream C (sym string);
+        define table T (sym string, price double);
+        from C left outer join T on T.sym == C.sym
+            select C.sym as sym, T.price as price insert into O;
+    """)
+    out = []
+    rt.add_callback("O", lambda evs: out.extend(e.data for e in evs))
+    rt.input_handler("C").send(("NOPE",))
+    rt.flush()
+    assert out == [("NOPE", None)]
+
+
+def test_two_table_join_rejected(mgr):
+    with pytest.raises(PlanError):
+        mgr.create_app_runtime("""
+            define table A (x int);
+            define table B (x int);
+            from A join B on A.x == B.x select A.x as x insert into O;
+        """)
+
+
+# -- `in Table` --------------------------------------------------------------
+
+def test_in_table_filter(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (sym string, price double);
+        define stream W (sym string);
+        define table Watch (sym string);
+        from W select sym insert into Watch;
+        from S[(Watch.sym == S.sym) in Watch]
+            select sym, price insert into O;
+    """)
+    out = []
+    rt.add_callback("O", lambda evs: out.extend(e.data for e in evs))
+    rt.input_handler("W").send(("IBM",))
+    rt.flush()
+    rt.input_handler("S").send([("IBM", 75.0), ("WSO2", 55.0)])
+    rt.flush()
+    assert out == [("IBM", 75.0)]
+
+
+# -- nulls & snapshot --------------------------------------------------------
+
+def test_table_stores_nulls(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream A (x int);
+        define stream B (y int);
+        define table T (x int, y int);
+        from e1=A or e2=B select e1.x as x, e2.y as y insert into T;
+    """)
+    rt.input_handler("B").send((42,))
+    rt.flush()
+    assert table_rows(rt, "T") == [(None, 42)]
+
+
+def test_table_snapshot_restore(mgr):
+    app = """
+        define stream S (k string, v int);
+        @PrimaryKey('k')
+        define table T (k string, v int);
+        from S select k, v insert into T;
+    """
+    rt = mgr.create_app_runtime(app)
+    rt.input_handler("S").send([("a", 1), ("b", 2)])
+    rt.flush()
+    snap = rt.snapshot()
+
+    m2 = SiddhiManager()
+    rt2 = m2.create_app_runtime(app)
+    rt2.restore(snap)
+    assert table_rows(rt2, "T") == [("a", 1), ("b", 2)]
+    # indexes rebuilt: a PK duplicate is still rejected
+    with pytest.warns(RuntimeWarning):
+        rt2.input_handler("S").send(("a", 9))
+        rt2.flush()
+    assert table_rows(rt2, "T") == [("a", 1), ("b", 2)]
+    m2.shutdown()
+
+
+def test_delete_then_reinsert_pk(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (k string, v int);
+        define stream D (k string);
+        @PrimaryKey('k')
+        define table T (k string, v int);
+        from S select k, v insert into T;
+        from D select k delete T on T.k == k;
+    """)
+    rt.input_handler("S").send(("a", 1))
+    rt.flush()
+    rt.input_handler("D").send(("a",))
+    rt.flush()
+    assert table_rows(rt, "T") == []
+    rt.input_handler("S").send(("a", 2))
+    rt.flush()
+    assert table_rows(rt, "T") == [("a", 2)]
+
+
+def test_compaction_preserves_contents(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (k int);
+        define stream D (k int);
+        define table T (k int);
+        from S select k insert into T;
+        from D select k delete T on T.k == k;
+    """)
+    rt.input_handler("S").send([(i,) for i in range(600)])
+    rt.flush()
+    rt.input_handler("D").send([(i,) for i in range(0, 600, 2)])
+    rt.flush()
+    assert len(rt.tables["T"]) == 300
+    assert table_rows(rt, "T") == [(i,) for i in range(1, 600, 2)]

@@ -1,0 +1,119 @@
+"""Triggers + scheduler (reference: core:trigger/*.java, TriggerTestCase;
+wall-clock pump replaces the reference's ScheduledExecutorService)."""
+import time
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+@pytest.fixture
+def mgr():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def collect(rt, sid):
+    out = []
+    rt.add_callback(sid, lambda evs: out.extend(e.data for e in evs))
+    return out
+
+
+def test_periodic_trigger_virtual_time(mgr):
+    rt = mgr.create_app_runtime("""
+        define trigger T at every 1 sec;
+        from T select triggered_time insert into O;
+    """)
+    out = collect(rt, "O")
+    rt.set_time(0)           # anchor
+    rt.set_time(3500)
+    assert [r[0] for r in out] == [1000, 2000, 3000]
+
+
+def test_trigger_feeds_queries(mgr):
+    rt = mgr.create_app_runtime("""
+        define trigger T at every 500 milliseconds;
+        from T select count() as n insert into O;
+    """)
+    out = collect(rt, "O")
+    rt.set_time(0)
+    rt.set_time(1000)
+    assert out == [(1,), (2,)]
+
+
+def test_start_trigger(mgr):
+    rt = mgr.create_app_runtime("""
+        @app:playback
+        define trigger T at 'start';
+        from T select triggered_time insert into O;
+    """)
+    out = collect(rt, "O")
+    rt.start()
+    assert len(out) == 1
+
+
+def test_cron_trigger_virtual_time(mgr):
+    rt = mgr.create_app_runtime("""
+        define trigger T at '*/2 * * * * ?';
+        from T select triggered_time insert into O;
+    """)
+    out = collect(rt, "O")
+    rt.set_time(0)
+    rt.set_time(10_000)
+    # every 2 seconds: 2000, 4000, 6000, 8000, 10000
+    assert [r[0] for r in out] == [2000, 4000, 6000, 8000, 10000]
+
+
+def test_trigger_snapshot_keeps_phase(mgr):
+    app = """
+        define trigger T at every 1 sec;
+        from T select triggered_time insert into O;
+    """
+    rt = mgr.create_app_runtime(app)
+    collect(rt, "O")
+    rt.set_time(0)
+    rt.set_time(1500)        # fired at 1000; next due 2000
+    snap = rt.snapshot()
+
+    m2 = SiddhiManager()
+    rt2 = m2.create_app_runtime(app)
+    out2 = collect(rt2, "O")
+    rt2.restore(snap)
+    rt2.set_time(2500)
+    assert [r[0] for r in out2] == [2000]
+    m2.shutdown()
+
+
+def test_wall_clock_scheduler_fires_triggers(mgr):
+    """Real-time mode: timers fire from the scheduler pump without
+    set_time() (VERDICT weak #4)."""
+    rt = mgr.create_app_runtime("""
+        define trigger T at every 100 milliseconds;
+        from T select triggered_time insert into O;
+    """)
+    out = collect(rt, "O")
+    rt.start()
+    deadline = time.time() + 2.0
+    while len(out) < 2 and time.time() < deadline:
+        time.sleep(0.02)
+    rt.shutdown()
+    assert len(out) >= 2
+
+
+def test_wall_clock_time_window_expires(mgr):
+    """A time window's expired events emit without explicit set_time."""
+    rt = mgr.create_app_runtime("""
+        define stream S (x int);
+        from S#window.time(100 milliseconds)
+            select x insert expired events into O;
+    """)
+    out = collect(rt, "O")
+    rt.start()
+    rt.input_handler("S").send((7,))
+    rt.flush()
+    deadline = time.time() + 2.0
+    while not out and time.time() < deadline:
+        time.sleep(0.02)
+    rt.shutdown()
+    assert out == [(7,)]
