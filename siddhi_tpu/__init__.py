@@ -27,10 +27,16 @@ from .query import ast, parse, parse_expression, parse_query, parse_store_query
 from .core.runtime import SiddhiAppRuntime, SiddhiManager
 from .core.schema import StreamSchema
 from .core.batch import EventBatch
+from .core.io import (InMemoryBroker, Sink, Source, SinkMapper, SourceMapper,
+                      register_sink_mapper, register_sink_type,
+                      register_source_mapper, register_source_type)
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 __all__ = [
     "SiddhiManager", "SiddhiAppRuntime", "StreamSchema", "EventBatch",
     "ast", "parse", "parse_query", "parse_store_query", "parse_expression",
+    "InMemoryBroker", "Source", "Sink", "SourceMapper", "SinkMapper",
+    "register_source_type", "register_sink_type",
+    "register_source_mapper", "register_sink_mapper",
 ]

@@ -1,0 +1,527 @@
+"""Incremental (multi-granularity) aggregation:
+`define aggregation A from S select sum(price) as total group by sym
+ aggregate by ts every sec ... year`.
+
+Reference: core:aggregation/IncrementalExecutor.java:45-133 (per-duration
+tumbling-bucket executor chain: seconds feed minutes feed hours ...),
+AggregationRuntime.java:65-105 (duration->executor + duration->table maps),
+AggregationParser.java:87, IncrementalAggregateCompileCondition.java:277
+(within/per join selection), Incremental*AttributeAggregator (avg ->
+(sum,count) decomposition).
+
+TPU-first reformulation (SURVEY §5 "maps to parallel-prefix"): the chain
+is replaced by **independent per-duration segmented reductions** — every
+micro-batch computes (bucket, group) segment ids and reduces all base
+fields with vectorized scatter-reductions (bincount / ufunc.at), then
+merges the few unique segments into per-duration bucket stores.  Because
+sum/count/min/max bases are associative, reducing raw events per duration
+equals the reference's bucket-of-buckets cascade, with no sequential
+dependency between levels — each duration is one data-parallel reduction.
+
+Buckets are never "finalized": within/per queries read running and past
+buckets uniformly (the reference merges in-memory + table state the same
+way: IncrementalDataAggregator).
+"""
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..query import ast
+from ..query.ast import AttrType, Duration
+from .batch import EventBatch
+from .planner import OutputBatch, PlanError, QueryPlan
+from .schema import StreamSchema, StringTable, dtype_of
+
+AGG_TIMESTAMP = "AGG_TIMESTAMP"
+
+# base-field decomposition (reference: aggregator/incremental/
+# Incremental{Sum,Count,Avg,Min,Max}AttributeAggregator)
+_BASES = {
+    "sum": ("sum",),
+    "count": ("count",),
+    "avg": ("sum", "count"),
+    "min": ("min",),
+    "max": ("max",),
+}
+
+_DUR_NAMES = {
+    "sec": Duration.SECONDS, "seconds": Duration.SECONDS,
+    "min": Duration.MINUTES, "minutes": Duration.MINUTES,
+    "hour": Duration.HOURS, "hours": Duration.HOURS,
+    "day": Duration.DAYS, "days": Duration.DAYS,
+    "week": Duration.WEEKS, "weeks": Duration.WEEKS,
+    "month": Duration.MONTHS, "months": Duration.MONTHS,
+    "year": Duration.YEARS, "years": Duration.YEARS,
+}
+
+
+def duration_of(name: str) -> Duration:
+    d = _DUR_NAMES.get(name.strip().lower())
+    if d is None:
+        raise PlanError(f"unknown aggregation duration {name!r}")
+    return d
+
+
+def bucket_starts(ts: np.ndarray, dur: Duration) -> np.ndarray:
+    """Vectorized bucket start (ms) per timestamp; months/years use
+    calendar boundaries via numpy datetime64 truncation (the reference
+    uses Calendar arithmetic: IncrementalTimeConverterUtil)."""
+    if dur == Duration.MONTHS:
+        d = ts.astype("datetime64[ms]").astype("datetime64[M]")
+        return d.astype("datetime64[ms]").astype(np.int64)
+    if dur == Duration.YEARS:
+        d = ts.astype("datetime64[ms]").astype("datetime64[Y]")
+        return d.astype("datetime64[ms]").astype(np.int64)
+    w = dur.approx_millis
+    return (ts // w) * w
+
+
+class _Site:
+    """One aggregator call site in the aggregation's selector."""
+    __slots__ = ("name", "key", "arg", "arg_fn", "in_type", "out_type")
+
+    def __init__(self, name, key, arg, arg_fn, in_type, out_type):
+        self.name = name          # sum/count/avg/min/max
+        self.key = key            # env placeholder "__agg<i>"
+        self.arg = arg            # column name if plain Variable, else None
+        self.arg_fn = arg_fn      # per-row fallback evaluator
+        self.in_type = in_type
+        self.out_type = out_type
+
+
+class AggregationRuntime(QueryPlan):
+    """Ingest plan + queryable per-duration bucket store."""
+
+    def __init__(self, rt, ad: ast.AggregationDefinition):
+        from ..interp.engine import extract_aggregators
+        from ..interp.expr import PyExprContext, compile_py
+
+        self.rt = rt
+        self.ad = ad
+        self.name = f"#aggregation_{ad.id}"
+        inp = ad.input
+        if inp.stream_id not in rt.schemas:
+            raise PlanError(f"aggregation {ad.id!r}: unknown input stream "
+                            f"{inp.stream_id!r}")
+        if inp.window is not None:
+            raise PlanError(f"aggregation {ad.id!r}: windows not allowed")
+        self.in_schema = rt.schemas[inp.stream_id]
+        self.input_streams = (inp.stream_id,)
+        self.output_target = None
+        self.durations = tuple(ad.durations)
+        if not self.durations:
+            raise PlanError(f"aggregation {ad.id!r}: no durations")
+
+        ctx = PyExprContext({inp.alias: self.in_schema,
+                             inp.stream_id: self.in_schema},
+                            default_ref=inp.alias, tables=rt.tables)
+        self.filters = [compile_py(f.expr, ctx)[0] for f in inp.filters]
+
+        # event-time source (reference: `aggregate by <attr>`)
+        self.by_attr = None
+        if ad.by_attribute is not None:
+            self.by_attr = ad.by_attribute.attribute
+            t = self.in_schema.type_of(self.by_attr)
+            if t != AttrType.LONG:
+                raise PlanError(f"aggregation {ad.id!r}: aggregate-by "
+                                f"attribute must be long (epoch ms)")
+
+        # group-by columns (plain variables, reference restriction)
+        self.group_attrs: list[str] = []
+        for g in ad.selector.group_by:
+            if g.stream_ref not in (None, inp.alias, inp.stream_id):
+                raise PlanError(f"aggregation {ad.id!r}: bad group-by ref")
+            self.group_attrs.append(g.attribute)
+
+        # selector: rewrite aggregator calls into placeholder sites
+        if ad.selector.select_all:
+            raise PlanError(f"aggregation {ad.id!r}: select * not allowed; "
+                            f"name the aggregates")
+        raw_sites: list = []
+        rewritten: list[tuple[str, ast.Expression]] = []
+        for oa in ad.selector.attributes:
+            rewritten.append((oa.name,
+                              extract_aggregators(oa.expr, raw_sites, ctx)))
+        self.sites: list[_Site] = []
+        for i, s in enumerate(raw_sites):
+            if s.name not in _BASES:
+                raise PlanError(
+                    f"aggregation {ad.id!r}: {s.name}() has no incremental "
+                    f"decomposition (reference supports sum/count/avg/min/max)")
+            self.sites.append(_Site(s.name, s.key, None,
+                                    s.arg_fns[0] if s.arg_fns else None,
+                                    s.in_type, s.out_type))
+        # plain-variable fast path for site args
+        site_i = 0
+        def scan_args(e):
+            nonlocal site_i
+            if isinstance(e, ast.FunctionCall) and e.namespace is None \
+                    and e.name.lower() in _BASES:
+                if len(e.args) == 1 and isinstance(e.args[0], ast.Variable) \
+                        and e.args[0].attribute in self.in_schema.types:
+                    self.sites[site_i].arg = e.args[0].attribute
+                site_i += 1
+                return
+            for sub in getattr(e, "args", ()) or ():
+                scan_args(sub)
+            for nm in ("left", "right", "expr"):
+                sub = getattr(e, nm, None)
+                if isinstance(sub, ast.Expression):
+                    scan_args(sub)
+        for oa in ad.selector.attributes:
+            scan_args(oa.expr)
+
+        # output row evaluators over {group attrs, AGG_TIMESTAMP, __agg*}
+        extra = {a: (a, self.in_schema.type_of(a)) for a in self.group_attrs}
+        extra[AGG_TIMESTAMP] = (AGG_TIMESTAMP, AttrType.LONG)
+        extra.update({s.key: (s.key, s.out_type) for s in self.sites})
+        octx = PyExprContext({}, extra=extra, tables=rt.tables)
+        self.out_fns: list = []
+        names, types = [], []
+        for nm, expr in rewritten:
+            f, t = compile_py(expr, octx)
+            self.out_fns.append(f)
+            names.append(nm)
+            types.append(t)
+        self.out_schema = StreamSchema(ad.id, tuple(
+            ast.Attribute(n, t) for n, t in zip(names, types)))
+
+        # per-duration bucket stores:
+        # (bucket_start_ms, group_key_tuple) -> [base floats ...]
+        self.n_bases = sum(len(_BASES[s.name]) for s in self.sites)
+        self.store: dict = {d: {} for d in self.durations}
+
+    # -- ingest (vectorized segmented reduction) -----------------------------
+
+    def process(self, stream_id: str, batch: EventBatch) -> list:
+        n = batch.n
+        if n == 0:
+            return []
+        ts = (batch.columns[self.by_attr].astype(np.int64)
+              if self.by_attr else batch.timestamps)
+        keep = None
+        if self.filters:
+            rows = batch.rows(self.rt.strings)
+            names = self.in_schema.names
+            keep = np.fromiter(
+                (all(f(dict(zip(names, r), __timestamp__=int(t)))
+                     for f in self.filters)
+                 for t, r in zip(batch.timestamps, rows)),
+                dtype=bool, count=n)
+            if not keep.any():
+                return []
+
+        gcols = [batch.columns[a] for a in self.group_attrs]
+        vals = self._site_values(batch)
+        if keep is not None:
+            ts = ts[keep]
+            gcols = [c[keep] for c in gcols]
+            vals = [v[keep] for v in vals]
+
+        # integer views of group columns for exact vectorized unique
+        gints = [self._int_view(c) for c in gcols]
+        for dur in self.durations:
+            buckets = bucket_starts(ts, dur)
+            segs = np.stack([buckets, *gints], axis=1) if gints \
+                else buckets[:, None]
+            uniq, inv = np.unique(segs, axis=0, return_inverse=True)
+            m = len(uniq)
+            # segmented reduction of every base field
+            reduced: list[np.ndarray] = []
+            for s, v in zip(self.sites, vals):
+                for base in _BASES[s.name]:
+                    if base == "sum":
+                        reduced.append(np.bincount(inv, weights=v, minlength=m))
+                    elif base == "count":
+                        reduced.append(np.bincount(inv, minlength=m).astype(float))
+                    elif base == "min":
+                        acc = np.full(m, np.inf)
+                        np.minimum.at(acc, inv, v)
+                        reduced.append(acc)
+                    elif base == "max":
+                        acc = np.full(m, -np.inf)
+                        np.maximum.at(acc, inv, v)
+                        reduced.append(acc)
+            # merge the (few) unique segments into the bucket store
+            st = self.store[dur]
+            first_rows = np.empty(m, dtype=np.int64)
+            first_rows[inv[::-1]] = np.arange(len(inv))[::-1]
+            for j in range(m):
+                r = int(first_rows[j])
+                gkey = tuple(self._decode_gval(c[r], a)
+                             for c, a in zip(gcols, self.group_attrs))
+                key = (int(uniq[j, 0]), gkey)
+                new = [red[j] for red in reduced]
+                old = st.get(key)
+                if old is None:
+                    st[key] = new
+                else:
+                    st[key] = self._merge(old, new)
+        return []
+
+    def _merge(self, a: list, b: list) -> list:
+        out = []
+        i = 0
+        for s in self.sites:
+            for base in _BASES[s.name]:
+                if base in ("sum", "count"):
+                    out.append(a[i] + b[i])
+                elif base == "min":
+                    out.append(min(a[i], b[i]))
+                else:
+                    out.append(max(a[i], b[i]))
+                i += 1
+        return out
+
+    def _site_values(self, batch: EventBatch) -> list:
+        vals = []
+        rows = None
+        for s in self.sites:
+            if s.name == "count" or s.arg_fn is None:
+                vals.append(np.ones(batch.n))
+            elif s.arg is not None:
+                vals.append(batch.columns[s.arg].astype(np.float64))
+            else:
+                if rows is None:
+                    rows = batch.rows(self.rt.strings)
+                names = self.in_schema.names
+                vals.append(np.fromiter(
+                    (float(s.arg_fn(dict(zip(names, r)))) for r in rows),
+                    dtype=np.float64, count=batch.n))
+        return vals
+
+    @staticmethod
+    def _int_view(col: np.ndarray) -> np.ndarray:
+        if col.dtype.kind in "iub":
+            return col.astype(np.int64)
+        if col.dtype.kind == "f":
+            v = col.astype(np.float64)
+            v = np.where(v == 0.0, 0.0, v)     # -0.0 keys with +0.0
+            return v.view(np.int64)            # exact bit key otherwise
+        raise PlanError("unsupported group-by column type")
+
+    @staticmethod
+    def _decode_gval(v, attr: str):
+        # unwrap numpy scalars for stable dict keys; string codes decode
+        # lazily in rows_between
+        return v.item() if isinstance(v, np.generic) else v
+
+    # -- query side (within/per selection) -----------------------------------
+
+    def rows_between(self, per: Duration, t0: Optional[int],
+                     t1: Optional[int]) -> list:
+        """Output rows [(bucket_start, env)] for buckets of `per` whose
+        start lies in [t0, t1)."""
+        if per not in self.store:
+            raise PlanError(
+                f"aggregation {self.ad.id!r}: per-duration {per.value!r} not "
+                f"in defined range {[d.value for d in self.durations]}")
+        out = []
+        for (start, gkey), bases in sorted(self.store[per].items()):
+            if t0 is not None and start < t0:
+                continue
+            if t1 is not None and start >= t1:
+                continue
+            env = {AGG_TIMESTAMP: start, "__timestamp__": start}
+            for a, v in zip(self.group_attrs, gkey):
+                if self.in_schema.type_of(a) == AttrType.STRING:
+                    v = self.rt.strings.decode(int(v))
+                env[a] = v
+            i = 0
+            for s in self.sites:
+                b = _BASES[s.name]
+                if s.name == "avg":
+                    sm, ct = bases[i], bases[i + 1]
+                    env[s.key] = (sm / ct) if ct else None
+                elif s.name == "count":
+                    env[s.key] = int(bases[i])
+                elif s.name in ("min", "max"):
+                    env[s.key] = self._cast(bases[i], s.in_type)
+                else:
+                    env[s.key] = self._cast(bases[i], s.out_type)
+                i += len(b)
+            row_env = dict(env)
+            row = [f(env) for f in self.out_fns]
+            for nm, v in zip(self.out_schema.names, row):
+                row_env[nm] = v
+            out.append((start, row_env, row))
+        return out
+
+    @staticmethod
+    def _cast(v: float, t: Optional[AttrType]):
+        if t in (AttrType.INT, AttrType.LONG):
+            return int(v)
+        return float(v)
+
+    # -- store-query support (reference: StoreQueryParser aggregation path) --
+
+    def compile_store_query(self, sq: ast.StoreQuery):
+        return _AggStoreExec(self, sq)
+
+    # -- snapshot ------------------------------------------------------------
+
+    def state_dict(self) -> dict:
+        return {"store": {d.value: {k: list(v) for k, v in s.items()}
+                          for d, s in self.store.items()}}
+
+    def load_state_dict(self, d: dict) -> None:
+        by_val = {x.value: x for x in Duration}
+        self.store = {by_val[dv]: {k: list(v) for k, v in s.items()}
+                      for dv, s in d["store"].items()}
+        for dur in self.durations:           # tolerate missing durations
+            self.store.setdefault(dur, {})
+
+
+# ---------------------------------------------------------------------------
+# within / per evaluation (shared by store queries and joins)
+# ---------------------------------------------------------------------------
+
+def parse_time_point(v) -> int:
+    """'2017-06-01 04:05:50' / epoch-ms long -> epoch ms (UTC)."""
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    if isinstance(v, str):
+        s = v.strip()
+        for fmt in ("%Y-%m-%d %H:%M:%S", "%Y-%m-%d %H:%M", "%Y-%m-%d"):
+            try:
+                t = _dt.datetime.strptime(s, fmt).replace(
+                    tzinfo=_dt.timezone.utc)
+                return int(t.timestamp() * 1000)
+            except ValueError:
+                continue
+    raise PlanError(f"cannot interpret time point {v!r}")
+
+
+def within_range_of(expr, value_fn_compiler, now_fn) -> Callable:
+    """Compile a `within` clause to env -> (t0, t1).
+
+    Forms: `within start, end` (two points), `within '2017-06-** ...'`
+    (wildcard pattern -> covered range), `within 1 day` (trailing window
+    ending now)."""
+    if expr is None:
+        return lambda env: (None, None)
+    if isinstance(expr, ast.FunctionCall) and expr.name == "withinRange":
+        f0 = value_fn_compiler(expr.args[0])
+        f1 = value_fn_compiler(expr.args[1])
+        return lambda env: (parse_time_point(f0(env)),
+                            parse_time_point(f1(env)))
+    if isinstance(expr, ast.TimeConstant):
+        ms = expr.millis
+        return lambda env: (now_fn() - ms, None)
+    f = value_fn_compiler(expr)
+
+    def rng(env):
+        v = f(env)
+        if isinstance(v, str) and "*" in v:
+            return _wildcard_range(v)
+        t0 = parse_time_point(v)
+        return (t0, None)
+    return rng
+
+
+def _wildcard_range(pat: str) -> tuple[int, int]:
+    """'2017-06-** **:**:**' -> (start, end) of the covered span, derived
+    component-wise: wildcards floor to their minimum for the start, and
+    the finest fully-specified component is incremented for the end."""
+    pat = pat.strip()
+    if len(pat) == 10:                  # date only
+        pat = pat + " **:**:**"
+    comps = _split_dt(pat)
+    lo_v = []
+    hi_v = []
+    mins = [1, 1, 1, 0, 0, 0]
+    for i, (c, mn) in enumerate(zip(comps, mins)):
+        if "*" in c:
+            lo_v.append(mn)
+            hi_v.append(None)
+        else:
+            lo_v.append(int(c))
+            hi_v.append(int(c))
+    start = _dt.datetime(lo_v[0], lo_v[1], lo_v[2], lo_v[3], lo_v[4],
+                         lo_v[5], tzinfo=_dt.timezone.utc)
+    # end: increment the finest fully-specified component
+    last_fixed = max(i for i, h in enumerate(hi_v) if h is not None)
+    end = start
+    if last_fixed == 0:
+        end = start.replace(year=start.year + 1)
+    elif last_fixed == 1:
+        end = (start.replace(day=1) + _dt.timedelta(days=32)).replace(day=1)
+    elif last_fixed == 2:
+        end = start + _dt.timedelta(days=1)
+    elif last_fixed == 3:
+        end = start + _dt.timedelta(hours=1)
+    elif last_fixed == 4:
+        end = start + _dt.timedelta(minutes=1)
+    else:
+        end = start + _dt.timedelta(seconds=1)
+    return int(start.timestamp() * 1000), int(end.timestamp() * 1000)
+
+
+def _split_dt(pat: str) -> list:
+    """'YYYY-MM-DD HH:MM:SS' -> 6 components."""
+    date, _, time = pat.partition(" ")
+    d = (date.split("-") + ["**", "**"])[:3]
+    t = (time.split(":") + ["**", "**", "**"])[:3] if time else ["**"] * 3
+    return d + t
+
+
+def per_duration_of(expr, ctx=None) -> Duration:
+    if isinstance(expr, ast.Constant):
+        return duration_of(str(expr.value))
+    if isinstance(expr, ast.Variable) and expr.stream_ref is None:
+        return duration_of(expr.attribute)
+    raise PlanError("per must be a constant duration like 'seconds'")
+
+
+class _AggStoreExec:
+    """`from A [on cond] within ... per ... select ...`"""
+
+    def __init__(self, agg: AggregationRuntime, sq: ast.StoreQuery):
+        from ..interp.expr import PyExprContext, compile_py
+        self.agg = agg
+        if sq.per is None:
+            raise PlanError("aggregation store query needs `per`")
+        self.per = per_duration_of(sq.per)
+        empty = PyExprContext({}, tables=agg.rt.tables)
+        self.within_fn = within_range_of(
+            sq.within, lambda e: compile_py(e, empty)[0],
+            lambda: agg.rt.now_ms())
+        octx = PyExprContext({agg.ad.id: agg.out_schema},
+                             default_ref=agg.ad.id, tables=agg.rt.tables)
+        on = None
+        for f in sq.input.filters:
+            on = f.expr if on is None else ast.And(on, f.expr)
+        self.cond = compile_py(on, octx)[0] if on is not None else None
+        sel = sq.selector
+        if sel.select_all:
+            self.sel_fns = None
+            self.out_schema = agg.out_schema
+        else:
+            extra = {a.name: (a.name, a.type)
+                     for a in agg.out_schema.attributes}
+            extra[AGG_TIMESTAMP] = (AGG_TIMESTAMP, AttrType.LONG)
+            sctx = PyExprContext({}, extra=extra, tables=agg.rt.tables)
+            self.sel_fns = []
+            names, types = [], []
+            for oa in sel.attributes:
+                f, t = compile_py(oa.expr, sctx)
+                self.sel_fns.append(f)
+                names.append(oa.name)
+                types.append(t)
+            self.out_schema = StreamSchema(f"#store_{agg.ad.id}", tuple(
+                ast.Attribute(n, t) for n, t in zip(names, types)))
+
+    def execute(self) -> list:
+        t0, t1 = self.within_fn({})
+        out = []
+        for start, row_env, row in self.agg.rows_between(self.per, t0, t1):
+            if self.cond is not None and not self.cond(row_env):
+                continue
+            if self.sel_fns is None:
+                out.append((start, tuple(row)))
+            else:
+                out.append((start, tuple(f(row_env) for f in self.sel_fns)))
+        return out

@@ -40,6 +40,15 @@ def build_app(rt) -> None:
     for tid, td in app.trigger_definitions.items():
         rt._register_plan(TriggerRuntime(rt, td))
 
+    from .aggregation import AggregationRuntime
+    for aid, ad in app.aggregation_definitions.items():
+        if aid in rt.schemas or aid in rt.tables:
+            raise PlanError(f"{aid!r} defined as both aggregation and "
+                            f"stream/table/window")
+        agg = AggregationRuntime(rt, ad)
+        rt.aggregations[aid] = agg
+        rt._register_plan(agg)
+
     for i, elem in enumerate(app.execution_elements):
         if isinstance(elem, ast.Query):
             plan = plan_query(rt, elem, default_name=f"query_{i}")
@@ -72,10 +81,53 @@ def attach_table_writer(rt, plan, q: ast.Query, name: str):
     return plan
 
 
+def _normalize_fault_inputs(node, rt, name: str):
+    """Rewrite every `!S` input reference (single streams, join sides,
+    pattern state elements) to the registered "!S" fault schema."""
+    import dataclasses
+    if isinstance(node, ast.SingleInputStream):
+        if not node.is_fault:
+            return node
+        fid = "!" + node.stream_id
+        if fid not in rt.schemas:
+            raise PlanError(f"query {name!r}: stream {node.stream_id!r} has "
+                            f"no fault stream; annotate it with "
+                            f"@OnError(action='stream')")
+        return dataclasses.replace(node, stream_id=fid, is_fault=False)
+    if isinstance(node, ast.JoinInputStream):
+        return dataclasses.replace(
+            node, left=_normalize_fault_inputs(node.left, rt, name),
+            right=_normalize_fault_inputs(node.right, rt, name))
+    if isinstance(node, ast.StateInputStream):
+        return dataclasses.replace(
+            node, state=_normalize_fault_inputs(node.state, rt, name))
+    if isinstance(node, (ast.StreamStateElement, ast.AbsentStreamStateElement)):
+        return dataclasses.replace(
+            node, stream=_normalize_fault_inputs(node.stream, rt, name))
+    if isinstance(node, ast.CountStateElement):
+        return dataclasses.replace(
+            node, stream=_normalize_fault_inputs(node.stream, rt, name))
+    if isinstance(node, ast.LogicalStateElement):
+        return dataclasses.replace(
+            node, left=_normalize_fault_inputs(node.left, rt, name),
+            right=_normalize_fault_inputs(node.right, rt, name))
+    if isinstance(node, ast.NextStateElement):
+        return dataclasses.replace(
+            node, state=_normalize_fault_inputs(node.state, rt, name),
+            next=_normalize_fault_inputs(node.next, rt, name))
+    if isinstance(node, ast.EveryStateElement):
+        return dataclasses.replace(
+            node, state=_normalize_fault_inputs(node.state, rt, name))
+    return node
+
+
 def plan_query(rt, q: ast.Query, default_name: str):
+    import dataclasses
     name = q.name(default_name)
     target = output_target_of(q)
-    inp = q.input
+    inp = _normalize_fault_inputs(q.input, rt, name)
+    if inp is not q.input:
+        q = dataclasses.replace(q, input=inp)
 
     if isinstance(inp, ast.SingleInputStream):
         if inp.stream_id in rt.tables:
@@ -110,9 +162,6 @@ def plan_query(rt, q: ast.Query, default_name: str):
             rt, InterpSingleQueryPlan(name, rt, q, inp, target), q, name)
 
     if isinstance(inp, ast.JoinInputStream):
-        if inp.per is not None or inp.within is not None:
-            raise PlanError(f"query {name!r}: aggregation joins "
-                            f"(within/per) not yet supported")
         from ..interp.joins import InterpJoinQueryPlan
         return attach_table_writer(
             rt, InterpJoinQueryPlan(name, rt, q, inp, target), q, name)

@@ -1,0 +1,334 @@
+"""Sources, sinks, and mappers — the transport SPI.
+
+Reference: core:stream/input/source/Source.java:42 (lifecycle +
+connectWithRetry), SourceMapper.java:193, core:stream/output/sink/Sink.java,
+SinkMapper, InMemorySource.java:115 / InMemorySink over the topic bus
+core:util/transport/InMemoryBroker.java:121, exponential backoff
+core:util/transport/BackoffRetryCounter.java:24.
+
+Differences by design: mappers translate between wire payloads and columnar
+rows (lists of tuples), not pooled event objects; a source delivers a whole
+message as one micro-batch.  Extension points are plain registries
+(`register_source_type` / `register_sink_type` / `register_*_mapper`) —
+the Python analog of `@Extension` classpath scanning.
+"""
+from __future__ import annotations
+
+import json
+import time
+import warnings
+from collections import defaultdict
+from typing import Callable, Optional
+
+from ..query import ast
+from .planner import PlanError
+
+
+# ---------------------------------------------------------------------------
+# in-memory topic bus (reference: InMemoryBroker.java:121)
+# ---------------------------------------------------------------------------
+
+class InMemoryBroker:
+    _subs: dict = defaultdict(list)     # topic -> [subscriber fn]
+
+    @classmethod
+    def publish(cls, topic: str, message) -> None:
+        for fn in list(cls._subs.get(topic, ())):
+            fn(message)
+
+    @classmethod
+    def subscribe(cls, topic: str, fn: Callable) -> Callable:
+        cls._subs[topic].append(fn)
+        return fn
+
+    @classmethod
+    def unsubscribe(cls, topic: str, fn: Callable) -> None:
+        try:
+            cls._subs[topic].remove(fn)
+        except ValueError:
+            pass
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._subs.clear()
+
+
+# ---------------------------------------------------------------------------
+# mappers
+# ---------------------------------------------------------------------------
+
+class SourceMapper:
+    """wire message -> list of (timestamp|None, row_tuple)."""
+
+    def __init__(self, schema, options: dict):
+        self.schema = schema
+        self.options = options
+
+    def map(self, message) -> list:
+        raise NotImplementedError
+
+
+class PassThroughSourceMapper(SourceMapper):
+    """Message is a row tuple, a list of row tuples, or an Event
+    (reference: PassThroughSourceMapper.java:80)."""
+
+    def map(self, message) -> list:
+        from .runtime import Event
+        if isinstance(message, Event):
+            return [(message.timestamp, message.data)]
+        if isinstance(message, tuple):
+            return [(None, message)]
+        if isinstance(message, list):
+            out = []
+            for m in message:
+                if isinstance(m, Event):
+                    out.append((m.timestamp, m.data))
+                else:
+                    out.append((None, tuple(m)))
+            return out
+        raise ValueError(f"passThrough mapper: bad message {message!r}")
+
+
+class JsonSourceMapper(SourceMapper):
+    """`{"event": {attr: value, ...}}` (or a JSON list of such), matching
+    the reference json mapper's default template."""
+
+    def map(self, message) -> list:
+        if isinstance(message, (str, bytes)):
+            message = json.loads(message)
+        msgs = message if isinstance(message, list) else [message]
+        names = self.schema.names
+        out = []
+        for m in msgs:
+            body = m.get("event", m) if isinstance(m, dict) else m
+            out.append((None, tuple(body.get(n) for n in names)))
+        return out
+
+
+class SinkMapper:
+    """events -> wire payloads (one per event)."""
+
+    def __init__(self, schema, options: dict):
+        self.schema = schema
+        self.options = options
+
+    def map(self, events: list) -> list:
+        raise NotImplementedError
+
+
+class PassThroughSinkMapper(SinkMapper):
+    def map(self, events: list) -> list:
+        return [e.data for e in events]
+
+
+class JsonSinkMapper(SinkMapper):
+    def map(self, events: list) -> list:
+        names = self.schema.names
+        return [json.dumps({"event": dict(zip(names, e.data))}) for e in events]
+
+
+SOURCE_MAPPERS: dict = {"passthrough": PassThroughSourceMapper,
+                        "json": JsonSourceMapper}
+SINK_MAPPERS: dict = {"passthrough": PassThroughSinkMapper,
+                      "json": JsonSinkMapper}
+
+
+def register_source_mapper(name: str, cls) -> None:
+    SOURCE_MAPPERS[name.lower()] = cls
+
+
+def register_sink_mapper(name: str, cls) -> None:
+    SINK_MAPPERS[name.lower()] = cls
+
+
+# ---------------------------------------------------------------------------
+# sources
+# ---------------------------------------------------------------------------
+
+class Source:
+    """Transport lifecycle (reference: Source.java:42).  Subclasses
+    implement connect/disconnect; incoming payloads go through
+    `self.deliver(message)`."""
+
+    def __init__(self, rt, stream_id: str, options: dict,
+                 mapper: SourceMapper):
+        self.rt = rt
+        self.stream_id = stream_id
+        self.options = options
+        self.mapper = mapper
+        self.connected = False
+
+    # -- SPI -----------------------------------------------------------------
+
+    def connect(self) -> None:
+        raise NotImplementedError
+
+    def disconnect(self) -> None:
+        pass
+
+    # -- runtime glue --------------------------------------------------------
+
+    def deliver(self, message) -> None:
+        """Map a wire message and feed it as one micro-batch."""
+        try:
+            rows = self.mapper.map(message)
+        except Exception as e:
+            self.rt._route_fault_rows(self.stream_id, [], f"map error: {e}",
+                                      raw=message)
+            return
+        with self.rt._lock:
+            for ts, row in rows:
+                self.rt._send_locked(self.stream_id, row, ts)
+            self.rt.flush()
+
+    def connect_with_retry(self, max_tries: int = 5,
+                           base_delay_s: float = 0.05) -> None:
+        """Exponential-backoff connect (reference:
+        Source.connectWithRetry + BackoffRetryCounter)."""
+        delay = base_delay_s
+        for attempt in range(max_tries):
+            try:
+                self.connect()
+                self.connected = True
+                return
+            except Exception as e:
+                if attempt == max_tries - 1:
+                    raise
+                warnings.warn(f"source {type(self).__name__} on "
+                              f"{self.stream_id!r}: connect failed ({e}); "
+                              f"retrying in {delay:.2f}s", RuntimeWarning)
+                time.sleep(delay)
+                delay *= 2
+
+
+class InMemorySource(Source):
+    """@source(type='inMemory', topic='t') (reference: InMemorySource.java:115)."""
+
+    def connect(self) -> None:
+        topic = self.options.get("topic")
+        if not topic:
+            raise PlanError("inMemory source needs a topic")
+        self._fn = InMemoryBroker.subscribe(topic, self.deliver)
+
+    def disconnect(self) -> None:
+        if self.connected:
+            InMemoryBroker.unsubscribe(self.options.get("topic"), self._fn)
+
+
+class CallbackSource(Source):
+    """@source(type='callback'): a programmatic ingress handle —
+    `rt.sources_for(stream)[0].deliver(msg)`; useful for tests and
+    embedding."""
+
+    def connect(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# sinks
+# ---------------------------------------------------------------------------
+
+class Sink:
+    def __init__(self, rt, stream_id: str, options: dict, mapper: SinkMapper):
+        self.rt = rt
+        self.stream_id = stream_id
+        self.options = options
+        self.mapper = mapper
+        self.connected = False
+
+    def connect(self) -> None:
+        raise NotImplementedError
+
+    def disconnect(self) -> None:
+        pass
+
+    def publish(self, payload) -> None:
+        raise NotImplementedError
+
+    def on_events(self, events: list) -> None:
+        for payload in self.mapper.map(events):
+            self.publish(payload)
+
+
+class InMemorySink(Sink):
+    def connect(self) -> None:
+        if not self.options.get("topic"):
+            raise PlanError("inMemory sink needs a topic")
+
+    def publish(self, payload) -> None:
+        InMemoryBroker.publish(self.options["topic"], payload)
+
+
+class LogSink(Sink):
+    """@sink(type='log') — prints events (reference: log sink extension)."""
+
+    def connect(self) -> None:
+        pass
+
+    def publish(self, payload) -> None:
+        print(f"[{self.options.get('prefix', self.stream_id)}] {payload}")
+
+
+SOURCE_TYPES: dict = {"inmemory": InMemorySource, "callback": CallbackSource}
+SINK_TYPES: dict = {"inmemory": InMemorySink, "log": LogSink}
+
+
+def register_source_type(name: str, cls) -> None:
+    SOURCE_TYPES[name.lower()] = cls
+
+
+def register_sink_type(name: str, cls) -> None:
+    SINK_TYPES[name.lower()] = cls
+
+
+# ---------------------------------------------------------------------------
+# wiring from @source/@sink annotations
+# (reference: DefinitionParserHelper.addEventSource/addEventSink:309-433)
+# ---------------------------------------------------------------------------
+
+def _ann_options(a: ast.Annotation) -> dict:
+    return {(k.lower() if k else f"_{i}"): v
+            for i, (k, v) in enumerate(a.elements)}
+
+
+def build_io(rt) -> None:
+    """Instantiate sources/sinks declared on stream definitions."""
+    from ..query.ast import find_annotation
+    for sid, sd in rt.app.stream_definitions.items():
+        for a in sd.annotations:
+            nm = a.name.lower()
+            if nm == "source":
+                opts = _ann_options(a)
+                typ = opts.get("type", "").lower()
+                cls = SOURCE_TYPES.get(typ)
+                if cls is None:
+                    raise PlanError(f"unknown source type {typ!r} on "
+                                    f"{sid!r}; have {sorted(SOURCE_TYPES)}")
+                mapper = _mapper_of(a, rt.schemas[sid], SOURCE_MAPPERS,
+                                    PassThroughSourceMapper)
+                rt.sources.append(cls(rt, sid, opts, mapper))
+            elif nm == "sink":
+                opts = _ann_options(a)
+                typ = opts.get("type", "").lower()
+                cls = SINK_TYPES.get(typ)
+                if cls is None:
+                    raise PlanError(f"unknown sink type {typ!r} on "
+                                    f"{sid!r}; have {sorted(SINK_TYPES)}")
+                mapper = _mapper_of(a, rt.schemas[sid], SINK_MAPPERS,
+                                    PassThroughSinkMapper)
+                sink = cls(rt, sid, opts, mapper)
+                rt.sinks.append(sink)
+                rt._stream_callbacks[sid].append(sink.on_events)
+
+
+def _mapper_of(a: ast.Annotation, schema, registry: dict, default_cls):
+    from ..query.ast import find_annotation
+    m = find_annotation(a.annotations, "map")
+    if m is None:
+        return default_cls(schema, {})
+    opts = _ann_options(m)
+    typ = opts.get("type", "passThrough").lower()
+    cls = registry.get(typ)
+    if cls is None:
+        raise PlanError(f"unknown mapper type {typ!r}; have {sorted(registry)}")
+    return cls(schema, opts)

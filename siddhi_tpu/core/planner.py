@@ -238,6 +238,8 @@ class FilterProjectPlan(QueryPlan):
 
 def output_target_of(q: ast.Query) -> Optional[str]:
     if isinstance(q.output, ast.InsertInto):
+        if q.output.is_fault:
+            return "!" + q.output.target
         return q.output.target
     if isinstance(q.output, ast.ReturnAction):
         return None

@@ -84,6 +84,18 @@ class SiddhiAppRuntime:
         self.tables: dict = {}
         self.named_windows: dict = {}
         self.aggregations: dict = {}
+        self.sources: list = []
+        self.sinks: list = []
+
+        # @OnError(action='stream') fault streams: schema = original attrs +
+        # _error string, registered under "!<id>" (reference:
+        # StreamJunction.java:77-103 fault-stream routing)
+        for sid, sd in list(app.stream_definitions.items()):
+            oe = qast.find_annotation(sd.annotations, "onerror")
+            if oe is not None and (oe.element("action") or "stream").lower() == "stream":
+                self.schemas["!" + sid] = StreamSchema(
+                    "!" + sid, tuple(sd.attributes) + (
+                        qast.Attribute("_error", qast.AttrType.STRING),))
 
         self._plans: list[QueryPlan] = []
         self._subscribers: dict = defaultdict(list)   # stream_id -> [plan]
@@ -105,13 +117,22 @@ class SiddhiAppRuntime:
         self._sched_thread = None
         self._sched_stop = None
 
+        from .stats import StatisticsManager
+        self.stats = StatisticsManager(self)
+        sa = qast.find_annotation(app.annotations, "app:statistics")
+        if sa is not None and (sa.element() or "true").lower() != "false":
+            self.stats.enabled = True
+        self._debugger = None
+
         self._build()
 
     # -- construction --------------------------------------------------------
 
     def _build(self) -> None:
         from . import build as _build_mod
+        from .io import build_io
         _build_mod.build_app(self)
+        build_io(self)
 
     def _register_plan(self, plan: QueryPlan) -> None:
         self._plans.append(plan)
@@ -179,6 +200,13 @@ class SiddhiAppRuntime:
                     for ob in p.fire_start(now):
                         self._emit(p, ob)
             self._drain()
+        for s in self.sources:
+            if not s.connected:
+                s.connect_with_retry()
+        for s in self.sinks:
+            if not s.connected:
+                s.connect()
+                s.connected = True
         if not self._playback:
             self._start_scheduler()
 
@@ -227,7 +255,28 @@ class SiddhiAppRuntime:
             self.flush()
             return exec_.execute()
 
+    def sources_for(self, stream_id: str) -> list:
+        return [s for s in self.sources if s.stream_id == stream_id]
+
+    def enable_stats(self, on: bool = True) -> None:
+        """Runtime statistics toggle (reference: SiddhiAppRuntime.enableStats:763)."""
+        self.stats.enabled = on
+
+    def statistics(self) -> dict:
+        return self.stats.report()
+
+    def debug(self):
+        """Attach the step debugger (reference: SiddhiAppRuntime.debug:575)."""
+        from .stats import SiddhiDebugger
+        if self._debugger is None:
+            self._debugger = SiddhiDebugger(self)
+        return self._debugger
+
     def shutdown(self) -> None:
+        for s in (*self.sources, *self.sinks):
+            if s.connected:
+                s.disconnect()
+                s.connected = False
         if self._sched_stop is not None:
             self._sched_stop.set()
             self._sched_thread.join(timeout=2)
@@ -347,13 +396,72 @@ class SiddhiAppRuntime:
                 if not self._pending:
                     continue
             sid, batch = self._pending.pop(0)
+            if self.stats.enabled:
+                self.stats.on_stream_batch(sid, batch.n)
             for cb in self._batch_callbacks.get(sid, ()):
                 cb(batch)
             for cb in self._stream_callbacks.get(sid, ()):  # junction callbacks
                 cb(self._decode(batch))
+            fault_err = None
             for plan in self._subscribers.get(sid, ()):
-                for ob in plan.process(sid, batch):
+                if self._debugger is not None:
+                    self._debugger.check_in(plan, batch)
+                try:
+                    if self.stats.enabled:
+                        with self.stats.time_plan(plan.name, batch.n):
+                            obs = plan.process(sid, batch)
+                    else:
+                        obs = plan.process(sid, batch)
+                except Exception as e:
+                    if ("!" + sid) not in self.schemas:
+                        raise
+                    fault_err = e        # route once per batch, below
+                    continue
+                if self._debugger is not None:
+                    self._debugger.check_out(plan, obs)
+                for ob in obs:
                     self._emit(plan, ob)
+            if fault_err is not None:
+                self._route_fault_batch(sid, batch, fault_err)
+
+    def _route_fault_batch(self, sid: str, batch: EventBatch, err) -> bool:
+        """@OnError(action='stream'): reroute a failing batch's events into
+        `!sid` with the error message (reference: StreamJunction fault
+        routing via FaultStreamEventConverter)."""
+        fault_id = "!" + sid
+        fs = self.schemas.get(fault_id)
+        if fs is None:
+            return False
+        msg = f"{type(err).__name__}: {err}"
+        bb = BatchBuilder(fs, self.strings)
+        for ts, row in zip(batch.timestamps, batch.rows(self.strings)):
+            bb.append(int(ts), (*row, msg), self._seq + 1)
+            self._seq += 1
+        self._pending.append((fault_id, bb.freeze()))
+        return True
+
+    def _route_fault_rows(self, sid: str, rows: list, msg: str,
+                          raw=None) -> None:
+        """Fault entry for errors before decoding (source mapper failures):
+        attributes are null, `_error` carries the message."""
+        fault_id = "!" + sid
+        fs = self.schemas.get(fault_id)
+        if fs is None:
+            raise RuntimeError(f"{sid}: {msg} (no @OnError fault stream)")
+        with self._lock:
+            bb = BatchBuilder(fs, self.strings)
+            n_attrs = len(fs.attributes) - 1
+            def nseq() -> int:
+                self._seq += 1
+                return self._seq
+            if rows:
+                for ts, row in rows:
+                    bb.append(self.now_ms() if ts is None else ts,
+                              (*row, msg), nseq())
+            else:
+                bb.append(self.now_ms(), (*([None] * n_attrs), msg), nseq())
+            self._pending.append((fault_id, bb.freeze()))
+            self._drain()
 
     def _emit(self, plan: QueryPlan, ob: OutputBatch) -> None:
         if ob.batch.n == 0 and not ob.is_signal:

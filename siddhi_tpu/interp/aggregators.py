@@ -310,3 +310,12 @@ def make_aggregator(name: str, in_type: Optional[AttrType]) -> Aggregator:
 
 def aggregator_out_type(name: str, in_type: Optional[AttrType]) -> AttrType:
     return make_aggregator(name, in_type).type
+
+
+def register_aggregator(name: str, cls) -> None:
+    """Extension point: a custom attribute aggregator class (ctor takes
+    in_type; implements add/remove/reset/value/state/restore — the
+    reference's @Extension AttributeAggregator protocol)."""
+    from ..core.planner import AGGREGATOR_NAMES
+    AGGREGATOR_CLASSES[name.lower()] = cls
+    AGGREGATOR_NAMES.add(name.lower())

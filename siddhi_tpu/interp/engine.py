@@ -414,7 +414,78 @@ def make_window(h: ast.WindowHandler, ctx: PyExprContext,
         return W.LossyFrequentWindow(float(_const(args[0])), err, key)
     if name == "cron":
         return W.CronWindow(str(_const(args[0])))
+    builder = WINDOW_TYPES.get((h.namespace.lower() if h.namespace else None,
+                                name))
+    if builder is not None:
+        return builder(args, ctx, schema)
     raise PlanError(f"unknown window type {h.name!r}")
+
+
+# extension point: custom window processors (reference: @Extension windows
+# discovered by SiddhiExtensionLoader; here an explicit registry)
+WINDOW_TYPES: dict = {}
+
+
+def register_window_type(name: str, builder, namespace: str = None) -> None:
+    """builder(args: tuple[ast expr], ctx: PyExprContext, schema) -> Window"""
+    WINDOW_TYPES[(namespace.lower() if namespace else None,
+                  name.lower())] = builder
+
+
+# ---------------------------------------------------------------------------
+# stream functions (reference: core:query/processor/stream/
+# LogStreamProcessor.java, Pol2CartStreamProcessor; extension point ≅
+# @Extension StreamFunctionProcessor)
+# ---------------------------------------------------------------------------
+
+STREAM_FUNCTIONS: dict = {}
+
+
+def register_stream_function(name: str, builder, namespace: str = None) -> None:
+    """builder(args, ctx, in_schema, query_name) ->
+    (out_schema, fn(Event) -> list[row_tuple])"""
+    STREAM_FUNCTIONS[(namespace.lower() if namespace else None,
+                      name.lower())] = builder
+
+
+def _log_stream_fn(args, ctx, in_schema, query_name):
+    msg_fns = [compile_py(a, ctx)[0] for a in args]
+    names = in_schema.names
+
+    def fn(ev: Event) -> list:
+        env = dict(zip(names, ev.data))
+        env["__timestamp__"] = ev.timestamp
+        extra = ", ".join(str(f(env)) for f in msg_fns)
+        prefix = f"{query_name}: " + (f"{extra}, " if extra else "")
+        print(f"{prefix}{ev.timestamp}, {ev.data}")
+        return [ev.data]
+    return in_schema, fn
+
+
+def _pol2cart_stream_fn(args, ctx, in_schema, query_name):
+    import math as _m
+    theta_f = compile_py(args[0], ctx)[0]
+    rho_f = compile_py(args[1], ctx)[0]
+    z_f = compile_py(args[2], ctx)[0] if len(args) > 2 else None
+    names = in_schema.names
+    extra = (ast.Attribute("x", AttrType.DOUBLE),
+             ast.Attribute("y", AttrType.DOUBLE)) + (
+        (ast.Attribute("z", AttrType.DOUBLE),) if z_f else ())
+    out_schema = StreamSchema(in_schema.id, in_schema.attributes + extra)
+
+    def fn(ev: Event) -> list:
+        env = dict(zip(names, ev.data))
+        env["__timestamp__"] = ev.timestamp
+        theta, rho = theta_f(env), rho_f(env)
+        x = rho * _m.cos(_m.radians(theta))
+        y = rho * _m.sin(_m.radians(theta))
+        row = ev.data + ((x, y, z_f(env)) if z_f else (x, y))
+        return [row]
+    return out_schema, fn
+
+
+register_stream_function("log", _log_stream_fn)
+register_stream_function("pol2cart", _pol2cart_stream_fn)
 
 
 # ---------------------------------------------------------------------------
@@ -451,21 +522,38 @@ class InterpSingleQueryPlan(QueryPlan):
                             default_ref=inp.alias, tables=rt.tables)
         self.ctx = ctx
         self.filters = [compile_py(f.expr, ctx)[0] for f in inp.filters]
+        # stream functions chain (reference: StreamFunctionProcessor
+        # subclasses; extension point instead of hardcoded built-ins).
+        # Filters apply first, then stream functions in handler order.
+        self._stream_fns: list = []
+        work_schema = schema
         for h in inp.handlers:
             if isinstance(h, ast.StreamFunction):
-                if (h.namespace, h.name.lower()) != (None, "log"):
-                    raise PlanError(f"query {name!r}: stream function "
-                                    f"{h.name!r} not supported")
-        self._log = any(isinstance(h, ast.StreamFunction) and
-                        h.name.lower() == "log" for h in inp.handlers)
+                key = (h.namespace.lower() if h.namespace else None,
+                       h.name.lower())
+                builder = STREAM_FUNCTIONS.get(key)
+                if builder is None:
+                    raise PlanError(f"query {name!r}: unknown stream function "
+                                    f"{h.name!r}")
+                hctx = PyExprContext({inp.alias: work_schema,
+                                      inp.stream_id: work_schema},
+                                     default_ref=inp.alias, tables=rt.tables)
+                work_schema, fn = builder(h.args, hctx, work_schema, name)
+                self._stream_fns.append(fn)
+        self.work_schema = work_schema
+        sctx = ctx if work_schema is schema else PyExprContext(
+            {inp.alias: work_schema, inp.stream_id: work_schema},
+            default_ref=inp.alias, tables=rt.tables)
         self.window: Optional[W.Window] = None
         wh = inp.window
         if wh is not None:
-            self.window = make_window(wh, ctx, schema)
-        self.sel = InterpSelector(q.selector, ctx, schema, target or f"#{name}")
+            self.window = make_window(wh, sctx, work_schema)
+        self.sel = InterpSelector(q.selector, sctx, work_schema,
+                                  target or f"#{name}")
         self.out_schema = self.sel.out_schema
         self.rate = make_rate_limiter(q.rate)
-        self._names = schema.names
+        self._names = work_schema.names
+        self._in_names = schema.names
 
     # -- helpers -------------------------------------------------------------
 
@@ -529,16 +617,19 @@ class InterpSingleQueryPlan(QueryPlan):
         emitted: list = []
         for ts, row in zip(batch.timestamps, rows):
             ev = Event(int(ts), row)
-            env = self._env_of(ev)
+            env = dict(zip(self._in_names, ev.data))
+            env["__timestamp__"] = ev.timestamp
             if any(not f(env) for f in self.filters):
                 continue
-            if self._log:
-                print(f"{self.name}: {ev.timestamp}, {ev.data}")
+            evs = [ev]
+            for fn in self._stream_fns:
+                evs = [Event(e.timestamp, r) for e in evs for r in fn(e)]
             now = self.rt.now_ms() if not self.rt._playback else ev.timestamp
-            if self.window is None:
-                emitted.append((kind, ev))
-            else:
-                emitted.extend(self.window.process(ev, now))
+            for e2 in evs:
+                if self.window is None:
+                    emitted.append((kind, e2))
+                else:
+                    emitted.extend(self.window.process(e2, now))
         if isinstance(self.window, W.BatchWindow):
             emitted.extend(self.window.end_chunk(self.rt.now_ms()))
         out_rows = self._run_selector(emitted)

@@ -151,10 +151,40 @@ class TableJoinSide:
         pass
 
 
+class AggregationJoinSide:
+    """An incremental aggregation in a join: `from S join A on ...
+    within t1, t2 per 'seconds'` (reference: AggregateWindowProcessor +
+    IncrementalAggregateCompileCondition.java:277).  The stream side's
+    arrivals select bucket rows at `per` granularity inside `within`."""
+
+    is_table = True        # never triggers; no retained state
+
+    def __init__(self, inp: ast.SingleInputStream, rt, agg):
+        if inp.window is not None or inp.filters or inp.handlers:
+            raise PlanError(f"join: aggregation {inp.stream_id!r} side "
+                            f"cannot have windows/filters")
+        self.ref = inp.alias
+        self.stream_id = inp.stream_id
+        self.agg = agg
+        self.schema = agg.out_schema
+
+    def on_timer(self, now_ms: int) -> None:
+        pass
+
+    def next_wakeup(self):
+        return None
+
+    def state(self) -> dict:
+        return {}
+
+    def restore(self, st: dict) -> None:
+        pass
+
+
 class InterpJoinQueryPlan(QueryPlan):
     """`from A#win as a join B#win as b on a.x == b.y select ...`
     Either side may be a table (probed via its index-aware compiled
-    condition instead of a retained window list)."""
+    condition) or an incremental aggregation (within/per bucket rows)."""
 
     def __init__(self, name: str, rt, q: ast.Query,
                  inp: ast.JoinInputStream, target: Optional[str]):
@@ -169,6 +199,9 @@ class InterpJoinQueryPlan(QueryPlan):
         def side_of(sinp):
             if sinp.stream_id in rt.tables:
                 return TableJoinSide(sinp, rt, rt.tables[sinp.stream_id])
+            if sinp.stream_id in rt.aggregations:
+                return AggregationJoinSide(sinp, rt,
+                                           rt.aggregations[sinp.stream_id])
             return JoinSide(sinp, rt)
 
         self.left = side_of(inp.left)
@@ -176,15 +209,15 @@ class InterpJoinQueryPlan(QueryPlan):
         if self.left.ref == self.right.ref:
             raise PlanError(f"join {name!r}: both sides named "
                             f"{self.left.ref!r}; alias one with `as`")
-        left_t = isinstance(self.left, TableJoinSide)
-        right_t = isinstance(self.right, TableJoinSide)
+        left_t = isinstance(self.left, (TableJoinSide, AggregationJoinSide))
+        right_t = isinstance(self.right, (TableJoinSide, AggregationJoinSide))
         if left_t and right_t:
-            raise PlanError(f"join {name!r}: cannot join two tables in a "
+            raise PlanError(f"join {name!r}: cannot join two stores in a "
                             f"streaming query; use a store query")
         self.join_type = inp.join_type
         self.trigger = inp.trigger       # "all" | "left" | "right"
-        # a table never triggers output (reference: table joins are
-        # implicitly unidirectional from the stream side)
+        # a table/aggregation never triggers output (reference: implicitly
+        # unidirectional from the stream side)
         if left_t:
             self.trigger = "right"
         elif right_t:
@@ -196,21 +229,40 @@ class InterpJoinQueryPlan(QueryPlan):
         # index-aware probe plan for the table side (reference:
         # CollectionExpressionParser compiled condition)
         self.table_cond = None
-        if left_t or right_t:
-            tside = self.left if left_t else self.right
+        self.agg_per = None
+        self.agg_within = None
+        store_side = self.left if left_t else self.right if right_t else None
+        if isinstance(store_side, TableJoinSide):
             sside = self.right if left_t else self.left
             sctx = PyExprContext({sside.ref: sside.schema,
                                   sside.stream_id: sside.schema},
                                  default_ref=sside.ref, tables=rt.tables)
             self.table_cond = compile_table_condition(
-                inp.on, tside.table, (tside.ref, tside.stream_id), sctx)
+                inp.on, store_side.table, (store_side.ref, store_side.stream_id),
+                sctx)
+        if isinstance(store_side, AggregationJoinSide):
+            from ..core.aggregation import per_duration_of, within_range_of
+            if inp.per is None:
+                raise PlanError(f"join {name!r}: aggregation join needs "
+                                f"`per '<duration>'`")
+            self.agg_per = per_duration_of(inp.per)
+            sside = self.right if left_t else self.left
+            sctx = PyExprContext({sside.ref: sside.schema,
+                                  sside.stream_id: sside.schema},
+                                 default_ref=sside.ref, tables=rt.tables)
+            self.agg_within = within_range_of(
+                inp.within, lambda e: compile_py(e, sctx)[0],
+                lambda: rt.now_ms())
+        elif inp.per is not None or inp.within is not None:
+            raise PlanError(f"query {name!r}: within/per only apply to "
+                            f"aggregation joins")
         self.sel = InterpSelector(_join_selector(q.selector, self), ctx,
                                   None, target or f"#{name}")
         self.out_schema = self.sel.out_schema
         self.rate = make_rate_limiter(q.rate)
         self.input_streams = tuple(
             {s.stream_id for s in (self.left, self.right)
-             if not isinstance(s, TableJoinSide)})
+             if not getattr(s, "is_table", False)})
         self._buffer: list = []          # (seq, stream_id, Event)
 
     # -- QueryPlan interface -------------------------------------------------
@@ -264,6 +316,23 @@ class InterpJoinQueryPlan(QueryPlan):
             for i in idx:
                 env = dict(base)
                 env.update(other.table.row_env(int(i), (other.ref,)))
+                matched = True
+                row = self.sel.process(CURRENT, env)
+                if row is not None:
+                    rows.append((CURRENT, ev.timestamp, row))
+            return rows + self._outer_miss(side, other, side_name, base, matched)
+        if isinstance(other, AggregationJoinSide):
+            t0, t1 = self.agg_within(side.env_of(ev))
+            names = other.schema.names
+            from ..core.aggregation import AGG_TIMESTAMP
+            for start, _renv, arow in other.agg.rows_between(
+                    self.agg_per, t0, t1):
+                env = dict(base)
+                for n, v in zip(names, arow):
+                    env[f"{other.ref}.{n}"] = v
+                env[f"{other.ref}.{AGG_TIMESTAMP}"] = start
+                if self.on is not None and not self.on(env):
+                    continue
                 matched = True
                 row = self.sel.process(CURRENT, env)
                 if row is not None:

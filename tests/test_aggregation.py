@@ -1,0 +1,153 @@
+"""Incremental (multi-granularity) aggregation (reference test surface:
+modules/siddhi-core/src/test/java/org/wso2/siddhi/core/aggregation/
+AggregationTestCase — define aggregation, within/per store queries and
+joins, restart continuity)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.planner import PlanError
+
+H = 3_600_000
+MIN = 60_000
+
+
+@pytest.fixture
+def mgr():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+APP = """
+    define stream Trades (sym string, price double, vol long, ts long);
+    define aggregation TradeAgg
+      from Trades
+      select sym, sum(price) as total, avg(price) as avgPrice,
+             count() as n, min(price) as lo, max(price) as hi
+      group by sym
+      aggregate by ts every sec, min, hour;
+"""
+
+
+def _feed(rt):
+    h = rt.input_handler("Trades")
+    # two seconds, two symbols
+    h.send([("A", 10.0, 1, 1000), ("A", 20.0, 1, 1400),
+            ("B", 5.0, 1, 1900), ("A", 30.0, 1, 2100),
+            ("B", 7.0, 1, 2500)])
+    rt.flush()
+
+
+def test_store_query_per_seconds(mgr):
+    rt = mgr.create_app_runtime(APP)
+    _feed(rt)
+    rows = rt.query("from TradeAgg within 0L, 100000L per 'seconds' "
+                    "select sym, total, n")
+    got = sorted((t, r) for t, r in rows)
+    assert got == [(1000, ("A", 30.0, 2)), (1000, ("B", 5.0, 1)),
+                   (2000, ("A", 30.0, 1)), (2000, ("B", 7.0, 1))]
+
+
+def test_store_query_per_minutes_rolls_up(mgr):
+    rt = mgr.create_app_runtime(APP)
+    _feed(rt)
+    rows = rt.query("from TradeAgg within 0L, 100000L per 'minutes' "
+                    "select sym, total, avgPrice, lo, hi")
+    got = sorted(r for _t, r in rows)
+    assert got == [("A", 60.0, 20.0, 10.0, 30.0), ("B", 12.0, 6.0, 5.0, 7.0)]
+
+
+def test_store_query_on_condition(mgr):
+    rt = mgr.create_app_runtime(APP)
+    _feed(rt)
+    rows = rt.query("from TradeAgg on sym == 'A' within 0L, 100000L "
+                    "per 'minutes' select sym, n")
+    assert [r for _t, r in rows] == [("A", 3)]
+
+
+def test_within_bounds_filter_buckets(mgr):
+    rt = mgr.create_app_runtime(APP)
+    _feed(rt)
+    rows = rt.query("from TradeAgg within 2000L, 3000L per 'seconds' "
+                    "select sym, total")
+    assert sorted(r for _t, r in rows) == [("A", 30.0), ("B", 7.0)]
+
+
+def test_aggregation_join(mgr):
+    rt = mgr.create_app_runtime(APP + """
+        define stream Probe (sym string);
+        from Probe as p join TradeAgg as a
+          on a.sym == p.sym
+          within 0L, 100000L per 'minutes'
+          select p.sym as sym, a.total as total
+          insert into O;
+    """)
+    out = []
+    rt.add_callback("O", lambda evs: out.extend(e.data for e in evs))
+    _feed(rt)
+    rt.input_handler("Probe").send(("A",))
+    rt.flush()
+    assert out == [("A", 60.0)]
+
+
+def test_aggregation_snapshot_restore(mgr):
+    rt = mgr.create_app_runtime(APP)
+    _feed(rt)
+    snap = rt.snapshot()
+
+    m2 = SiddhiManager()
+    rt2 = m2.create_app_runtime(APP)
+    rt2.restore(snap)
+    # continuity: keep aggregating into the same buckets
+    rt2.input_handler("Trades").send(("A", 40.0, 1, 2600))
+    rt2.flush()
+    rows = rt2.query("from TradeAgg on sym == 'A' within 0L, 100000L "
+                     "per 'minutes' select total, n")
+    assert [r for _t, r in rows] == [(100.0, 4)]
+    m2.shutdown()
+
+
+def test_arrival_time_when_no_aggregate_by(mgr):
+    rt = mgr.create_app_runtime("""
+        @app:playback
+        define stream S (x int);
+        define aggregation A from S select sum(x) as s every sec;
+    """)
+    h = rt.input_handler("S")
+    h.send((1,), timestamp=1000)
+    h.send((2,), timestamp=1500)
+    h.send((3,), timestamp=2200)
+    rt.flush()
+    rows = rt.query("from A within 0L, 10000L per 'seconds' select s")
+    assert [(t, r) for t, r in rows] == [(1000, (3,)), (2000, (3,))]
+
+
+def test_unsupported_incremental_aggregator_rejected(mgr):
+    with pytest.raises(PlanError):
+        mgr.create_app_runtime("""
+            define stream S (x int);
+            define aggregation A from S select distinctCount(x) as d every sec;
+        """)
+
+
+def test_per_outside_range_rejected(mgr):
+    rt = mgr.create_app_runtime(APP)
+    _feed(rt)
+    with pytest.raises(PlanError):
+        rt.query("from TradeAgg within 0L, 10000L per 'days' select total")
+
+
+def test_wildcard_within_pattern(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (x int, ts long);
+        define aggregation A from S select sum(x) as s
+            aggregate by ts every hour, day;
+    """)
+    # 2017-06-01 10:30 UTC
+    base = 1496313000000
+    rt.input_handler("S").send([(5, base), (6, base + H)])
+    rt.flush()
+    rows = rt.query("from A within '2017-06-01 **:**:**' per 'hours' select s")
+    assert sorted(r for _t, r in rows) == [(5,), (6,)]
+    rows = rt.query("from A within '2017-06-02 **:**:**' per 'hours' select s")
+    assert rows == []

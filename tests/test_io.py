@@ -1,0 +1,142 @@
+"""Sources/sinks/mappers + InMemoryBroker + @OnError fault streams.
+
+Reference test surface: modules/siddhi-core/src/test/java/org/wso2/siddhi/
+core/stream/ (InMemorySourceTestCase, InMemorySinkTestCase), managment/
+FaultStreamTestCase."""
+import pytest
+
+from siddhi_tpu import InMemoryBroker, SiddhiManager, register_source_type
+from siddhi_tpu.core.io import Source
+
+
+@pytest.fixture
+def mgr():
+    InMemoryBroker.reset()
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+    InMemoryBroker.reset()
+
+
+def collect(rt, sid):
+    out = []
+    rt.add_callback(sid, lambda evs: out.extend(e.data for e in evs))
+    return out
+
+
+def test_inmemory_source(mgr):
+    rt = mgr.create_app_runtime("""
+        @source(type='inMemory', topic='stocks')
+        define stream S (sym string, price double);
+        from S[price > 10] select sym insert into O;
+    """)
+    out = collect(rt, "O")
+    rt.start()
+    InMemoryBroker.publish("stocks", ("A", 5.0))
+    InMemoryBroker.publish("stocks", ("B", 20.0))
+    assert out == [("B",)]
+    rt.shutdown()
+    # disconnected after shutdown: no more delivery
+    InMemoryBroker.publish("stocks", ("C", 30.0))
+    assert out == [("B",)]
+
+
+def test_inmemory_sink(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (x int);
+        @sink(type='inMemory', topic='out')
+        define stream O (x int);
+        from S select x insert into O;
+    """)
+    got = []
+    InMemoryBroker.subscribe("out", got.append)
+    rt.start()
+    rt.input_handler("S").send([(1,), (2,)])
+    rt.flush()
+    assert got == [(1,), (2,)]
+
+
+def test_json_mappers_roundtrip(mgr):
+    rt = mgr.create_app_runtime("""
+        @source(type='inMemory', topic='in', @map(type='json'))
+        define stream S (sym string, price double);
+        @sink(type='inMemory', topic='out', @map(type='json'))
+        define stream O (sym string, price double);
+        from S select sym, price insert into O;
+    """)
+    got = []
+    InMemoryBroker.subscribe("out", got.append)
+    rt.start()
+    InMemoryBroker.publish("in", '{"event": {"sym": "A", "price": 1.5}}')
+    assert got == ['{"event": {"sym": "A", "price": 1.5}}']
+
+
+def test_custom_source_type(mgr):
+    class ListSource(Source):
+        instances = []
+
+        def connect(self):
+            ListSource.instances.append(self)
+
+    register_source_type("list", ListSource)
+    rt = mgr.create_app_runtime("""
+        @source(type='list')
+        define stream S (x int);
+        from S select x insert into O;
+    """)
+    out = collect(rt, "O")
+    rt.start()
+    ListSource.instances[-1].deliver([(1,), (2,)])
+    assert out == [(1,), (2,)]
+
+
+def test_on_error_fault_stream(mgr):
+    from siddhi_tpu.interp.expr import register_py_function
+
+    def _boom(args):
+        f, t = args[0]
+        def fn(env):
+            v = f(env)
+            if v == 0:
+                raise ValueError("boom")
+            return v
+        return fn, t
+    register_py_function("boom", _boom, "test")
+
+    rt = mgr.create_app_runtime("""
+        @OnError(action='stream')
+        define stream S (x int, y int);
+        from S select x, test:boom(y) as q insert into O;
+        from !S select x, _error insert into F;
+    """)
+    ok, faults = collect(rt, "O"), collect(rt, "F")
+    h = rt.input_handler("S")
+    h.send((10, 2))
+    rt.flush()
+    # a processing exception routes the batch to !S
+    h.send((11, 0))
+    rt.flush()
+    assert ok == [(10, 2)]
+    assert len(faults) == 1 and faults[0][0] == 11
+    assert "boom" in faults[0][1]
+
+
+def test_fault_without_onerror_raises(mgr):
+    with pytest.raises(Exception):
+        mgr.create_app_runtime("""
+            define stream S (x int);
+            from !S select x insert into O;
+        """)
+
+
+def test_source_mapper_error_routes_to_fault(mgr):
+    rt = mgr.create_app_runtime("""
+        @OnError(action='stream')
+        @source(type='inMemory', topic='t', @map(type='json'))
+        define stream S (x int);
+        from !S select _error insert into F;
+    """)
+    faults = collect(rt, "F")
+    rt.start()
+    InMemoryBroker.publish("t", "{not json")
+    assert len(faults) == 1 and "map error" in faults[0][0]

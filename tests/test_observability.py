@@ -1,0 +1,188 @@
+"""Statistics, debugger, and extension SPI (stream functions, windows,
+aggregators).  Reference test surface: managment/StatisticsTestCase,
+debugger/SiddhiDebuggerTestCase, query/extension/*."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+@pytest.fixture
+def mgr():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def collect(rt, sid):
+    out = []
+    rt.add_callback(sid, lambda evs: out.extend(e.data for e in evs))
+    return out
+
+
+def test_statistics_tracking(mgr):
+    rt = mgr.create_app_runtime("""
+        @app:statistics('true')
+        define stream S (x int);
+        @info(name='q1') from S[x > 0] select x insert into O;
+    """)
+    collect(rt, "O")
+    rt.input_handler("S").send([(i,) for i in range(100)])
+    rt.flush()
+    rep = rt.statistics()
+    assert rep["streams"]["S"]["events"] == 100
+    assert rep["queries"]["q1"]["events"] == 100
+    assert rep["queries"]["q1"]["seconds"] > 0
+
+
+def test_statistics_runtime_toggle(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (x int);
+        from S select x insert into O;
+    """)
+    collect(rt, "O")
+    rt.input_handler("S").send((1,))
+    rt.flush()
+    assert rt.statistics()["streams"] == {}     # off by default
+    rt.enable_stats(True)
+    rt.input_handler("S").send((2,))
+    rt.flush()
+    assert rt.statistics()["streams"]["S"]["events"] == 1
+
+
+def test_debugger_breakpoints(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (x int);
+        @info(name='q1') from S[x > 5] select x * 2 as y insert into O;
+    """)
+    collect(rt, "O")
+    dbg = rt.debug()
+    hits = []
+    dbg.set_callback(lambda q, pt, evs: hits.append((q, pt,
+                                                     [e.data for e in evs])))
+    dbg.acquire_breakpoint("q1", dbg.IN)
+    dbg.acquire_breakpoint("q1", dbg.OUT)
+    rt.input_handler("S").send([(3,), (10,)])
+    rt.flush()
+    assert ("q1", "in", [(3,), (10,)]) in hits
+    assert ("q1", "out", [(20,)]) in hits
+    dbg.release_all()
+    hits.clear()
+    rt.input_handler("S").send((7,))
+    rt.flush()
+    assert hits == []
+
+
+def test_log_stream_function(mgr, capsys):
+    rt = mgr.create_app_runtime("""
+        define stream S (x int);
+        @info(name='q') from S#log('seen') select x insert into O;
+    """)
+    out = collect(rt, "O")
+    rt.input_handler("S").send((1,))
+    rt.flush()
+    assert out == [(1,)]
+    assert "seen" in capsys.readouterr().out
+
+
+def test_pol2cart_stream_function(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (theta double, rho double);
+        from S#pol2cart(theta, rho) select x, y insert into O;
+    """)
+    out = collect(rt, "O")
+    rt.input_handler("S").send((0.0, 2.0))
+    rt.flush()
+    x, y = out[0]
+    assert abs(x - 2.0) < 1e-9 and abs(y) < 1e-9
+
+
+def test_custom_stream_function(mgr):
+    from siddhi_tpu.interp.engine import register_stream_function
+
+    def explode(args, ctx, in_schema, qname):
+        def fn(ev):
+            return [ev.data, ev.data]          # duplicate every event
+        return in_schema, fn
+    register_stream_function("explode", explode, "test")
+
+    rt = mgr.create_app_runtime("""
+        define stream S (x int);
+        from S#test:explode() select x insert into O;
+    """)
+    out = collect(rt, "O")
+    rt.input_handler("S").send((4,))
+    rt.flush()
+    assert out == [(4,), (4,)]
+
+
+def test_custom_aggregator(mgr):
+    from siddhi_tpu.interp.aggregators import Aggregator, register_aggregator
+    from siddhi_tpu.query.ast import AttrType
+
+    class ConcatAgg(Aggregator):
+        type = AttrType.STRING
+
+        def __init__(self, in_type):
+            self.parts = []
+
+        def add(self, v):
+            self.parts.append(str(v))
+
+        def remove(self, v):
+            if str(v) in self.parts:
+                self.parts.remove(str(v))
+
+        def reset(self):
+            self.parts = []
+
+        def value(self):
+            return "".join(self.parts)
+
+        def state(self):
+            return {"parts": list(self.parts)}
+
+        def restore(self, st):
+            self.parts = list(st["parts"])
+
+    register_aggregator("strConcat", ConcatAgg)
+    rt = mgr.create_app_runtime("""
+        define stream S (s string);
+        from S select strConcat(s) as joined insert into O;
+    """)
+    out = collect(rt, "O")
+    rt.input_handler("S").send([("a",), ("b",)])
+    rt.flush()
+    assert out == [("a",), ("ab",)]
+
+
+def test_custom_window_type(mgr):
+    from siddhi_tpu.interp.engine import register_window_type
+    from siddhi_tpu.interp import windows as W
+
+    def first_n(args, ctx, schema):
+        n = int(args[0].value)
+
+        class FirstN(W.Window):
+            def __init__(self):
+                self.seen = 0
+
+            def process(self, ev, now_ms):
+                self.seen += 1
+                return [(W.CURRENT, ev)] if self.seen <= n else []
+
+            def state(self):
+                return {"seen": self.seen}
+
+            def restore(self, st):
+                self.seen = st["seen"]
+        return FirstN()
+    register_window_type("firstN", first_n)
+
+    rt = mgr.create_app_runtime("""
+        define stream S (x int);
+        from S#window.firstN(2) select x insert into O;
+    """)
+    out = collect(rt, "O")
+    rt.input_handler("S").send([(1,), (2,), (3,)])
+    rt.flush()
+    assert out == [(1,), (2,)]
