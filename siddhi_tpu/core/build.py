@@ -143,6 +143,18 @@ def plan_query(rt, q: ast.Query, default_name: str):
         # host path's expired-stream subscription
         nw_needs_host = (inp.stream_id in rt.named_windows
                          and q.output.events_for != ast.OutputEventsFor.CURRENT)
+        # TPU windowed-aggregation path (length/time/lengthBatch windows
+        # with sum/count/avg/min/max): one fused device step per batch
+        dw_mode = rt.device_windows
+        if has_window and has_agg and dw_mode != "never":
+            from .window_device import DeviceWindowAggPlan, DeviceWindowUnsupported
+            try:
+                return attach_table_writer(rt, DeviceWindowAggPlan(
+                    name, rt, q, inp, target), q, name)
+            except DeviceWindowUnsupported as e:
+                if dw_mode == "always":
+                    raise PlanError(f"query {name!r}: deviceWindows=always "
+                                    f"but unsupported: {e}")
         # TPU fast path: stateless filter/project with device-typed columns
         if (not has_window and not has_agg and q.rate is None and not nw_needs_host
                 and isinstance(q.output, (ast.InsertInto, ast.ReturnAction))

@@ -75,6 +75,10 @@ class SiddhiAppRuntime:
         # plans (grows adaptively; pre-sizing skips a growth recompile)
         ds = qast.find_annotation(app.annotations, "app:deviceSlots")
         self.device_slots = int(ds.element()) if ds is not None else 16
+        # device window-aggregation: "auto" (device when supported),
+        # "always" (device or error), "never" (host interpreter)
+        dw = qast.find_annotation(app.annotations, "app:deviceWindows")
+        self.device_windows = dw.element() if dw is not None else "auto"
 
         # stream schemas: defined + inferred from query outputs
         self.schemas: dict = {}

@@ -1,0 +1,639 @@
+"""Device (TPU) window + aggregation plans.
+
+Reference semantics: core:query/processor/stream/window/{Length,Time,
+LengthBatch}WindowProcessor.java + core:query/selector/attribute/
+aggregator/{Sum,Count,Avg,Min,Max}AttributeAggregator — the reference
+updates aggregates event-at-a-time via current/expired event pairs.
+
+TPU-first reformulation: a micro-batch of T events is ONE fused array
+program; the per-event "add current, remove expired, read aggregate"
+loop becomes closed-form range reductions over the concatenated
+[carry | batch] sequence:
+
+  * sliding windows — each event's aggregate is a contiguous-range
+    reduction ending at that event.  The left edge is rank arithmetic
+    for length(L) and a vectorized `searchsorted` for time(D);
+    sums/counts/avgs read prefix-sum differences (O(T)), min/max read
+    a log2 sparse table (O(T log T) build, O(1) per query).
+  * group-by — per-group prefixes come from one sort by (segment,
+    position) + segmented cumsum + two searchsorted rank lookups; no
+    per-group state is kept at all for sliding windows.
+  * lengthBatch(N) tumbling — per-event running aggregates restart at
+    bucket boundaries: a segmented scan keyed by (bucket, group); rows
+    emit only when their bucket completes (reference emits the whole
+    chunk at batch boundary), so the incomplete bucket's raw events
+    ride in the carry.
+
+Carry state is a fixed-capacity device buffer packed at the right edge
+(so [carry | batch] keeps global arrival order contiguous); a capacity
+overflow sets a flag and the host doubles C and retries — the same
+adaptive protocol as the pattern kernel (pattern_plan.py).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..query import ast
+from ..query.ast import AttrType
+from .batch import EventBatch
+from .expr import (CompiledExpr, ExprError, SingleStreamContext,
+                   compile_expression, jnp_dtype)
+from .planner import (AGGREGATOR_NAMES, OutputBatch, PlanError, QueryPlan,
+                      selector_has_aggregators)
+from .schema import StreamSchema, TIMESTAMP_DTYPE, dtype_of
+
+
+class DeviceWindowUnsupported(Exception):
+    pass
+
+
+_INCR = {"sum", "count", "avg", "min", "max"}
+
+F64 = jnp.float64
+NEG = -jnp.inf
+POS = jnp.inf
+_TS_PAD = jnp.int64(2 ** 62)
+
+
+def pow2_at_least(n: int, lo: int = 8) -> int:
+    p = lo
+    while p < n:
+        p *= 2
+    return p
+
+
+# ---------------------------------------------------------------------------
+# vectorized building blocks
+# ---------------------------------------------------------------------------
+
+def _floor_log2(x: jnp.ndarray) -> jnp.ndarray:
+    """floor(log2(x)) for int64 x >= 1, exact (no float rounding)."""
+    res = jnp.zeros_like(x)
+    for shift in (32, 16, 8, 4, 2, 1):
+        m = x >= (jnp.int64(1) << shift)
+        res = jnp.where(m, res + shift, res)
+        x = jnp.where(m, x >> shift, x)
+    return res
+
+
+def _sparse_table(v: jnp.ndarray, is_max: bool) -> jnp.ndarray:
+    """(J, N) table: row j reduces [i, i + 2^j)."""
+    n = v.shape[0]
+    neutral = NEG if is_max else POS
+    op = jnp.maximum if is_max else jnp.minimum
+    rows = [v]
+    w = 1
+    while w < n:
+        prev = rows[-1]
+        shifted = jnp.concatenate([prev[w:], jnp.full(w, neutral)])
+        rows.append(op(prev, shifted))
+        w *= 2
+    return jnp.stack(rows)
+
+
+def _range_reduce(table: jnp.ndarray, l: jnp.ndarray, r: jnp.ndarray,
+                  is_max: bool) -> jnp.ndarray:
+    """Reduce over inclusive ranges [l, r]; requires r >= l."""
+    op = jnp.maximum if is_max else jnp.minimum
+    j = _floor_log2(jnp.maximum(r - l + 1, 1))
+    j = jnp.minimum(j, table.shape[0] - 1)
+    half = jnp.left_shift(jnp.int64(1), j)
+    return op(table[j, l], table[j, r - half + 1])
+
+
+def _segmented_prefix(seg: jnp.ndarray, v: jnp.ndarray) -> tuple:
+    """Inclusive per-segment prefix sums over arrival order.
+
+    seg: (N,) int64 segment id (invalid entries: large id, zero value).
+    Returns (ks, segpfx): sorted (seg*N + pos) keys and the per-segment
+    inclusive prefix at each sorted slot."""
+    n = seg.shape[0]
+    key = seg * n + jnp.arange(n, dtype=jnp.int64)
+    order = jnp.argsort(key)
+    ks = key[order]
+    ss = seg[order]
+    cs = jnp.cumsum(v[order])
+    is_start = jnp.concatenate([jnp.array([True]), ss[1:] != ss[:-1]])
+    start_idx = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(is_start, jnp.arange(n), 0))
+    base = jnp.where(start_idx > 0, cs[jnp.maximum(start_idx - 1, 0)], 0.0)
+    return ks, cs - base
+
+
+def _seg_prefix_at(ks, segpfx, seg, pos, n):
+    """Inclusive prefix at an existing (seg, pos) entry."""
+    r = jnp.searchsorted(ks, seg * n + pos)
+    return segpfx[r]
+
+
+def _seg_prefix_before(ks, segpfx, seg, bound, n):
+    """Prefix over entries of `seg` with position < bound (0.0 if none)."""
+    lo = jnp.searchsorted(ks, seg * n)
+    p = jnp.searchsorted(ks, seg * n + bound)
+    return jnp.where(p > lo, segpfx[jnp.maximum(p - 1, 0)], 0.0)
+
+
+def _seg_window_sum(seg, v, left, gpos, n):
+    """Per-entry sum over its segment's members in positions [left, gpos]."""
+    ks, segpfx = _segmented_prefix(seg, v)
+    incl = _seg_prefix_at(ks, segpfx, seg, gpos, n)
+    return incl - _seg_prefix_before(ks, segpfx, seg, left, n)
+
+
+def _seg_running_sum(seg, v, n):
+    ks, segpfx = _segmented_prefix(seg, v)
+    return _seg_prefix_at(ks, segpfx, seg, jnp.arange(n, dtype=jnp.int64), n)
+
+
+def _seg_running_minmax(seg, v, is_max, n):
+    """Per-entry running min/max within its segment, arrival order."""
+    key = seg * n + jnp.arange(n, dtype=jnp.int64)
+    order = jnp.argsort(key)
+    ks = key[order]
+    ss = seg[order]
+    vs = v[order]
+    is_start = jnp.concatenate([jnp.array([True]), ss[1:] != ss[:-1]])
+    op = jnp.maximum if is_max else jnp.minimum
+
+    def comb(a, b):
+        af, av = a
+        bf, bv = b
+        return (af | bf, jnp.where(bf, bv, op(av, bv)))
+    _f, run = jax.lax.associative_scan(comb, (is_start, vs))
+    return run[jnp.searchsorted(ks, key)]
+
+
+# ---------------------------------------------------------------------------
+# plan
+# ---------------------------------------------------------------------------
+
+class DeviceWindowAggPlan(QueryPlan):
+    """`from S[f]#window.{length|time|lengthBatch}(..) select <aggs>
+    [group by ...] [having ...] insert into O` as one fused device step."""
+
+    C_START = 1024          # initial carry capacity for time windows
+    L_CAP = 1 << 16         # larger length windows stay on host
+
+    def __init__(self, name: str, rt, q: ast.Query,
+                 inp: ast.SingleInputStream, target: Optional[str]):
+        from ..interp.engine import extract_aggregators
+        from ..interp.expr import PyExprContext
+
+        self.name = name
+        self.rt = rt
+        self.output_target = target
+        if q.rate is not None:
+            raise DeviceWindowUnsupported("output rate limiting")
+        if getattr(q.output, "events_for", ast.OutputEventsFor.CURRENT) \
+                != ast.OutputEventsFor.CURRENT:
+            raise DeviceWindowUnsupported("expired-events output")
+        if q.selector.order_by or q.selector.limit is not None \
+                or q.selector.offset:
+            raise DeviceWindowUnsupported("order-by/limit")
+        if any(isinstance(h, ast.StreamFunction) for h in inp.handlers):
+            raise DeviceWindowUnsupported("stream functions")
+        if inp.stream_id in rt.named_windows:
+            raise DeviceWindowUnsupported("named-window input")
+
+        schema = rt.schemas[inp.stream_id]
+        self.in_schema = schema
+        self.input_streams = (inp.stream_id,)
+        if any(a.type == AttrType.OBJECT for a in schema.attributes):
+            raise DeviceWindowUnsupported("object columns")
+
+        # -- window spec ------------------------------------------------------
+        wh = inp.window
+        if wh is None:
+            raise DeviceWindowUnsupported("no window")
+        wname = wh.name.lower()
+        if wh.namespace is not None:
+            raise DeviceWindowUnsupported(f"namespaced window {wname}")
+
+        def _const(i):
+            a = wh.args[i]
+            if isinstance(a, ast.TimeConstant):
+                return a.millis
+            if isinstance(a, ast.Constant):
+                return a.value
+            raise DeviceWindowUnsupported("non-constant window arg")
+
+        if wname == "length":
+            self.kind = "length"
+            self.L = int(_const(0))
+            if self.L <= 0 or self.L > self.L_CAP:
+                raise DeviceWindowUnsupported(f"length({self.L})")
+            self.C = pow2_at_least(self.L)
+        elif wname == "time":
+            self.kind = "time"
+            self.D = int(_const(0))
+            self.C = self.C_START
+        elif wname == "lengthbatch":
+            self.kind = "lengthbatch"
+            self.L = int(_const(0))
+            if self.L <= 0 or self.L > self.L_CAP:
+                raise DeviceWindowUnsupported(f"lengthBatch({self.L})")
+            self.C = pow2_at_least(self.L)
+        else:
+            raise DeviceWindowUnsupported(f"window {wname}")
+
+        # -- expressions ------------------------------------------------------
+        ctx = SingleStreamContext(schema, rt.strings, inp.alias)
+        try:
+            self._filter = None
+            if inp.filters:
+                f = inp.filters[0].expr
+                for g in inp.filters[1:]:
+                    f = ast.And(f, g.expr)
+                self._filter = compile_expression(f, ctx)
+                if self._filter.type != AttrType.BOOL:
+                    raise PlanError(f"filter must be boolean in {name!r}")
+
+            self.group_keys: list[str] = []
+            for g in q.selector.group_by:
+                key, t = ctx.resolve(g)
+                if t == AttrType.OBJECT:
+                    raise DeviceWindowUnsupported("object group key")
+                self.group_keys.append(key)
+
+            pyctx = PyExprContext({inp.alias: schema, inp.stream_id: schema},
+                                  default_ref=inp.alias)
+            raw_sites: list = []
+            rewritten = []
+            sel = q.selector
+            if sel.select_all:
+                raise DeviceWindowUnsupported("select * with aggregation")
+            for oa in sel.attributes:
+                rewritten.append(
+                    (oa.name, extract_aggregators(oa.expr, raw_sites, pyctx)))
+            n_sel_sites = len(raw_sites)
+            having_re = None
+            if sel.having is not None:
+                having_re = extract_aggregators(sel.having, raw_sites, pyctx)
+            if not raw_sites:
+                raise DeviceWindowUnsupported("no aggregates")
+
+            site_args: list = []
+            _collect_site_args([oa.expr for oa in sel.attributes]
+                               + ([sel.having] if sel.having is not None
+                                  else []), site_args)
+            assert len(site_args) == len(raw_sites)
+            self.sites = []
+            for s, arg_ast in zip(raw_sites, site_args):
+                if s.name not in _INCR:
+                    raise DeviceWindowUnsupported(f"aggregator {s.name}()")
+                if s.name in ("min", "max") and self.group_keys \
+                        and self.kind != "lengthbatch":
+                    raise DeviceWindowUnsupported("grouped sliding min/max")
+                arg_ce = (compile_expression(arg_ast, ctx)
+                          if arg_ast is not None else None)
+                self.sites.append((s.name, arg_ce, s.out_type))
+
+            extra = {f"__agg{i}": (f"__agg{i}", s.out_type)
+                     for i, s in enumerate(raw_sites)}
+            octx = SingleStreamContext(schema, rt.strings, inp.alias, extra)
+            self.out_fns: list[CompiledExpr] = []
+            names, types = [], []
+            for nm, expr in rewritten:
+                ce = compile_expression(expr, octx)
+                self.out_fns.append(ce)
+                names.append(nm)
+                types.append(ce.type)
+            self.having = None
+            if having_re is not None:
+                hextra = dict(extra)
+                hextra.update({n: (n, t) for n, t in zip(names, types)})
+                hctx = SingleStreamContext(schema, rt.strings, inp.alias,
+                                           hextra)
+                self.having = compile_expression(having_re, hctx)
+                if self.having.type != AttrType.BOOL:
+                    raise PlanError("having must be boolean")
+        except ExprError as e:
+            raise DeviceWindowUnsupported(str(e))
+
+        self._out_names = names
+        self.out_schema = StreamSchema(target or f"#{name}", tuple(
+            ast.Attribute(n, t) for n, t in zip(names, types)))
+
+        # event columns the kernel reads
+        reads: set = set()
+        for ce in self.out_fns:
+            reads |= set(ce.reads)
+        if self._filter is not None:
+            reads |= set(self._filter.reads)
+        if self.having is not None:
+            # output attribute names are injected into the having env
+            reads |= set(self.having.reads) - set(names)
+        for _nm, arg, _t in self.sites:
+            if arg is not None:
+                reads |= set(arg.reads)
+        reads |= set(self.group_keys)
+        reads.discard("__timestamp__")
+        unknown = [k for k in reads
+                   if k not in schema.types and not k.startswith("__agg")]
+        if unknown:
+            raise DeviceWindowUnsupported(f"unresolved columns {unknown}")
+        self.cols = sorted(k for k in reads if k in schema.types)
+
+        self.state = self._init_state()
+        jax.eval_shape(self._step_fn(8, self.C), self.state, self._dummy(8))
+
+    # -- state ---------------------------------------------------------------
+
+    def _carry_cols(self) -> list:
+        """Event columns that must ride in the carry buffer."""
+        if self.kind == "lengthbatch":
+            return list(self.cols)      # rows emit later: full env needed
+        need = set(self.group_keys)
+        for _nm, arg, _t in self.sites:
+            if arg is not None:
+                need |= set(arg.reads) & set(self.in_schema.types)
+        return sorted(need)
+
+    def _init_state(self) -> dict:
+        C = self.C
+        st = {"ts": jnp.full(C, -_TS_PAD),
+              "valid": jnp.zeros(C, dtype=bool),
+              "seen": jnp.int64(0)}
+        for k in self._carry_cols():
+            st[f"c.{k}"] = jnp.zeros(
+                C, dtype=jnp_dtype(self.in_schema.types[k]))
+        return st
+
+    def _dummy(self, T: int) -> dict:
+        env = {"__timestamp__": jnp.zeros(T, jnp.int64),
+               "__valid__": jnp.zeros(T, bool)}
+        for k in self.cols:
+            env[k] = jnp.zeros(T, dtype=jnp_dtype(self.in_schema.types[k]))
+        return env
+
+    def _grow(self, new_c: int) -> None:
+        old = {k: np.asarray(v) for k, v in self.state.items()}
+        self.C = new_c
+        fresh = self._init_state()
+        st = {}
+        for k, f in fresh.items():
+            o = old[k]
+            if np.ndim(o) == 0:
+                st[k] = jnp.asarray(o)
+            else:
+                pad = np.asarray(f).copy()
+                pad[-o.shape[0]:] = o       # keep right-packing
+                st[k] = jnp.asarray(pad)
+        self.state = st
+
+    # -- kernel --------------------------------------------------------------
+
+    @functools.lru_cache(maxsize=None)
+    def _step_fn(self, T: int, C: int) -> Callable:
+        kind = self.kind
+        sites = self.sites
+        group_keys = self.group_keys
+        filt = self._filter
+        out_fns = self.out_fns
+        out_names = self._out_names
+        having = self.having
+        carry_cols = self._carry_cols()
+        cols = self.cols
+        L = getattr(self, "L", 0)
+        D = getattr(self, "D", 0)
+        N = C + T
+
+        def site_vals(env_all, n):
+            out = []
+            for nm, arg, _t in sites:
+                if arg is None or nm == "count":
+                    out.append(jnp.ones(n))
+                else:
+                    out.append(arg.fn(env_all).astype(F64))
+            return out
+
+        def group_seg(env_all, gvalid, n):
+            """Dense group-segment id per entry (invalid -> n)."""
+            if not group_keys:
+                return jnp.where(gvalid, 0, n).astype(jnp.int64)
+            keys = []
+            for g in group_keys:
+                c = env_all[g]
+                if c.dtype.kind == "f":
+                    c = c.astype(jnp.float64)
+                    c = jnp.where(c == 0.0, 0.0, c).view(jnp.int64)
+                else:
+                    c = c.astype(jnp.int64)
+                keys.append(c)
+            order = jnp.lexsort(keys[::-1])
+            diff = jnp.zeros(n, dtype=bool)
+            for kk in keys:
+                ks = kk[order]
+                diff = diff | jnp.concatenate(
+                    [jnp.array([True]), ks[1:] != ks[:-1]])
+            seg_sorted = jnp.cumsum(diff) - 1
+            seg = jnp.zeros(n, dtype=jnp.int64).at[order].set(seg_sorted)
+            return jnp.where(gvalid, seg, n)
+
+        def finish(env_all, aggs, row_ok):
+            """Select + having over an aligned env; returns (outs, ok)."""
+            env2 = dict(env_all)
+            for i, a in enumerate(aggs):
+                _nm, _arg, ot = sites[i]
+                env2[f"__agg{i}"] = _cast_site(a, ot)
+            outs = [ce.fn(env2) for ce in out_fns]
+            if having is not None:
+                henv = dict(env2)
+                for nm2, col in zip(out_names, outs):
+                    henv[nm2] = col
+                row_ok = row_ok & having.fn(henv)
+            return outs, row_ok
+
+        def step_sliding(state, bts, bvalid, bcols, k):
+            raw_bts = bts
+            all_ts = jnp.concatenate([state["ts"], bts])
+            all_ts = jax.lax.associative_scan(jnp.maximum, all_ts)  # monotone
+            all_valid = jnp.concatenate([state["valid"], bvalid])
+            env_all = {c: jnp.concatenate([state[f"c.{c}"], bcols[c]])
+                       for c in carry_cols}
+            env_all["__timestamp__"] = all_ts
+            gpos = jnp.arange(N, dtype=jnp.int64)
+            vcnt = jnp.cumsum(all_valid.astype(jnp.int64))
+            if kind == "length":
+                want = jnp.maximum(vcnt - L, 0)
+                left = jnp.searchsorted(vcnt, want, side="right")
+            else:
+                left = jnp.searchsorted(all_ts, all_ts - D, side="right")
+            seg = group_seg(env_all, all_valid, N)
+            vals = site_vals(env_all, N)
+
+            aggs_full = []
+            for i, (nm, _arg, _ot) in enumerate(sites):
+                if nm in ("min", "max"):
+                    neutral = NEG if nm == "max" else POS
+                    vv = jnp.where(all_valid, vals[i], neutral)
+                    table = _sparse_table(vv, nm == "max")
+                    aggs_full.append(_range_reduce(
+                        table, jnp.minimum(left, gpos), gpos, nm == "max"))
+                    continue
+                v = (all_valid.astype(F64) if nm == "count"
+                     else jnp.where(all_valid, vals[i], 0.0))
+                s = _seg_window_sum(seg, v, left, gpos, N)
+                if nm == "avg":
+                    c1 = _seg_window_sum(seg, all_valid.astype(F64), left,
+                                         gpos, N)
+                    s = s / jnp.maximum(c1, 1.0)
+                aggs_full.append(s)
+
+            # rows align with the compacted batch part (raw timestamps:
+            # the monotonic clamp is internal to expiry math only)
+            aggs = [a[C:] for a in aggs_full]
+            benv = {c: bcols[c] for c in cols}
+            benv["__timestamp__"] = raw_bts
+            outs, row_ok = finish(benv, aggs, bvalid)
+            row_ts = raw_bts
+
+            # carry = last C entries ending at C+k, minus departed ones
+            if kind == "length":
+                total_v = vcnt[N - 1]
+                start_k = jnp.searchsorted(
+                    vcnt, jnp.maximum(total_v - L, 0), side="right")
+            else:
+                last_ts = all_ts[jnp.maximum(C + k - 1, 0)]
+                start_k = jnp.searchsorted(all_ts, last_ts - D, side="right")
+            keep = (gpos >= start_k) & all_valid
+            sl = lambda a: jax.lax.dynamic_slice(a, (k,), (C,))
+            nst = {"seen": state["seen"] + k,
+                   "ts": sl(all_ts),
+                   "valid": sl(keep)}
+            for c in carry_cols:
+                nst[f"c.{c}"] = sl(env_all[c])
+            overflow = (jnp.sum(keep) > C).astype(jnp.int32)
+            return nst, outs, row_ok, row_ts, overflow
+
+        def step_lengthbatch(state, bts, bvalid, bcols, k):
+            all_ts = jnp.concatenate([state["ts"], bts])
+            all_valid = jnp.concatenate([state["valid"], bvalid])
+            env_all = {c: jnp.concatenate([state[f"c.{c}"], bcols[c]])
+                       for c in carry_cols}
+            env_all["__timestamp__"] = all_ts
+            # admission index: carried events resume their old positions
+            base = state["seen"] - jnp.sum(state["valid"])   # multiple of L
+            vrank = jnp.cumsum(all_valid.astype(jnp.int64)) - 1
+            gidx = base + vrank
+            brel = jnp.where(all_valid, (gidx - base) // L, -1)
+            seg = group_seg(env_all, all_valid, N)
+            segb = jnp.where(all_valid, brel * (N + 1) + seg,
+                             jnp.int64((N + 2) * (N + 1)))
+            vals = site_vals(env_all, N)
+            aggs = []
+            for i, (nm, _arg, _ot) in enumerate(sites):
+                if nm in ("min", "max"):
+                    neutral = NEG if nm == "max" else POS
+                    vv = jnp.where(all_valid, vals[i], neutral)
+                    aggs.append(_seg_running_minmax(segb, vv, nm == "max", N))
+                else:
+                    v = (all_valid.astype(F64) if nm == "count"
+                         else jnp.where(all_valid, vals[i], 0.0))
+                    s = _seg_running_sum(segb, v, N)
+                    if nm == "avg":
+                        c1 = _seg_running_sum(segb, all_valid.astype(F64), N)
+                        s = s / jnp.maximum(c1, 1.0)
+                    aggs.append(s)
+            total = base + jnp.sum(all_valid)
+            completed = (total // L) * L
+            emit = all_valid & (gidx < completed)
+            outs, row_ok = finish(env_all, aggs, emit)
+            row_ts = all_ts
+            pend = all_valid & (gidx >= completed)
+            sl = lambda a: jax.lax.dynamic_slice(a, (k,), (C,))
+            nst = {"seen": total, "ts": sl(all_ts), "valid": sl(pend)}
+            for c in carry_cols:
+                nst[f"c.{c}"] = sl(env_all[c])
+            return nst, outs, row_ok, row_ts, jnp.int32(0)
+
+        def step(state, env):
+            mask = env["__valid__"]
+            if filt is not None:
+                mask = mask & filt.fn(env)
+            order = jnp.argsort(~mask, stable=True)
+            k = jnp.sum(mask)
+            bvalid = jnp.arange(T) < k
+            bts = jnp.where(bvalid, env["__timestamp__"][order], _TS_PAD)
+            bcols = {c: env[c][order] for c in cols}
+            if kind == "lengthbatch":
+                return step_lengthbatch(state, bts, bvalid, bcols, k)
+            return step_sliding(state, bts, bvalid, bcols, k)
+
+        return jax.jit(step)
+
+    # -- QueryPlan interface --------------------------------------------------
+
+    def process(self, stream_id: str, batch: EventBatch) -> list:
+        if batch.n == 0:
+            return []
+        T = pow2_at_least(batch.n)
+        env = {"__timestamp__": _pad(batch.timestamps, T, 0),
+               "__valid__": _pad(np.ones(batch.n, bool), T, False)}
+        for c in self.cols:
+            env[c] = _pad(batch.columns[c], T, 0)
+        while True:
+            fn = self._step_fn(T, self.C)
+            state2, outs, row_ok, row_ts, overflow = fn(self.state, env)
+            if int(np.asarray(overflow)):
+                self._grow(2 * self.C)
+                continue
+            break
+        self.state = state2
+        ok = np.asarray(row_ok)
+        if not ok.any():
+            return []
+        cols = {}
+        for a, colv in zip(self.out_schema.attributes, outs):
+            cols[a.name] = np.asarray(colv)[ok].astype(dtype_of(a.type))
+        ts_out = np.asarray(row_ts)[ok].astype(TIMESTAMP_DTYPE)
+        out = EventBatch(self.out_schema, ts_out, cols, int(ok.sum()))
+        return [OutputBatch(self.output_target, out)]
+
+    # -- snapshot -------------------------------------------------------------
+
+    def state_dict(self) -> dict:
+        return {"state": {k: np.asarray(v) for k, v in self.state.items()},
+                "C": self.C}
+
+    def load_state_dict(self, d: dict) -> None:
+        c = int(d.get("C", self.C))
+        if c != self.C:
+            self.C = c
+        self.state = {k: jnp.asarray(v) for k, v in d["state"].items()}
+
+
+def _cast_site(a: jnp.ndarray, t: AttrType) -> jnp.ndarray:
+    if t in (AttrType.INT, AttrType.LONG):
+        return a.astype(jnp.int64)
+    return a
+
+
+def _pad(a: np.ndarray, T: int, fill) -> np.ndarray:
+    out = np.full(T, fill, dtype=a.dtype)
+    out[:a.shape[0]] = a
+    return out
+
+
+def _collect_site_args(exprs, acc: list) -> None:
+    """Aggregator arg ASTs in extract_aggregators traversal order."""
+    def walk(e):
+        if isinstance(e, ast.FunctionCall) and e.namespace is None \
+                and e.name.lower() in AGGREGATOR_NAMES:
+            acc.append(e.args[0] if e.args else None)
+            return
+        if isinstance(e, (ast.Math, ast.Compare, ast.And, ast.Or)):
+            walk(e.left)
+            walk(e.right)
+        elif isinstance(e, ast.Not):
+            walk(e.expr)
+        elif isinstance(e, ast.FunctionCall):
+            for a in e.args:
+                walk(a)
+    for e in exprs:
+        walk(e)
