@@ -135,8 +135,35 @@ _JNP_OF = {
     AttrType.BOOL: jnp.bool_, AttrType.STRING: jnp.int32,
 }
 
+# Compute-precision override (device kernels): TPUs emulate f64 on the VPU,
+# so hot kernels compute DOUBLE in f32 by default (opt out per app with
+# @app:devicePrecision('f64')).  The override is consulted at trace time, so
+# wrapping a kernel's trace in `compute_dtypes(...)` retargets every cast and
+# constant the compiled expressions emit.
+import contextvars as _contextvars
+from contextlib import contextmanager as _contextmanager
+
+_DTYPE_OVERRIDES: "_contextvars.ContextVar" = _contextvars.ContextVar(
+    "siddhi_dtype_overrides", default=None)
+
+
+@_contextmanager
+def compute_dtypes(overrides: Optional[dict]):
+    """Override AttrType -> jnp dtype inside this context (trace-time)."""
+    tok = _DTYPE_OVERRIDES.set(overrides)
+    try:
+        yield
+    finally:
+        _DTYPE_OVERRIDES.reset(tok)
+
+
+F32_MODE = {AttrType.DOUBLE: jnp.float32}
+
 
 def jnp_dtype(t: AttrType):
+    o = _DTYPE_OVERRIDES.get()
+    if o is not None and t in o:
+        return o[t]
     return _JNP_OF[t]
 
 
@@ -277,9 +304,11 @@ def _compile_constant(expr: ast.Constant, ctx: ExprContext) -> CompiledExpr:
     if t == AttrType.STRING:
         code = ctx.resolve_string_constant(expr.value)
         v = jnp.asarray(code, dtype=jnp.int32)
-    else:
-        v = jnp.asarray(expr.value, dtype=jnp_dtype(t))
-    return CompiledExpr(lambda env: v, t, frozenset())
+        return CompiledExpr(lambda env: v, t, frozenset())
+    # dtype resolved at trace time so compute_dtypes() overrides apply
+    val = expr.value
+    return CompiledExpr(lambda env: jnp.asarray(val, dtype=jnp_dtype(t)),
+                        t, frozenset())
 
 
 def _want_bool(*exprs: CompiledExpr):

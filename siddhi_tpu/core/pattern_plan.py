@@ -10,6 +10,10 @@ The partition axis is 1 for plain pattern queries; partitioned queries
 a partition capacity so thousands of per-key NFA instances run as one
 kernel (reference clones the whole query graph per key instead:
 core:partition/PartitionRuntime.java:257-306).
+
+Timestamps and seqs are shipped to the device as i32 offsets from
+per-plan bases (TPU x64 is emulated; see nfa_device.py); the plan
+rebases the persistent slot state host-side before offsets can overflow.
 """
 from __future__ import annotations
 
@@ -21,11 +25,22 @@ import numpy as np
 from ..query import ast
 from .batch import EventBatch
 from .expr import ExprError, MultiStreamContext, compile_expression
-from .nfa_device import (ChainSpec, DeviceNFAUnsupported, NFAKernel,
-                         lower_chain, pow2_at_least)
+from .nfa_device import (ChainSpec, DeviceNFAUnsupported, LOCAL_SPAN,
+                         NFAKernel, join64_np, lower_chain, pow2_at_least)
 from .planner import (AGGREGATOR_NAMES, OutputBatch, PlanError, QueryPlan,
                       selector_has_aggregators)
 from .schema import StreamSchema, TIMESTAMP_DTYPE, dtype_of
+
+_I32 = np.int32
+
+
+def _m_bucket(n: int) -> int:
+    """Match-buffer capacity bucket: pow2 up to 16K, then 16K multiples —
+    every pull through the tunnel pays per-byte, so over-allocating 2x at
+    large n (pow2) wastes real time; finer buckets cost a rare recompile."""
+    if n <= 16384:
+        return pow2_at_least(n, lo=16)
+    return -(-n // 16384) * 16384
 
 
 class DevicePatternPlan(QueryPlan):
@@ -43,6 +58,8 @@ class DevicePatternPlan(QueryPlan):
         cap = ast.find_annotation(rt.app.annotations, "app:deviceSlotCap")
         if cap is not None:
             self.A_CAP = int(cap.element())
+        prec = ast.find_annotation(rt.app.annotations, "app:devicePrecision")
+        self.f64 = prec is not None and str(prec.element()).lower() == "f64"
         self.output_target = target
         self.events_for = getattr(q.output, "events_for",
                                   ast.OutputEventsFor.CURRENT)
@@ -101,12 +118,17 @@ class DevicePatternPlan(QueryPlan):
             ast.Attribute(n, t) for n, t in zip(names, types)))
 
         self.kernel = NFAKernel(self.spec, dict(zip(names, fns)), having,
-                                self.P, slots)
+                                self.P, slots, f64=self.f64)
         self.state = self.kernel.init_state()
+        self._ts_base: Optional[int] = None
+        self._seq_base: Optional[int] = None
         self._m_hint = 16           # last match-buffer capacity that sufficed
         self._of_slots_seen = 0     # accepted (at-cap) overflow totals
         self._buffered: list = []   # (stream_id, EventBatch)
         self._scode = {sid: i for i, sid in enumerate(self.spec.stream_ids)}
+        # device grids shipped per block: only attrs some predicate or
+        # capture row reads, per scode
+        self._grid_attrs: list = sorted(self._needed_grid_attrs())
 
         # build-time validation: trace a tiny block so unsupported env keys
         # fail here (-> sequential fallback) instead of at first flush
@@ -115,18 +137,40 @@ class DevicePatternPlan(QueryPlan):
 
     # -- helpers -------------------------------------------------------------
 
+    def _needed_grid_attrs(self) -> set:
+        """(scode, attr, AttrType) triples whose (T, P) grids the kernel
+        reads (predicate inputs + capture writes)."""
+        keys: set = set()
+        for st in self.spec.states:
+            for ce in st.pre_conjs + st.step_conjs:
+                keys.update(k for k in ce.reads if "." in k)
+        keys.update(k for k in self.kernel._row_of if not k.startswith("__"))
+        ref_scode = {st.ref: st.scode for st in self.spec.states}
+        ref_schema = self.spec.schemas
+        out = set()
+        for k in keys:
+            ref, attr = k.split(".", 1)
+            if ref in ref_scode and attr in ref_schema[ref].types:
+                out.add((ref_scode[ref], attr, ref_schema[ref].type_of(attr)))
+        return out
+
+    def _np_dtype(self, t: ast.AttrType):
+        if not self.f64 and t == ast.AttrType.DOUBLE:
+            return np.float32
+        return dtype_of(t)
+
     def _dense_dummy(self, T: int) -> dict:
         import jax.numpy as jnp
-        from .expr import jnp_dtype
         P = self.P
-        ev = {"__ts__": jnp.zeros((T, P), dtype=jnp.int64),
-              "__seq__": jnp.zeros((T, P), dtype=jnp.int64),
-              "__scode__": jnp.zeros((T, P), dtype=jnp.int32),
-              "__valid__": jnp.zeros((T, P), dtype=bool)}
-        for sid in self.spec.stream_ids:
-            si = self._scode[sid]
-            for a in self.rt.schemas[sid].attributes:
-                ev[f"{si}.{a.name}"] = jnp.zeros((T, P), dtype=jnp_dtype(a.type))
+        ev = {"__ts__": jnp.zeros((T, P), dtype=jnp.int32),
+              "__seq__": jnp.zeros((T, P), dtype=jnp.int32),
+              "__valid__": jnp.zeros((T, P), dtype=bool),
+              "__base_ts__": jnp.zeros((), dtype=jnp.int64),
+              "__base_seq__": jnp.zeros((), dtype=jnp.int64)}
+        if len(self.spec.stream_ids) > 1:
+            ev["__scode__"] = jnp.zeros((T, P), dtype=jnp.int32)
+        for si, attr, t in self._grid_attrs:
+            ev[f"{si}.{attr}"] = jnp.zeros((T, P), dtype=self._np_dtype(t))
         return ev
 
     @property
@@ -137,49 +181,72 @@ class DevicePatternPlan(QueryPlan):
         return int(np.asarray(self.state["of_slots"]).sum())
 
     def part_of(self, stream_id: str, batch: EventBatch) -> np.ndarray:
-        """Partition index per event; grows the key map (host side)."""
+        """Partition index per event; grows the key map (host side).
+        Vectorized: the python dict is consulted once per DISTINCT key."""
         if self.part_key_fns is None:
-            return np.zeros(batch.n, dtype=np.int32)
+            return np.zeros(batch.n, dtype=_I32)
         keys = self.part_key_fns[stream_id](batch)
-        out = np.empty(batch.n, dtype=np.int32)
+        uniq, inv = np.unique(keys, return_inverse=True)
         k2p = self._key_to_part
-        for i, k in enumerate(keys.tolist()):
+        parts_u = np.empty(len(uniq), dtype=_I32)
+        for j, k in enumerate(uniq.tolist()):
             p = k2p.get(k)
             if p is None:
                 if len(k2p) >= self.P:
                     self._grow(2 * self.P)
                 p = k2p[k] = len(k2p)
-            out[i] = p
-        return out
+            parts_u[j] = p
+        return parts_u[inv]
 
     def _grow(self, new_p: int) -> None:
-        """Double the partition axis: pad state arrays, rebuild the kernel
-        (the next block jit-compiles at the new P)."""
+        """Double the partition axis (last axis of every state leaf): pad,
+        rebuild the kernel (the next block jit-compiles at the new P)."""
         import jax.numpy as jnp
         old = jax.tree_util.tree_map(np.asarray, self.state)
         kern = NFAKernel(self.spec, self.kernel.sel_fns, self.kernel.having,
-                         new_p, self.kernel.A, self.kernel.E)
+                         new_p, self.kernel.A, self.kernel.E, f64=self.f64)
         fresh = kern.init_state()
         self.state = jax.tree_util.tree_map(
             lambda f, o: jnp.asarray(
-                np.concatenate([o, np.asarray(f)[o.shape[0]:]], axis=0)),
+                np.concatenate([o, np.asarray(f)[..., o.shape[-1]:]], axis=-1)),
             fresh, old)
         self.kernel = kern
         self.P = new_p
 
     def _grow_slots(self, new_a: int) -> None:
-        """Pad the slot axis of all (P, A) state leaves and rebuild."""
+        """Pad the slot axis of per-slot state leaves and rebuild."""
         import jax.numpy as jnp
         old = jax.tree_util.tree_map(np.asarray, self.state)
         kern = NFAKernel(self.spec, self.kernel.sel_fns, self.kernel.having,
-                         self.P, new_a, self.kernel.E)
+                         self.P, new_a, self.kernel.E, f64=self.f64)
         fresh = kern.init_state()
-        self.state = jax.tree_util.tree_map(
-            lambda f, o: jnp.asarray(np.concatenate(
-                [o, np.asarray(f)[:, o.shape[1]:]], axis=1))
-            if o.ndim == 2 else jnp.asarray(o),
-            fresh, old)
+
+        def pad(f, o):
+            ax = {2: 0, 3: 1}.get(o.ndim)
+            if ax is None or f.shape == o.shape:
+                return jnp.asarray(o)
+            filler = np.asarray(f)[(slice(None),) * ax + (slice(o.shape[ax], None),)]
+            return jnp.asarray(np.concatenate([o, filler], axis=ax))
+        self.state = jax.tree_util.tree_map(pad, fresh, old)
         self.kernel = kern
+
+    def _rebase(self, min_ts: int, min_seq: int) -> None:
+        """Shift the plan's ts/seq bases forward and adjust persistent slot
+        offsets so i32 locals never overflow.  Ancient slots clamp to
+        -LOCAL_SPAN (their age saturates; `within` then expires them)."""
+        import jax.numpy as jnp
+        st = {k: np.asarray(v) for k, v in self.state.items()}
+        if self._ts_base is not None and min_ts > self._ts_base:
+            d = min_ts - self._ts_base
+            st["first_ts"] = np.maximum(
+                st["first_ts"].astype(np.int64) - d, -LOCAL_SPAN).astype(_I32)
+            self._ts_base = min_ts
+        if self._seq_base is not None and min_seq > self._seq_base:
+            d = min_seq - self._seq_base
+            st["head_seq"] = np.maximum(
+                st["head_seq"].astype(np.int64) - d, -LOCAL_SPAN).astype(_I32)
+            self._seq_base = min_seq
+        self.state = {k: jnp.asarray(v) for k, v in st.items()}
 
     # -- QueryPlan interface -------------------------------------------------
 
@@ -197,13 +264,11 @@ class DevicePatternPlan(QueryPlan):
         N = sum(b.n for _s, b in bufs)
         ts = np.empty(N, dtype=np.int64)
         seq = np.empty(N, dtype=np.int64)
-        scode = np.empty(N, dtype=np.int32)
-        part = np.empty(N, dtype=np.int32)
+        scode = np.empty(N, dtype=_I32)
+        part = np.empty(N, dtype=_I32)
         cols: dict = {}
-        for sid in self.spec.stream_ids:
-            si = self._scode[sid]
-            for a in self.rt.schemas[sid].attributes:
-                cols[f"{si}.{a.name}"] = np.zeros(N, dtype=dtype_of(a.type))
+        for si, attr, t in self._grid_attrs:
+            cols[f"{si}.{attr}"] = np.zeros(N, dtype=self._np_dtype(t))
         o = 0
         for sid, b in bufs:
             si = self._scode[sid]
@@ -212,8 +277,9 @@ class DevicePatternPlan(QueryPlan):
             seq[sl] = b.seqs if b.seqs is not None else np.arange(o, o + b.n)
             scode[sl] = si
             part[sl] = self.part_of(sid, b)
-            for a in self.rt.schemas[sid].attributes:
-                cols[f"{si}.{a.name}"][sl] = b.columns[a.name]
+            for sj, attr, _t in self._grid_attrs:
+                if sj == si:
+                    cols[f"{si}.{attr}"][sl] = b.columns[attr]
             o += b.n
 
         # 2. order by arrival, compute index-within-partition
@@ -228,8 +294,25 @@ class DevicePatternPlan(QueryPlan):
         run_id = np.cumsum(np.r_[True, sp[1:] != sp[:-1]]) - 1
         idx_within[by_part] = np.arange(N) - run_start[run_id]
 
-        # 3. run dense (T, P) blocks (chunked if one partition hogs the batch)
+        # 3. i32 offset bases (+ rebase persistent state before overflow).
+        # The base is chosen from the flush MAX so headroom is always
+        # restored even when a stale event pins the minimum; events older
+        # than base - LOCAL_SPAN clamp low (their age saturates and
+        # `within` expires them — never a silent wrap).
+        budget = LOCAL_SPAN - (1 << 16)
+        if self._ts_base is None:
+            self._ts_base = max(int(ts.min()), int(ts.max()) - budget)
+            self._seq_base = max(int(seq.min()), int(seq.max()) - budget)
+        if int(ts.max()) - self._ts_base >= budget \
+                or int(seq.max()) - self._seq_base >= budget:
+            self._rebase(max(int(ts.min()), int(ts.max()) - budget),
+                         max(int(seq.min()), int(seq.max()) - budget))
+        ts32 = np.clip(ts - self._ts_base, -LOCAL_SPAN, LOCAL_SPAN).astype(_I32)
+        seq32 = np.clip(seq - self._seq_base, -LOCAL_SPAN, LOCAL_SPAN).astype(_I32)
+
+        # 4. run dense (T, P) blocks (chunked if one partition hogs the batch)
         T_CAP = 512
+        multi = len(self.spec.stream_ids) > 1
         rows_out: list = []
         n_chunks = int(idx_within.max()) // T_CAP + 1
         for c in range(n_chunks):
@@ -238,20 +321,24 @@ class DevicePatternPlan(QueryPlan):
                 continue
             t_local = (idx_within[m] - c * T_CAP).astype(np.int64)
             T = pow2_at_least(int(t_local.max()) + 1)
-            ev = {"__ts__": np.zeros((T, self.P), np.int64),
-                  "__seq__": np.zeros((T, self.P), np.int64),
-                  "__scode__": np.full((T, self.P), -1, np.int32),
+            ev = {"__ts__": np.zeros((T, self.P), _I32),
+                  "__seq__": np.zeros((T, self.P), _I32),
                   "__valid__": np.zeros((T, self.P), bool)}
+            if multi:
+                ev["__scode__"] = np.full((T, self.P), -1, _I32)
             for k, v in cols.items():
                 ev[k] = np.zeros((T, self.P), v.dtype)
             pm = part[m]
-            ev["__ts__"][t_local, pm] = ts[m]
-            ev["__seq__"][t_local, pm] = seq[m]
-            ev["__scode__"][t_local, pm] = scode[m]
+            ev["__ts__"][t_local, pm] = ts32[m]
+            ev["__seq__"][t_local, pm] = seq32[m]
+            if multi:
+                ev["__scode__"][t_local, pm] = scode[m]
             ev["__valid__"][t_local, pm] = True
             for k, v in cols.items():
                 ev[k][t_local, pm] = v[m]
-            rows_out.extend(self._run_block(ev, T))
+            ev["__base_ts__"] = np.int64(self._ts_base)
+            ev["__base_seq__"] = np.int64(self._seq_base)
+            rows_out.append(self._run_block(ev, T))
 
         return self._rows_to_batches(rows_out)
 
@@ -261,16 +348,19 @@ class DevicePatternPlan(QueryPlan):
         the workload without ever losing a match (until the documented
         A_CAP ceiling; emission lanes cannot overflow — completions park
         in their slot and drain over subsequent steps)."""
-        from .nfa_device import _unpack_i64
-        M = max(self._m_hint, pow2_at_least(2 * T, lo=16))
+        M = max(self._m_hint, _m_bucket(2 * T))
         while True:
             fn = self.kernel.block_fn(T, M)
             state2, out = fn(self.state, ev)
-            ipack = np.asarray(out["i"])     # two device->host transfers
+            try:        # start the D2H pull while the device still computes
+                out["i"].copy_to_host_async()
+            except Exception:
+                pass
+            ipack = np.asarray(out["i"])     # ONE device->host transfer
             fpack = np.asarray(out["f"]) if "f" in out else None
             n, ofs = int(ipack[0, 0]), int(ipack[0, 1])
             if n > M:
-                M = pow2_at_least(n)
+                M = _m_bucket(n)
                 continue
             if ofs > self._of_slots_seen and self.kernel.A < self.A_CAP:
                 self._grow_slots(min(2 * self.kernel.A, self.A_CAP))
@@ -286,60 +376,82 @@ class DevicePatternPlan(QueryPlan):
         self._m_hint = M           # avoid recompiling next flush
         self._of_slots_seen = ofs
         self.state = state2
-        valid = ipack[1] != 0                     # (M,)
+        if self.kernel.having is not None:
+            valid = ipack[1] != 0                 # (M,)
+            ii = 2
+        else:
+            valid = np.arange(ipack.shape[1]) < n
+            ii = 1
         if not valid.any():
-            return []
+            return None
+        # unpack columns in out_names order (columnar, no per-row python):
+        # f32 rows are bitcast into the i32 pack, f64 rows (f64 mode) come
+        # from the float pack, i64 as hi/lo row pairs
         row = {}
-        ii, fi = 2, 0
+        fi = 0
         for nm in self.kernel.out_names:
-            if fpack is not None and nm in self.kernel.f64_names:
+            dt = np.dtype(self.kernel.out_dtypes[nm])
+            if dt == np.float64:
                 row[nm] = fpack[fi]; fi += 1
+            elif dt == np.float32:
+                row[nm] = ipack[ii].view(np.float32); ii += 1
+            elif dt == np.int64:
+                row[nm] = join64_np(ipack[ii], ipack[ii + 1]); ii += 2
             else:
                 row[nm] = ipack[ii]; ii += 1
-        seqs = row["__seq__"][valid]
+        tss = row["__timestamp__"][valid].astype(np.int64) + self._ts_base
+        seqs = row["__seq__"][valid].astype(np.int64) + self._seq_base
         hseqs = row["__head_seq__"][valid]
-        tss = row["__timestamp__"][valid]
-        data = {nm: _unpack_i64(row[nm], dtype_of(t))[valid]
-                for nm, t in zip(self._names, self._types)}
-        # same-event completions tie on seq; order them by head arrival
+        data = {}
+        for nm, t in zip(self._names, self._types):
+            col = row[nm][valid]
+            if t == ast.AttrType.BOOL:
+                col = col != 0
+            data[nm] = col.astype(dtype_of(t))
+        return (tss, seqs, hseqs, data)
+
+    def _rows_to_batches(self, chunks: list) -> list:
+        """chunks: list of (tss, seqs, hseqs, data) columnar match tables."""
+        chunks = [c for c in chunks if c is not None]
+        if not chunks or self.events_for == ast.OutputEventsFor.EXPIRED:
+            return []
+        tss = np.concatenate([c[0] for c in chunks])
+        seqs = np.concatenate([c[1] for c in chunks])
+        hseqs = np.concatenate([c[2] for c in chunks])
+        data = {nm: np.concatenate([c[3][nm] for c in chunks])
+                for nm in self._names}
+        # emit in completion order; same-event ties by head arrival
         # (reference emits pending-list == arrival order)
         o = np.lexsort((hseqs, seqs))
-        return [(int(tss[i]), int(seqs[i]),
-                 tuple(data[nm][i] for nm in self._names)) for i in o]
-
-    def _rows_to_batches(self, rows: list) -> list:
-        if not rows or self.events_for == ast.OutputEventsFor.EXPIRED:
-            return []
-        rows.sort(key=lambda r: r[1])
         if self.offset:
-            rows = rows[self.offset:]
+            o = o[self.offset:]
         if self.limit is not None:
-            rows = rows[:self.limit]
-        if not rows:
+            o = o[:self.limit]
+        if not len(o):
             return []
-        n = len(rows)
-        cols = {}
-        for j, (nm, t) in enumerate(zip(self._names, self._types)):
-            cols[nm] = np.asarray([r[2][j] for r in rows], dtype=dtype_of(t))
-        batch = EventBatch(self.out_schema,
-                           np.asarray([r[0] for r in rows], dtype=TIMESTAMP_DTYPE),
-                           cols, n)
+        cols = {nm: data[nm][o] for nm in self._names}
+        batch = EventBatch(self.out_schema, tss[o].astype(TIMESTAMP_DTYPE),
+                           cols, len(o), seqs[o])
         return [OutputBatch(self.output_target, batch)]
 
     # -- snapshot ------------------------------------------------------------
 
     def state_dict(self) -> dict:
         st = jax.tree_util.tree_map(np.asarray, self.state)
-        return {"state": st, "key_to_part": dict(self._key_to_part)}
+        return {"state": st, "key_to_part": dict(self._key_to_part),
+                "ts_base": self._ts_base, "seq_base": self._seq_base}
 
     def load_state_dict(self, d: dict) -> None:
         import jax.numpy as jnp
         st = d["state"]
-        p, a = st["active"].shape
+        a, p = st["sidx"].shape
         if p != self.P or a != self.kernel.A:  # snapshot taken after growth
             self.kernel = NFAKernel(self.spec, self.kernel.sel_fns,
-                                    self.kernel.having, p, a, self.kernel.E)
+                                    self.kernel.having, p, a, self.kernel.E,
+                                    f64=self.f64)
             self.P = p
         self.state = jax.tree_util.tree_map(jnp.asarray, st)
         self._key_to_part = dict(d["key_to_part"])
+        self._ts_base = d.get("ts_base")
+        self._seq_base = d.get("seq_base")
         self._of_slots_seen = int(np.asarray(st["of_slots"]).sum())

@@ -128,7 +128,7 @@ def test_partitioned_pattern_vs_clones(mgr):
     sends = []
     for i in range(120):
         sends.append((syms[int(rng.integers(len(syms)))],
-                      round(float(rng.uniform(90, 120)), 1), 1000 + i))
+                      float(np.round(rng.uniform(90, 120) * 4) / 4), 1000 + i))
     outs = {}
     for mode in ("auto", "never"):
         app = f"@app:devicePatterns('{mode}')\n" + PATTERN_PART
