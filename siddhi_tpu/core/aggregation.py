@@ -214,6 +214,21 @@ class AggregationRuntime(QueryPlan):
             if not keep.any():
                 return []
 
+        # rows whose group key or aggregate argument is NULL would otherwise
+        # be bucketed/summed as their fill values (advisor r2): mask them out
+        if batch.nulls:
+            null_mask = np.zeros(n, dtype=bool)
+            for a in self.group_attrs:
+                if a in batch.nulls:
+                    null_mask |= batch.nulls[a]
+            for s in self.sites:
+                if s.arg is not None and s.arg in batch.nulls:
+                    null_mask |= batch.nulls[s.arg]
+            if null_mask.any():
+                keep = ~null_mask if keep is None else (keep & ~null_mask)
+                if not keep.any():
+                    return []
+
         gcols = [batch.columns[a] for a in self.group_attrs]
         vals = self._site_values(batch)
         if keep is not None:

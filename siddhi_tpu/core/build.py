@@ -157,6 +157,7 @@ def plan_query(rt, q: ast.Query, default_name: str):
                                     f"but unsupported: {e}")
         # TPU fast path: stateless filter/project with device-typed columns
         if (not has_window and not has_agg and q.rate is None and not nw_needs_host
+                and rt.device_filters != "never"
                 and isinstance(q.output, (ast.InsertInto, ast.ReturnAction))
                 and not any(isinstance(h, ast.StreamFunction) for h in inp.handlers)):
             try:

@@ -173,8 +173,17 @@ class Source:
         try:
             rows = self.mapper.map(message)
         except Exception as e:
-            self.rt._route_fault_rows(self.stream_id, [], f"map error: {e}",
-                                      raw=message)
+            if ("!" + self.stream_id) in self.rt.schemas:
+                self.rt._route_fault_rows(self.stream_id, [],
+                                          f"map error: {e}", raw=message)
+            else:
+                # no @OnError fault stream: log and drop the malformed
+                # message (reference SourceMapper does the same) instead of
+                # raising into the transport and starving co-subscribers
+                warnings.warn(
+                    f"source on {self.stream_id!r}: dropping unmappable "
+                    f"message ({e}); add @OnError(action='stream') to route "
+                    f"to a fault stream", RuntimeWarning)
             return
         with self.rt._lock:
             for ts, row in rows:
@@ -318,7 +327,12 @@ def build_io(rt) -> None:
                                     PassThroughSinkMapper)
                 sink = cls(rt, sid, opts, mapper)
                 rt.sinks.append(sink)
-                rt._stream_callbacks[sid].append(sink.on_events)
+                # stage into the runtime's outbox instead of publishing
+                # under the runtime lock (cross-runtime ABBA deadlock —
+                # runtime._flush_sink_outbox delivers after release)
+                def _stage(events, _sink=sink, _rt=rt):
+                    _rt._sink_outbox.append((_sink.on_events, events))
+                rt._stream_callbacks[sid].append(_stage)
 
 
 def _mapper_of(a: ast.Annotation, schema, registry: dict, default_cls):

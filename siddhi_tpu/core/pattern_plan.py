@@ -310,10 +310,12 @@ class DevicePatternPlan(QueryPlan):
         ts32 = np.clip(ts - self._ts_base, -LOCAL_SPAN, LOCAL_SPAN).astype(_I32)
         seq32 = np.clip(seq - self._seq_base, -LOCAL_SPAN, LOCAL_SPAN).astype(_I32)
 
-        # 4. run dense (T, P) blocks (chunked if one partition hogs the batch)
-        T_CAP = 512
+        # 4. run dense (T, P) blocks (chunked if one partition hogs the
+        # batch); T_CAP widens for small P so single-partition patterns
+        # amortize per-block overhead over longer scans
+        T_CAP = min(8192, max(512, (1 << 19) // max(self.P, 1)))
         multi = len(self.spec.stream_ids) > 1
-        rows_out: list = []
+        chunk_evs: list = []
         n_chunks = int(idx_within.max()) // T_CAP + 1
         for c in range(n_chunks):
             m = (idx_within >= c * T_CAP) & (idx_within < (c + 1) * T_CAP)
@@ -338,44 +340,71 @@ class DevicePatternPlan(QueryPlan):
                 ev[k][t_local, pm] = v[m]
             ev["__base_ts__"] = np.int64(self._ts_base)
             ev["__base_seq__"] = np.int64(self._seq_base)
-            rows_out.append(self._run_block(ev, T))
+            chunk_evs.append((ev, T))
 
-        return self._rows_to_batches(rows_out)
+        return self._rows_to_batches(self._run_chunks(chunk_evs))
 
-    def _run_block(self, ev: dict, T: int) -> list:
-        """Run one dense block; retry (exactly — state is functional) with
-        doubled match buffer / slots on overflow, so the kernel adapts to
-        the workload without ever losing a match (until the documented
-        A_CAP ceiling; emission lanes cannot overflow — completions park
-        in their slot and drain over subsequent steps)."""
-        M = max(self._m_hint, _m_bucket(2 * T))
-        while True:
-            fn = self.kernel.block_fn(T, M)
-            state2, out = fn(self.state, ev)
-            try:        # start the D2H pull while the device still computes
-                out["i"].copy_to_host_async()
-            except Exception:
-                pass
-            ipack = np.asarray(out["i"])     # ONE device->host transfer
-            fpack = np.asarray(out["f"]) if "f" in out else None
-            n, ofs = int(ipack[0, 0]), int(ipack[0, 1])
-            if n > M:
-                M = _m_bucket(n)
-                continue
-            if ofs > self._of_slots_seen and self.kernel.A < self.A_CAP:
-                self._grow_slots(min(2 * self.kernel.A, self.A_CAP))
-                continue
-            if ofs > self._of_slots_seen:
-                import warnings
-                warnings.warn(
-                    f"pattern {self.name!r}: pending-match slots hit the "
-                    f"deviceSlotCap ceiling ({self.A_CAP}); {ofs} partial "
-                    f"matches dropped so far (raise @app:deviceSlotCap)",
-                    RuntimeWarning, stacklevel=2)
-            break
-        self._m_hint = M           # avoid recompiling next flush
-        self._of_slots_seen = ofs
-        self.state = state2
+    def _run_chunks(self, chunk_evs: list) -> list:
+        """Dispatch ALL blocks first (device state threads functionally),
+        then pull outputs — async D2H copies overlap the tunnel's ~100 ms
+        fixed latency (measured 3.3x on back-to-back pulls).
+
+        Retries are exact because state is functional: a match-buffer
+        overflow re-runs only that block from its saved pre-state (state
+        evolution is M-independent); pending-slot exhaustion grows A and
+        restarts the chain from the exhausted block (dropped heads change
+        downstream state)."""
+        results: list = [None] * len(chunk_evs)
+        i = 0
+        while i < len(chunk_evs):
+            dispatched = []
+            st = self.state
+            for j in range(i, len(chunk_evs)):
+                ev, T = chunk_evs[j]
+                M = max(self._m_hint, _m_bucket(2 * T))
+                fn = self.kernel.block_fn(T, M)
+                pre = st
+                st, out = fn(st, ev)
+                try:    # start the D2H pull while the device still computes
+                    out["i"].copy_to_host_async()
+                except Exception:
+                    pass
+                dispatched.append((j, pre, ev, T, M, out))
+            restart = None
+            for j, pre, ev, T, M, out in dispatched:
+                ipack = np.asarray(out["i"])   # ONE device->host transfer
+                fpack = np.asarray(out["f"]) if "f" in out else None
+                n, ofs = int(ipack[0, 0]), int(ipack[0, 1])
+                while n > M:                   # exact re-run, bigger buffer
+                    M = _m_bucket(n)
+                    fn = self.kernel.block_fn(T, M)
+                    _st2, out = fn(pre, ev)
+                    ipack = np.asarray(out["i"])
+                    fpack = np.asarray(out["f"]) if "f" in out else None
+                    n, ofs = int(ipack[0, 0]), int(ipack[0, 1])
+                self._m_hint = max(self._m_hint, M)
+                if ofs > self._of_slots_seen and self.kernel.A < self.A_CAP:
+                    self.state = pre
+                    self._grow_slots(min(2 * self.kernel.A, self.A_CAP))
+                    restart = j
+                    break
+                if ofs > self._of_slots_seen:
+                    import warnings
+                    warnings.warn(
+                        f"pattern {self.name!r}: pending-match slots hit the "
+                        f"deviceSlotCap ceiling ({self.A_CAP}); {ofs} partial "
+                        f"matches dropped so far (raise @app:deviceSlotCap)",
+                        RuntimeWarning, stacklevel=2)
+                    self._of_slots_seen = ofs
+                results[j] = self._unpack_block(ipack, fpack, n)
+            if restart is None:
+                self.state = st
+                break
+            i = restart
+        return results
+
+    def _unpack_block(self, ipack, fpack, n: int):
+        """Columnar match table from one block's packed output."""
         if self.kernel.having is not None:
             valid = ipack[1] != 0                 # (M,)
             ii = 2

@@ -79,6 +79,10 @@ class SiddhiAppRuntime:
         # "always" (device or error), "never" (host interpreter)
         dw = qast.find_annotation(app.annotations, "app:deviceWindows")
         self.device_windows = dw.element() if dw is not None else "auto"
+        # stateless filter/projection: "auto" (jitted device kernel),
+        # "never" (host interpreter — benchmarking / debugging)
+        df = qast.find_annotation(app.annotations, "app:deviceFilters")
+        self.device_filters = df.element() if df is not None else "auto"
 
         # stream schemas: defined + inferred from query outputs
         self.schemas: dict = {}
@@ -118,6 +122,11 @@ class SiddhiAppRuntime:
         # and single-writer by design)
         import threading
         self._lock = threading.RLock()
+        # sink deliveries staged inside _drain (under the lock) and flushed
+        # after release: a sink publishing into another runtime's source
+        # (which takes THAT runtime's lock) could otherwise ABBA-deadlock
+        # when two runtimes publish to each other's topics (advisor r2)
+        self._sink_outbox: list = []
         self._sched_thread = None
         self._sched_stop = None
 
@@ -233,6 +242,7 @@ class SiddhiAppRuntime:
                     if due and min(due) <= now:
                         self._fire_timers(now)
                         self._clock_ms = None    # stay in wall-clock mode
+                self._flush_sink_outbox()
 
         self._sched_thread = threading.Thread(
             target=pump, name="siddhi-scheduler", daemon=True)
@@ -313,6 +323,7 @@ class SiddhiAppRuntime:
             self._fire_timers(ms)
             self._clock_ms = ms
             self._drain()
+        self._flush_sink_outbox()
 
     def _fire_timers(self, upto_ms: int) -> None:
         guard = 0
@@ -337,6 +348,7 @@ class SiddhiAppRuntime:
     def send(self, stream_id: str, data, timestamp: Optional[int] = None) -> None:
         with self._lock:
             self._send_locked(stream_id, data, timestamp)
+        self._flush_sink_outbox()
 
     def _send_locked(self, stream_id: str, data, timestamp: Optional[int]) -> None:
         schema = self.schemas[stream_id]
@@ -380,6 +392,18 @@ class SiddhiAppRuntime:
                 if len(b):
                     self._pending.append((sid, b.freeze_and_clear()))
             self._drain()
+        self._flush_sink_outbox()
+
+    def _flush_sink_outbox(self) -> None:
+        """Deliver staged sink payloads outside the runtime lock.  When
+        called from a nested frame the outer frame may still hold the RLock;
+        the outermost public entry always ends with an unlocked flush."""
+        while True:
+            try:        # pop-then-use: safe vs the scheduler pump thread
+                fn, events = self._sink_outbox.pop(0)
+            except IndexError:
+                return
+            fn(events)
 
     def _drain(self) -> None:
         guard = 0

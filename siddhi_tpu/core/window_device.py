@@ -31,7 +31,6 @@ adaptive protocol as the pattern kernel (pattern_plan.py).
 """
 from __future__ import annotations
 
-import functools
 from typing import Callable, Optional
 
 import jax
@@ -291,6 +290,15 @@ class DeviceWindowAggPlan(QueryPlan):
                     raise DeviceWindowUnsupported("grouped sliding min/max")
                 arg_ce = (compile_expression(arg_ast, ctx)
                           if arg_ast is not None else None)
+                # strings are dictionary codes on device: min()/max() would
+                # compare codes, not lexicographic order, and sum()/avg()
+                # would aggregate codes — fall back to the host interpreter
+                # (advisor r2 HIGH finding)
+                if arg_ce is not None and s.name in ("min", "max", "sum", "avg") \
+                        and arg_ce.type not in (AttrType.INT, AttrType.LONG,
+                                                AttrType.FLOAT, AttrType.DOUBLE):
+                    raise DeviceWindowUnsupported(
+                        f"{s.name}() over non-numeric ({arg_ce.type.name}) column")
                 self.sites.append((s.name, arg_ce, s.out_type))
 
             extra = {f"__agg{i}": (f"__agg{i}", s.out_type)
@@ -388,8 +396,18 @@ class DeviceWindowAggPlan(QueryPlan):
 
     # -- kernel --------------------------------------------------------------
 
-    @functools.lru_cache(maxsize=None)
     def _step_fn(self, T: int, C: int) -> Callable:
+        """Per-instance cache (an lru_cache on the bound method would pin
+        the plan instance and its compiled fns forever — advisor r2)."""
+        cache = getattr(self, "_step_cache", None)
+        if cache is None:
+            cache = self._step_cache = {}
+        fn = cache.get((T, C))
+        if fn is None:
+            fn = cache[(T, C)] = self._build_step_fn(T, C)
+        return fn
+
+    def _build_step_fn(self, T: int, C: int) -> Callable:
         kind = self.kind
         sites = self.sites
         group_keys = self.group_keys
