@@ -185,6 +185,14 @@ def plan_query(rt, q: ast.Query, default_name: str):
             from .pattern_plan import DevicePatternPlan
             return attach_table_writer(rt, DevicePatternPlan(
                 name, rt, q, inp, target, slots=rt.device_slots), q, name)
+        if mode == "prefer":
+            from .nfa_device import DeviceNFAUnsupported
+            from .pattern_plan import DevicePatternPlan
+            try:
+                return attach_table_writer(rt, DevicePatternPlan(
+                    name, rt, q, inp, target, slots=rt.device_slots), q, name)
+            except DeviceNFAUnsupported:
+                pass
         if mode == "auto":
             pass   # P=1 on a remote chip loses to the host matcher; the
                    # partition planner routes partitioned patterns here

@@ -3,24 +3,45 @@
 The north-star component (SURVEY §3.3): the reference walks per-event
 pending-StateEvent lists through Pre/PostStateProcessor chains
 (reference: core:query/input/stream/state/StreamPreStateProcessor.java:292,
-StreamPostStateProcessor.java:53).  Here the whole matcher is ONE fused
-array program:
+StreamPostStateProcessor.java:53, LogicalPreStateProcessor.java:330-337,
+CountPreStateProcessor.java:370-393, AbsentStreamPreStateProcessor.java:60-115).
+Here the whole matcher is ONE fused array program:
 
   * the partition axis P (reference: core:partition/PartitionRuntime.java
     clones the query graph per key) becomes the minor (lane) axis —
     thousands of independent NFA instances evaluated in lockstep and
     shardable over a `jax.sharding.Mesh`;
   * pending partial matches become A fixed "slots" per partition laid out
-    (A, P): `sidx` (0 = free, 1..S-1 = waiting, S = parked completion)
-    plus capture rows `ref.attr -> (A, P)`;
+    (A, P): `occ` (0 = free, p = stationed at position p-1, S+1 = parked
+    completion) plus capture rows `ref.attr -> (A, P)`;
   * a micro-batch becomes a dense (T, P) block — one event per partition
     per `lax.scan` step, so in-partition order (the sequential semantics)
     is preserved while all partitions and slots advance in parallel;
   * `every` heads are an always-armed flag; `within` expiry, sequence
-    strictness, and match emission are masked vector ops.
+    strictness, logical fills, count collection, absent deadlines, and
+    match emission are masked vector ops.
 
-TPU-economics of this kernel (what round-2 got wrong and this design
-fixes; measured on v5e):
+Pattern algebra on device (mirrors the host oracle interp/nfa.py):
+  * count quantifiers `<m:n>` / `+`: a per-slot counter row per count
+    position; collection is decoupled from the slot's station (`cnt_active`)
+    so a partial match keeps absorbing occurrences while waiting further
+    down the chain, exactly like the reference's pending count lists;
+    indexed captures (e1[0], e1[i], e1[last], e1[last-1]) are capture rows;
+    completions whose count is still collecting emit WITHOUT freeing the
+    slot (more occurrences -> more matches).
+  * logical `and`/`or`: a position holds a partner pair with a fill
+    bitmask; `or` completions leave the other ref NULL (emitted present
+    bits -> host-side null columns); an absent partner (`not X and e2=Y`)
+    kills the slot when X arrives.
+  * absent (`not X for T`): a deadline row per absent position; the
+    forbidden stream's arrival kills the slot; deadline passage emits (at
+    the deadline timestamp) or advances.  Deadlines fire on timer "tick"
+    cells injected by the host scheduler (and, in playback mode, lazily
+    against event timestamps, matching the host's pre-fire loop); the
+    block reports the earliest pending deadline so the host scheduler
+    knows when to tick.
+
+TPU-economics of this kernel (what round-2 got wrong; measured on v5e):
   * NO f64/i64 inside the scan.  x64 arrays are emulated as f32/u32
     pairs, which (a) doubles every carry/output buffer and (b) made XLA
     choose mismatched layouts for the big scan-output accumulators,
@@ -36,17 +57,17 @@ fixes; measured on v5e):
     evaluated for the WHOLE block outside the scan as fused (T, P)
     vector ops; only capture-dependent conjuncts run per-step.
   * completing slots park their snapshot in slot storage (sentinel
-    state) and drain through E narrow i32/f32 lanes per step (masked
+    station) and drain through E narrow i32/f32 lanes per step (masked
     one-hot reductions — TPU scatters serialize); after the scan,
-    ceil(A/E) drain rounds empty any backlog, then ONE
-    cumsum+searchsorted+gather per lane-grid row compacts matches into
-    a flat (M,) buffer (capacity doubled-and-retried on overflow —
-    state is functional, so a retry is exact).
+    ceil(A/E) drain rounds empty any backlog, then ONE cumsum + one
+    scatter per lane-grid row compacts matches into a flat (M,) buffer
+    (capacity doubled-and-retried on overflow — state is functional, so
+    a retry is exact).
 
-Supported device subset (everything else falls back to the sequential
-host matcher, interp/nfa.py): linear chains of single-count stream states
-with an optional `every` head and per-element/query `within`; predicates
-may reference any earlier capture (e2[price > e1.price]).
+Still host-only (DeviceNFAUnsupported -> sequential fallback):
+`every` below the head, absent states in the head position, min-count 0,
+adjacent count positions, sequences containing absent/logical states,
+non-Variable selector outputs over maybe-absent refs.
 """
 from __future__ import annotations
 
@@ -66,6 +87,7 @@ from .schema import StreamSchema, StringTable
 
 # local-offset budget: rebase when offsets approach this (i32 headroom)
 LOCAL_SPAN = 1 << 30
+NO_DEADLINE = np.int32(2**31 - 1)
 
 
 class DeviceNFAUnsupported(Exception):
@@ -92,19 +114,44 @@ class PatternFilterContext(MultiStreamContext):
 
 
 @dataclass
-class ChainState:
+class PNode:
+    """One condition inside a position (a reference Pre/PostStateProcessor)."""
     ref: str
     stream_id: str
-    scode: int                      # index into spec.stream_ids
-    within_ms: Optional[int]
-    # filter conjuncts, split by what they read:
-    pre_conjs: list = field(default_factory=list)   # event-only -> (T,P) pre-pass
-    step_conjs: list = field(default_factory=list)  # capture-referencing -> in-scan
+    scode: int
+    kind: str                       # "stream" | "absent"
+    waiting_ms: Optional[int]       # absent `for T`
+    pre_conjs: list = field(default_factory=list)   # event-only -> (T,P)
+    step_conjs: list = field(default_factory=list)  # capture-referencing
+    pre_key: Optional[str] = None   # xs key of the precomputed mask
+
+
+@dataclass
+class Position:
+    """One chain position: a single state or a logical partner pair."""
+    nodes: list                     # [PNode] (2 for logical)
+    op: Optional[str] = None        # None | "and" | "or"
+    min_count: int = 1
+    max_count: int = 1
+    within_ms: Optional[int] = None
+    sticky: bool = False            # `every` head arm
+    # state-row assignments (set by the kernel):
+    cnt_row: Optional[int] = None   # counter row (count positions)
+    log_row: Optional[int] = None   # fill-bit row (logical positions)
+    dl_rows: Optional[dict] = None  # node idx -> deadline row (absent+for)
+
+    @property
+    def is_count(self) -> bool:
+        return (self.min_count, self.max_count) != (1, 1)
+
+    @property
+    def refs(self) -> list:
+        return [n.ref for n in self.nodes]
 
 
 @dataclass
 class ChainSpec:
-    states: list                     # [ChainState]
+    positions: list                  # [Position]
     stream_ids: list                 # distinct stream ids, scode order
     schemas: dict                    # ref -> StreamSchema
     is_sequence: bool
@@ -112,7 +159,23 @@ class ChainSpec:
 
     @property
     def S(self) -> int:
-        return len(self.states)
+        return len(self.positions)
+
+    @property
+    def all_nodes(self) -> list:
+        return [n for p in self.positions for n in p.nodes]
+
+    def maybe_absent_refs(self) -> set:
+        """Refs that can be NULL in an emitted match (or-sides, absent
+        nodes, and-pair sides advanced by a partner deadline)."""
+        out = set()
+        for p in self.positions:
+            if p.op is not None:
+                out.update(p.refs)
+            for n in p.nodes:
+                if n.kind == "absent":
+                    out.add(n.ref)
+        return out
 
 
 def _conjuncts(e: ast.Expression) -> list:
@@ -123,10 +186,11 @@ def _conjuncts(e: ast.Expression) -> list:
 
 def lower_chain(state_input, schemas_by_stream: dict, strings: StringTable,
                 filters_by_node: list) -> ChainSpec:
-    """Validate + lower a StateInputStream into a linear device chain.
+    """Validate + lower a StateInputStream into a device position chain.
 
     Reuses the host NFACompiler lowering so device and host agree on
-    structure; anything non-linear raises DeviceNFAUnsupported.
+    structure; anything outside the supported algebra raises
+    DeviceNFAUnsupported (-> sequential fallback).
     """
     from ..interp.nfa import NFACompiler
     from ..query.ast import StateType
@@ -134,46 +198,96 @@ def lower_chain(state_input, schemas_by_stream: dict, strings: StringTable,
     comp = NFACompiler()
     entries, _exits = comp.lower(state_input.state)
     nodes = comp.nodes
-    if len(entries) != 1 or entries[0].id != nodes[0].id:
-        raise DeviceNFAUnsupported("non-single-entry pattern")
-    order = []
-    nid = nodes[0].id
-    while nid is not None:
-        order.append(nodes[nid])
-        nid = nodes[nid].next_id
-    if len(order) != len(nodes):
-        raise DeviceNFAUnsupported("non-linear state graph")
+    is_sequence = state_input.type == StateType.SEQUENCE
     qw = state_input.within.millis if state_input.within else None
+
+    # walk entry -> FINAL, grouping logical partners into one position
+    if len(entries) == 1:
+        head_ids = [entries[0].id]
+    elif len(entries) == 2 and entries[0].partner_id == entries[1].id:
+        head_ids = [entries[0].id, entries[1].id]
+    else:
+        raise DeviceNFAUnsupported("unsupported entry structure")
+
     stream_ids, scode_of = [], {}
-    states = []
-    for i, n in enumerate(order):
-        if n.kind != "stream" or n.partner_id is not None:
-            raise DeviceNFAUnsupported("absent/logical states")
-        if n.min_count != 1 or n.max_count != 1:
-            raise DeviceNFAUnsupported("count quantifiers")
-        if n.sticky and i != 0:
-            raise DeviceNFAUnsupported("`every` on a non-head state")
-        if n.stream_id not in schemas_by_stream:
-            raise DeviceNFAUnsupported(f"unknown stream {n.stream_id!r}")
-        if n.stream_id not in scode_of:
-            scode_of[n.stream_id] = len(stream_ids)
-            stream_ids.append(n.stream_id)
-        w = n.within_ms if n.within_ms is not None else qw
+
+    def scode(sid: str) -> int:
+        if sid not in schemas_by_stream:
+            raise DeviceNFAUnsupported(f"unknown stream {sid!r}")
+        if sid not in scode_of:
+            scode_of[sid] = len(stream_ids)
+            stream_ids.append(sid)
+        return scode_of[sid]
+
+    def mk_pnode(n) -> PNode:
+        return PNode(n.ref, n.stream_id, scode(n.stream_id), n.kind,
+                     n.waiting_ms)
+
+    positions: list = []
+    seen: set = set()
+    cur = head_ids
+    while cur:
+        n0 = nodes[cur[0]]
+        group = [n0] + ([nodes[n0.partner_id]] if n0.partner_id is not None
+                        else [])
+        for g in group:
+            if g.id in seen:
+                raise DeviceNFAUnsupported("cyclic state graph")
+            seen.add(g.id)
+        pos = Position([mk_pnode(g) for g in group])
+        if n0.partner_id is not None:
+            pos.op = n0.partner_op
+        pos.min_count, pos.max_count = n0.min_count, n0.max_count
+        w = n0.within_ms if n0.within_ms is not None else qw
         if w is not None and w >= LOCAL_SPAN:
             raise DeviceNFAUnsupported("within > ~12 days (i32 ms offsets)")
-        states.append(ChainState(n.ref, n.stream_id, scode_of[n.stream_id], w))
-    spec = ChainSpec(states, stream_ids,
-                     {s.ref: schemas_by_stream[s.stream_id] for s in states},
-                     state_input.type == StateType.SEQUENCE,
-                     bool(order[0].sticky))
-    # compile filters (indices follow NFACompiler node creation order ==
-    # chain order for linear chains), split into event-only vs capture-
-    # referencing conjuncts
-    for si, (st, elem_filters) in enumerate(zip(spec.states, filters_by_node)):
+        pos.within_ms = w
+        pos.sticky = bool(n0.sticky)
+        positions.append(pos)
+        nxt = n0.next_id
+        cur = [nxt] if nxt is not None else []
+    if len(seen) != len(nodes):
+        raise DeviceNFAUnsupported("non-linear state graph")
+
+    # ---- support matrix ---------------------------------------------------
+    S = len(positions)
+    for i, pos in enumerate(positions):
+        if pos.sticky and i != 0:
+            raise DeviceNFAUnsupported("`every` below the head")
+        if pos.min_count == 0:
+            raise DeviceNFAUnsupported("min-count 0 (optional state)")
+        if pos.is_count and (pos.op is not None
+                             or pos.nodes[0].kind == "absent"):
+            raise DeviceNFAUnsupported("count on logical/absent state")
+        if pos.is_count and i + 1 < S and positions[i + 1].is_count:
+            raise DeviceNFAUnsupported("adjacent count positions")
+        if i == 0 and any(n.kind == "absent" for n in pos.nodes):
+            raise DeviceNFAUnsupported("absent state in the head position")
+        if is_sequence and (pos.op is not None
+                            or any(n.kind == "absent" for n in pos.nodes)):
+            raise DeviceNFAUnsupported("sequence with logical/absent states")
+    if sum(1 for p_ in positions if p_.is_count) > 1:
+        raise DeviceNFAUnsupported("multiple count positions")
+
+    schemas = {n.ref: schemas_by_stream[n.stream_id]
+               for p in positions for n in p.nodes}
+    spec = ChainSpec(positions, stream_ids, schemas, is_sequence,
+                     positions[0].sticky)
+
+    # ---- compile filters (filters_by_node follows NFACompiler node order) -
+    flat_pnodes: dict = {}
+    for p in positions:
+        for n in p.nodes:
+            flat_pnodes[n.ref] = n
+    for host_n, elem_filters in zip(nodes, filters_by_node):
+        pn = flat_pnodes.get(host_n.ref)
+        if pn is None:
+            continue
         conjs: list = []
         for f in elem_filters:
             conjs.extend(_conjuncts(f.expr))
-        ctx = PatternFilterContext(spec.schemas, strings, st.ref)
+        ctx = PatternFilterContext(spec.schemas, strings, pn.ref)
+        is_head = host_n.id in head_ids
         for c in conjs:
             try:
                 ce = compile_expression(c, ctx)
@@ -181,15 +295,15 @@ def lower_chain(state_input, schemas_by_stream: dict, strings: StringTable,
                 raise DeviceNFAUnsupported(f"filter not device-compilable: {e}")
             if ce.type != ast.AttrType.BOOL:
                 raise DeviceNFAUnsupported("non-boolean filter")
-            own = {f"{st.ref}.{a.name}" for a in spec.schemas[st.ref].attributes}
+            own = {f"{pn.ref}.{a.name}" for a in spec.schemas[pn.ref].attributes}
             own.add("__timestamp__")
             if set(ce.reads) <= own:
-                st.pre_conjs.append(ce)
+                pn.pre_conjs.append(ce)
             else:
-                if si == 0:
+                if is_head:
                     raise DeviceNFAUnsupported(
                         "head filter references later captures")
-                st.step_conjs.append(ce)
+                pn.step_conjs.append(ce)
     return spec
 
 
@@ -200,83 +314,161 @@ def lower_chain(state_input, schemas_by_stream: dict, strings: StringTable,
 _I32 = jnp.int32
 
 
+def _base_ref(refpart: str):
+    """'e1' -> ('e1', None); 'e1[0]' -> ('e1', 0); 'e1[last]' etc."""
+    if "[" in refpart and refpart.endswith("]"):
+        base, idx = refpart[:-1].split("[", 1)
+        return base, idx
+    return refpart, None
+
+
 class NFAKernel:
     """Builds the jitted block function for one ChainSpec.
 
     state pytree (persistent across blocks; all (A, P) with P minor):
-      sidx     (A, P) i32      0 = free, si = waiting at chain state si,
-                               S = parked completion awaiting a drain lane
+      occ      (A, P) i32      0 = free, p = stationed at position p-1,
+                               S+1 = parked completion awaiting a drain lane
       first_ts (A, P) i32      head-capture ts offset (within anchor)
       head_seq (A, P) i32      head-capture seq offset (emission tie order)
-      caps_f   (Kf, A, P) f32  float capture rows (see self.rows_f)
-      caps_i   (Ki, A, P) i32  int/string/bool capture rows + parked
-                               completion ts/seq (self.rows_i)
-      caps_l   (Kl, A, P) i64  LONG capture rows (self.rows_l; emitted as
-                               hi/lo i32 lane pairs)
+      cnt      (Kc, A, P) i32  occurrence counters (count positions)
+      cnt_on   (Kc, A, P) bool still-collecting flags
+      narm     (Kc, A, P) bool successor armed (set when cnt hits min,
+                               consumed by the successor's match — the
+                               reference re-registers the next state only
+                               at the exact min crossing)
+      fl       (Kl, A, P) i32  logical fill bits (1 = left, 2 = right)
+      dl       (Ka, A, P) i32  absent deadlines (NO_DEADLINE = disarmed)
+      caps_f   (Kf, A, P) f32  float capture rows (self.rows_f)
+      caps_i   (Ki, A, P) i32  int/string/bool/present capture rows +
+                               parked completion ts/seq (self.rows_i)
+      caps_l   (Kl', A, P) i64 LONG capture rows (hi/lo i32 lane pairs)
       armed0   (P,)  bool      entry arm (always True for `every`)
-      of_slots (P,)  i32       slot-exhaustion events (head drops; the host
-                               grows A and retries, so only nonzero once
-                               the A_CAP ceiling is hit)
+      of_slots (P,)  i32       head drops from slot exhaustion
+      of_lanes (P,)  i32       direct-emit drops (count-survivor bursts
+                               wider than E; host doubles E and retries)
 
     block(state, ev) -> (state', out): ev holds (T, P) i32/f32 grids plus
-    0-d base scalars; out packs the compacted match buffer into an i32
-    matrix + f32 matrix (two host transfers).
+    0-d base scalars; out is ONE packed i32 matrix (+ f64 matrix only in
+    f64 mode).  out row 0 = [n, of_slots, of_lanes, min_deadline, ...].
     """
 
     def __init__(self, spec: ChainSpec, sel_fns: dict, having: Optional[CompiledExpr],
-                 P: int, A: int, E: Optional[int] = None, f64: bool = False):
+                 P: int, A: int, E: Optional[int] = None, f64: bool = False,
+                 playback: bool = False):
         self.spec = spec
-        self.sel_fns = sel_fns          # out name -> CompiledExpr (over ref.attr env)
+        self.sel_fns = sel_fns          # out name -> CompiledExpr (ref.attr env)
         self.having = having
         self.P, self.A = P, A
         self.f64 = f64
+        self.playback = playback
         self._mode = None if f64 else F32_MODE
-        # emission lanes: completions drained per partition per step; parked
-        # backlog drains on later steps / post-scan rounds, so E stays narrow
-        # without ever losing a match.
         self.E = E if E is not None else (1 if spec.S == 1 else min(A, 2))
+
+        # ---- state-row assignment ----------------------------------------
+        kc = kl = ka = 0
+        for pos in spec.positions:
+            if pos.is_count:
+                pos.cnt_row = kc
+                kc += 1
+            if pos.op is not None:
+                pos.log_row = kl
+                kl += 1
+            pos.dl_rows = {}
+            for ni, n in enumerate(pos.nodes):
+                if n.kind == "absent" and n.waiting_ms is not None:
+                    pos.dl_rows[ni] = ka
+                    ka += 1
+        self.Kc, self.Kl, self.Ka = kc, kl, ka
+        self.has_absent = any(n.kind == "absent" for n in spec.all_nodes)
 
         # ---- capture rows: only columns something downstream reads -------
         cap_keys: set = set()
-        for st in spec.states:
-            for ce in st.step_conjs:
-                for k in ce.reads:
-                    if k == "__timestamp__":
-                        continue
-                    ref = k.split(".", 1)[0]
-                    if ref != st.ref:
-                        cap_keys.add(k)
+        for pos in spec.positions:
+            for n in pos.nodes:
+                for ce in n.step_conjs:
+                    for k in ce.reads:
+                        if k == "__timestamp__":
+                            continue
+                        ref = k.split(".", 1)[0]
+                        if ref != n.ref:
+                            cap_keys.add(k)
         for ce in list(sel_fns.values()) + ([having] if having else []):
             for k in ce.reads:
-                if "." in k and not k.startswith("__"):
+                if k.startswith("__present__."):
                     cap_keys.add(k)
+                elif "." in k and not k.startswith("__"):
+                    cap_keys.add(k)
+        # present bits for maybe-absent refs are always emitted (host null
+        # reconstruction needs them even when the selector doesn't is-null)
+        self._maybe_absent = spec.maybe_absent_refs()
+        sel_refs = set()
+        for ce in sel_fns.values():
+            for k in ce.reads:
+                if "." in k and not k.startswith("__"):
+                    sel_refs.add(_base_ref(k.split(".", 1)[0])[0])
+        for r in self._maybe_absent & sel_refs:
+            cap_keys.add(f"__present__.{r}")
+
         self._key_type: dict = {}
         for k in sorted(cap_keys):
-            ref, attr = k.split(".", 1)
-            if ref not in spec.schemas:
+            if k.startswith("__present__."):
+                self._key_type[k] = ast.AttrType.BOOL
+                continue
+            refpart, attr = k.split(".", 1)
+            base, cidx = _base_ref(refpart)
+            if base not in spec.schemas:
                 raise DeviceNFAUnsupported(f"unresolvable capture key {k!r}")
-            self._key_type[k] = spec.schemas[ref].type_of(attr)
+            if cidx is not None and cidx not in ("last", "last-1") \
+                    and not cidx.isdigit():
+                raise DeviceNFAUnsupported(f"indexed capture {k!r}")
+            self._key_type[k] = spec.schemas[base].type_of(attr)
         with compute_dtypes(self._mode):
-            grp = {k: self._group_of(jnp_dtype(t))
-                   for k, t in self._key_type.items()}
+            grp = {}
+            for k, t in self._key_type.items():
+                if k.startswith("__present__."):
+                    grp[k] = "i"
+                else:
+                    grp[k] = self._group_of(jnp_dtype(t))
         self.rows_f = [k for k in sorted(cap_keys) if grp[k] == "f"]
         self.rows_l = [k for k in sorted(cap_keys) if grp[k] == "l"]
         self.rows_i = [k for k in sorted(cap_keys) if grp[k] == "i"]
-        if spec.S > 1:
+        if spec.S > 1 or self.has_absent or spec.positions[0].op is not None \
+                or spec.positions[0].is_count:
             self.rows_i += ["__comp_ts__", "__comp_seq__"]
+        self._parked_emission = "__comp_ts__" in self.rows_i
         self._row_of = {k: ("f", i) for i, k in enumerate(self.rows_f)}
         self._row_of.update({k: ("i", i) for i, k in enumerate(self.rows_i)})
         self._row_of.update({k: ("l", i) for i, k in enumerate(self.rows_l)})
 
+        # or-sides whose selected outputs must come back as NULL: selector
+        # outputs that are plain variables over maybe-absent refs (anything
+        # fancier can't be null-reconstructed host-side)
+        self.null_outputs: dict = {}      # out name -> ref
+        for name, ce in sel_fns.items():
+            reads = [k for k in ce.reads if "." in k and not k.startswith("__")]
+            refs = {_base_ref(k.split(".", 1)[0])[0] for k in reads}
+            hit = refs & self._maybe_absent
+            if not hit:
+                continue
+            if len(reads) == 1 and len(hit) == 1:
+                self.null_outputs[name] = next(iter(hit))
+            else:
+                raise DeviceNFAUnsupported(
+                    f"selector output {name!r} mixes maybe-absent refs")
+
         # ---- output rows (post-selector) ----------------------------------
         self.out_names = list(sel_fns) + ["__timestamp__", "__seq__",
                                           "__head_seq__"]
+        for r in sorted(self._maybe_absent & sel_refs):
+            self.out_names.append(f"__present__.{r}")
         with compute_dtypes(self._mode):
             self.out_dtypes = {n: jnp_dtype(ce.type)
                                for n, ce in sel_fns.items()}
         self.out_dtypes["__timestamp__"] = _I32   # local offsets
         self.out_dtypes["__seq__"] = _I32
         self.out_dtypes["__head_seq__"] = _I32
+        for r in self._maybe_absent & sel_refs:
+            self.out_dtypes[f"__present__.{r}"] = _I32
         self._block_cache: dict = {}    # (T, M) -> jitted fn
 
     @staticmethod
@@ -296,14 +488,20 @@ class NFAKernel:
     def init_state(self) -> dict:
         P, A = self.P, self.A
         return {
-            "sidx": jnp.zeros((A, P), dtype=_I32),
+            "occ": jnp.zeros((A, P), dtype=_I32),
             "first_ts": jnp.zeros((A, P), dtype=_I32),
             "head_seq": jnp.zeros((A, P), dtype=_I32),
+            "cnt": jnp.zeros((self.Kc, A, P), dtype=_I32),
+            "cnt_on": jnp.zeros((self.Kc, A, P), dtype=bool),
+            "narm": jnp.zeros((self.Kc, A, P), dtype=bool),
+            "fl": jnp.zeros((self.Kl, A, P), dtype=_I32),
+            "dl": jnp.full((self.Ka, A, P), int(NO_DEADLINE), dtype=_I32),
             "caps_f": jnp.zeros((len(self.rows_f), A, P), dtype=self.fdt),
             "caps_i": jnp.zeros((len(self.rows_i), A, P), dtype=_I32),
             "caps_l": jnp.zeros((len(self.rows_l), A, P), dtype=jnp.int64),
             "armed0": jnp.ones((P,), dtype=bool),
             "of_slots": jnp.zeros((P,), dtype=_I32),
+            "of_lanes": jnp.zeros((P,), dtype=_I32),
         }
 
     # -- env helpers -----------------------------------------------------
@@ -319,49 +517,59 @@ class NFAKernel:
             env[k] = col
         return env
 
-    def _event_env(self, x: dict, st: ChainState, base_ts) -> dict:
+    def _event_env(self, x: dict, n: PNode, base_ts) -> dict:
         """Arriving event's own columns as (P,) arrays (broadcast vs (A,P))."""
         env = {}
-        sch = self.spec.schemas[st.ref]
+        sch = self.spec.schemas[n.ref]
         for a in sch.attributes:
-            key = f"{st.scode}.{a.name}"
+            key = f"{n.scode}.{a.name}"
             if key in x:
-                env[f"{st.ref}.{a.name}"] = x[key]
+                env[f"{n.ref}.{a.name}"] = x[key]
         env["__timestamp__"] = base_ts + x["__ts__"].astype(jnp.int64)
         return env
 
-    def _write_caps(self, caps: dict, mask, st: ChainState, x: dict,
-                    extra: Optional[dict] = None) -> dict:
-        """Masked write of state st's captured event columns into slot
-        storage; `mask` is (A, P).  One select per dtype group."""
+    def _node_match(self, x: dict, n: PNode, caps_env: dict, base_ts,
+                    valid) -> jnp.ndarray:
+        """(A, P) mask: does the arriving event satisfy node n's condition
+        (stream + filters)?  Independent of slot station."""
+        P = self.P
+        m = valid
+        if len(self.spec.stream_ids) > 1:
+            m = m & (x["__scode__"] == n.scode)
+        if n.pre_key is not None:
+            m = m & x[n.pre_key]
+        m = jnp.broadcast_to(m, (self.A, P)) if m.ndim == 1 else m
+        for ce in n.step_conjs:
+            env = dict(caps_env)
+            env.update(self._event_env(x, n, base_ts))
+            m = m & jnp.broadcast_to(ce.fn(env), (self.A, P))
+        return m
+
+    def _write_caps(self, caps: dict, mask, values: dict) -> dict:
+        """Masked write of named values into capture rows; `mask` (A,P);
+        values maps cap key -> (P,) / (A,P) array (missing keys skipped)."""
         caps = dict(caps)
-        ev_env = {}
-        sch = self.spec.schemas[st.ref]
-        for a in sch.attributes:
-            key = f"{st.scode}.{a.name}"
-            if key in x:
-                ev_env[f"{st.ref}.{a.name}"] = x[key]
-        if extra:
-            ev_env.update(extra)
-        for g in ("f", "i", "l"):
-            rows = {"f": self.rows_f, "i": self.rows_i, "l": self.rows_l}[g]
+        for g, rows in (("f", self.rows_f), ("i", self.rows_i),
+                        ("l", self.rows_l)):
             idx, vals = [], []
+            arr = caps[f"caps_{g}"]
             for i, k in enumerate(rows):
-                if k in ev_env:
+                if k in values:
                     idx.append(i)
-                    v = ev_env[k]
-                    dt = caps[f"caps_{g}"].dtype
-                    vals.append(jnp.broadcast_to(v, (self.P,)).astype(dt))
+                    v = values[k]
+                    if getattr(v, "ndim", 0) < 2:
+                        v = jnp.broadcast_to(v, (self.P,))[None, :]
+                    vals.append(v.astype(arr.dtype))
             if not idx:
                 continue
-            arr = caps[f"caps_{g}"]
             if len(idx) == arr.shape[0]:
-                new = jnp.stack(vals, axis=0)[:, None, :]        # (K,1,P)
+                new = jnp.stack([jnp.broadcast_to(v, (self.A, self.P))
+                                 for v in vals], axis=0)
                 caps[f"caps_{g}"] = jnp.where(mask[None], new, arr)
             else:
                 for i, v in zip(idx, vals):
                     caps[f"caps_{g}"] = caps[f"caps_{g}"].at[i].set(
-                        jnp.where(mask, v[None, :], caps[f"caps_{g}"][i]))
+                        jnp.where(mask, v, caps[f"caps_{g}"][i]))
         return caps
 
     # -- the per-event step ----------------------------------------------
@@ -369,134 +577,479 @@ class NFAKernel:
     def _step(self, carry: dict, x: dict):
         spec, P, A, E = self.spec, self.P, self.A, self.E
         S = spec.S
-        sidx = carry["sidx"]
+        PARK = S + 1
+        occ0 = carry["occ"]           # pre-event stations (two-phase commit)
+        occ = occ0
         first_ts, head_seq = carry["first_ts"], carry["head_seq"]
+        cnt, cnt_on, fl, dl = (carry["cnt"], carry["cnt_on"], carry["fl"],
+                               carry["dl"])
+        narm = carry["narm"]
         caps = {k: carry[k] for k in ("caps_f", "caps_i", "caps_l")}
-        armed0, of_slots = carry["armed0"], carry["of_slots"]
+        armed0 = carry["armed0"]
+        of_slots, of_lanes = carry["of_slots"], carry["of_lanes"]
         base_ts = x["__base_ts__"]
 
         ts, seq, valid = x["__ts__"], x["__seq__"], x["__valid__"]
-        scode = x.get("__scode__")
-        single_stream = scode is None
+        tick = x.get("__tick__")
+        timey = valid if tick is None else (valid | tick)
+        if self.playback:
+            dl_fire = timey
+        elif tick is not None:
+            dl_fire = tick
+        else:
+            dl_fire = jnp.zeros((P,), dtype=bool)
 
-        # 1+2. within expiry (now = event ts; lazy, reference
-        #    StreamPreStateProcessor.java:102-113) folded into the per-state
-        #    match pass; matches are against PRE-event state (two-phase
-        #    commit: one event can't climb two chained states)
-        age = ts[None, :] - first_ts
-        expired = jnp.zeros((A, P), dtype=bool)
-        total_match = jnp.zeros((A, P), dtype=bool)
-        complete = jnp.zeros((A, P), dtype=bool)
-        cap_writes = []    # (mask (A,P), state)
         caps_env = self._caps_env(caps)
-        for si in range(1, S):
-            st = spec.states[si]
-            at_s = (sidx == si) & valid[None, :]
-            if st.within_ms is not None:
-                exp_s = at_s & (age > jnp.int32(st.within_ms))
-                expired = expired | exp_s
-                at_s = at_s & ~exp_s
-            ok = at_s if single_stream else at_s & (scode == st.scode)[None, :]
-            if st.pre_conjs:
-                ok = ok & x[f"__pre{si}__"][None, :]
-            for ce in st.step_conjs:
-                env = dict(caps_env)
-                env.update(self._event_env(x, st, base_ts))
-                pred = ce.fn(env)
-                ok = ok & jnp.broadcast_to(pred, (A, P))
-            total_match = total_match | ok
-            if si == S - 1:
-                complete = ok
-            else:
-                cap_writes.append((ok, st))
-        sidx = jnp.where(expired, 0, sidx)
+        age = ts[None, :] - first_ts
+        transitioned = jnp.zeros((A, P), dtype=bool)
+        complete = jnp.zeros((A, P), dtype=bool)
+        kill = jnp.zeros((A, P), dtype=bool)
+        enters: list = []             # (target position index, mask)
+        cap_writes: list = []         # (mask, values dict)
 
-        # 3. head match (entry arm; head filters are all pre-evaluated)
-        h = spec.states[0]
-        ok0 = armed0 & valid if single_stream \
-            else armed0 & (scode == h.scode) & valid
-        if h.pre_conjs:
-            ok0 = ok0 & x["__pre0__"]
+        # node-match masks (station-independent; shared below)
+        nm: dict = {}
+        for pi, pos in enumerate(spec.positions):
+            for ni, n in enumerate(pos.nodes):
+                nm[(pi, ni)] = self._node_match(x, n, caps_env, base_ts, valid)
+
+        # absent-deadline pre-pass: deadlines at or before this event's
+        # timestamp fire BEFORE the event is processed (the host's playback
+        # pre-fire loop / scheduler ordering), so the freed slot can consume
+        # this very event at its next position
+        for pi, pos in enumerate(spec.positions):
+            if pos.op is not None or not pos.dl_rows:
+                continue
+            n0 = pos.nodes[0]
+            if n0.kind != "absent":
+                continue
+            r = pos.dl_rows[0]
+            due = (occ0 == pi + 1) & (dl[r] <= ts[None, :]) & dl_fire[None, :]
+            if pi == S - 1:
+                complete = complete | due
+                cap_writes.append((due, {
+                    "__comp_ts__": dl[r], "__comp_seq__": seq,
+                    f"__present__.{n0.ref}": jnp.zeros((P,), _I32)}))
+            else:
+                occ0 = jnp.where(due, pi + 2, occ0)
+                cnt, cnt_on, narm, fl, dl2 = self._enter_position(
+                    pi + 1, due, cnt, cnt_on, narm, fl, dl, dl[r])
+                dl = dl2
+            dl = dl.at[r].set(jnp.where(due, NO_DEADLINE, dl[r]))
+        occ = occ0
+
+        # within expiry per station (lazy, on event/tick time — reference
+        # StreamPreStateProcessor.java:102-113)
+        expired = jnp.zeros((A, P), dtype=bool)
+        at_pos: list = []
+        for pi, pos in enumerate(spec.positions):
+            at = occ0 == pi + 1
+            if pos.within_ms is not None:
+                exp = at & timey[None, :] & (age > jnp.int32(pos.within_ms))
+                expired = expired | exp
+                at = at & ~exp
+            at_pos.append(at)
+
+        def advance(pi_from: int, mask):
+            nonlocal occ, complete
+            if pi_from == S - 1:
+                complete = complete | mask
+            else:
+                occ = jnp.where(mask, pi_from + 2, occ)
+                enters.append((pi_from + 1, mask))
+
+        # --- count collection (station-independent: a partial match keeps
+        #     absorbing occurrences while waiting further down the chain,
+        #     reference CountPreStateProcessor pending lists) -------------
+        for pi, pos in enumerate(spec.positions):
+            if not pos.is_count:
+                continue
+            c = pos.cnt_row
+            collect = cnt_on[c] & nm[(pi, 0)]
+            newc = cnt[c] + collect.astype(_I32)
+            vals = self._count_capture_values(x, pos.nodes[0], newc, caps)
+            if pi == S - 1:
+                vals["__comp_ts__"] = ts
+                vals["__comp_seq__"] = seq
+            cap_writes.append((collect, vals))
+            cnt = cnt.at[c].set(newc)
+            cnt_on = cnt_on.at[c].set(
+                cnt_on[c] & (newc < jnp.int32(pos.max_count)))
+            if pi < S - 1:
+                narm = narm.at[c].set(
+                    narm[c] | (collect & (newc == jnp.int32(pos.min_count))))
+            transitioned = transitioned | collect
+            if pi == S - 1:
+                # count in the final position: every collection at or past
+                # min emits (reference _emit_or_stage for count-final)
+                complete = complete | (collect
+                                       & (newc >= jnp.int32(pos.min_count)))
+
+        # --- per-position station logic -----------------------------------
+        for pi, pos in enumerate(spec.positions):
+            at = at_pos[pi]
+            if pos.is_count:
+                continue              # handled above
+            if pi == 0 and pos.op is None:
+                continue              # plain head: alloc below
+
+            if pos.op is not None:
+                fl, dl, k2, t2 = self._logical_step(
+                    pi, pos, at, nm, x, ts, seq, dl, fl, caps,
+                    cap_writes, advance, dl_fire)
+                kill = kill | k2
+                transitioned = transitioned | t2
+                continue
+
+            n0 = pos.nodes[0]
+            if n0.kind == "absent":
+                # forbidden arrival kills (deadline passage is handled by
+                # the pre-pass above, reference
+                # AbsentStreamPreStateProcessor.java:60-115)
+                arr = at & nm[(pi, 0)]
+                kill = kill | arr
+                continue
+
+            # (1,1) stream position: eligible when stationed here, or via
+            # the previous count position's armed successor (set at the
+            # exact min crossing, consumed here)
+            elig = at
+            prev = spec.positions[pi - 1]
+            if prev.is_count:
+                elig = elig | (at_pos[pi - 1] & narm[prev.cnt_row])
+            m = elig & nm[(pi, 0)]
+            if prev.is_count:
+                narm = narm.at[prev.cnt_row].set(narm[prev.cnt_row] & ~m)
+            transitioned = transitioned | m
+            vals = self._capture_values(x, n0)
+            vals["__comp_ts__"] = ts
+            vals["__comp_seq__"] = seq
+            cap_writes.append((m, vals))
+            advance(pi, m)
+
+        dead = expired | kill
+        occ = jnp.where(dead, 0, occ)
+        if self.Kc:
+            cnt_on = cnt_on & ~dead[None]
+            narm = narm & ~dead[None]
+        if self.Ka:
+            dl = jnp.where(dead[None], NO_DEADLINE, dl)
+        complete = complete & ~dead
+
+        # --- apply capture writes (post-match) ----------------------------
+        for mask, vals in cap_writes:
+            caps = self._write_caps(caps, mask & ~dead, vals)
+
+        # --- completion: park (slot freed at drain) or, for completions
+        #     whose count is still collecting, direct-emit keeping the slot
+        survivor = jnp.zeros((A, P), dtype=bool)
+        if self.Kc and spec.positions[S - 1].is_count:
+            survivor = cnt_on[spec.positions[S - 1].cnt_row]
+        park = complete & ~survivor
+        emit_now = complete & survivor
+        occ = jnp.where(park, PARK, occ)
+        if self.Kc:
+            # a parked snapshot must freeze: station-independent collection
+            # would otherwise overwrite captures before the drain lane emits
+            # (the host's surviving count-pm keeps collecting, but it can
+            # never re-emit, so freezing is unobservable)
+            cnt_on = cnt_on & ~park[None]
+            narm = narm & ~park[None]
+
+        # --- entry writes on advance --------------------------------------
+        for tpi, mask in enters:
+            mask = mask & ~dead
+            tpos = spec.positions[tpi]
+            cnt, cnt_on, narm, fl, dl = self._enter_position(
+                tpi, mask, cnt, cnt_on, narm, fl, dl, ts)
+            # clear stale capture/present rows of the entered position's
+            # refs (slots are reused; a previous life's captures must not
+            # leak into this match's emission)
+            zero = {}
+            for n in tpos.nodes:
+                zero[f"__present__.{n.ref}"] = jnp.zeros((P,), _I32)
+            caps = self._write_caps(caps, mask, zero)
+
+        # --- sequence strictness ------------------------------------------
+        if spec.is_sequence:
+            started = (occ > 0) & (occ < PARK)
+            kills = started & ~transitioned & valid[None, :]
+            occ = jnp.where(kills, 0, occ)
+            if self.Kc:
+                cnt_on = cnt_on & ~kills[None]
+                narm = narm & ~kills[None]
+
+        # --- emission lanes ------------------------------------------------
+        if self._parked_emission:
+            occ, y, lost = self._drain_done(occ, head_seq, caps, emit_now)
+            of_lanes = of_lanes + lost.sum(axis=0, dtype=_I32)
+
+        # --- head: slot alloc (or direct single-position emission) --------
+        head = spec.positions[0]
+        ok0 = armed0 & self._head_match(x, head, valid)
         if not spec.every_head:
             armed0 = armed0 & ~ok0
-
-        # 4. apply advances + captures
-        sidx = jnp.where(total_match, sidx + 1, sidx)
-        for ok, st in cap_writes:
-            caps = self._write_caps(caps, ok, st, x)
-
-        # 5. emission.  Completing slots advance to the sentinel state
-        #    sidx == S ("done": step 4 already moved them there) and park
-        #    their completion snapshot in slot storage; each step drains up
-        #    to E done slots through dense lanes (masked one-hot reductions,
-        #    scatter-free — TPU scatters serialize).  Bursts larger than E
-        #    stay parked and drain on later steps / the post-scan drain, so
-        #    no match is ever lost and lanes stay narrow.  The host
-        #    re-orders same-event ties by the emitted __head_seq__.
-        if S > 1:
-            caps = self._write_caps(
-                caps, complete, spec.states[-1], x,
-                extra={"__comp_ts__": ts, "__comp_seq__": seq})
-            sidx, y = self._drain_done(sidx, head_seq, caps)
+        if not self._parked_emission:
+            y = self._emit_single(x, head.nodes[0], ts, seq, ok0)
         else:
-            # single-state chain: head match emits directly (one lane)
-            ev_env = self._event_env(x, h, base_ts)
-            irows = [ok0.astype(_I32)[None, :]]
-            frows = []
-            for k in self.rows_f:
-                frows.append(jnp.broadcast_to(ev_env[k], (P,)).astype(self.fdt)[None, :])
-            for k in self.rows_i:
-                v = ev_env.get(k, jnp.zeros((P,), _I32))
-                irows.append(jnp.broadcast_to(v, (P,)).astype(_I32)[None, :])
-            irows.append(seq[None, :])      # __head_seq__
-            for k in self.rows_l:
-                v = jnp.broadcast_to(ev_env[k], (P,)).astype(jnp.int64)
-                irows.append(_hi32(v)[None, :])
-                irows.append(_lo32(v)[None, :])
-            irows.append(ts[None, :])       # __comp_ts__ (S==1 tail rows)
-            irows.append(seq[None, :])      # __comp_seq__
-            y = {"i": jnp.stack(irows, axis=0)}           # (Ci, 1=E, P)
-            if frows:
-                y["f"] = jnp.stack(frows, axis=0)
-
-        # 6. sequence strictness: any valid event kills non-transitioned
-        #    started slots (reference StreamPreStateProcessor.java:317-330);
-        #    parked completions (sidx == S) already matched — exempt
-        if spec.is_sequence:
-            started = (sidx > 0) & (sidx < S)
-            kill = started & ~total_match & valid[None, :]
-            sidx = jnp.where(kill, 0, sidx)
-
-        # 7. allocate a slot for the head match (at most one per step).
-        #    One-hot where-writes, not scatters: scatters each compile to
-        #    their own kernel and serialize the step; wheres fuse.
-        if S > 1:
-            free = sidx == 0
+            free = occ == 0
             has_free = free.any(axis=0)
             do = ok0 & has_free
             of_slots = of_slots + (ok0 & ~has_free).astype(_I32)
-            hot = free & (jnp.cumsum(free.astype(_I32), axis=0, dtype=_I32) == 1) \
-                & do[None, :]                                    # (A,P)
-            sidx = jnp.where(hot, 1, sidx)
+            hot = free & (jnp.cumsum(free.astype(_I32), axis=0,
+                                     dtype=_I32) == 1) & do[None, :]
             first_ts = jnp.where(hot, ts[None, :], first_ts)
             head_seq = jnp.where(hot, seq[None, :], head_seq)
-            caps = self._write_caps(caps, hot, h, x)
+            occ, cnt, cnt_on, narm, fl, dl, caps = self._alloc_head(
+                x, head, hot, occ, cnt, cnt_on, narm, fl, dl, caps, ts, seq,
+                PARK)
 
-        carry = {"sidx": sidx, "first_ts": first_ts, "head_seq": head_seq,
+        carry = {"occ": occ, "first_ts": first_ts, "head_seq": head_seq,
+                 "cnt": cnt, "cnt_on": cnt_on, "narm": narm, "fl": fl,
+                 "dl": dl,
                  "caps_f": caps["caps_f"], "caps_i": caps["caps_i"],
                  "caps_l": caps["caps_l"], "armed0": armed0,
-                 "of_slots": of_slots}
+                 "of_slots": of_slots, "of_lanes": of_lanes}
         return carry, y
 
-    def _drain_done(self, sidx, head_seq, caps):
-        """Emit up to E parked completions per partition from slot storage;
-        returns (sidx', y) with y the packed (C, E, P) lane grids."""
+    # -- helpers for pieces of the step ----------------------------------
+
+    def _enter_position(self, tpi, mask, cnt, cnt_on, narm, fl, dl, ts):
+        """State-row resets/arms when slots advance into position tpi."""
+        tpos = self.spec.positions[tpi]
+        if tpos.is_count:
+            cnt = cnt.at[tpos.cnt_row].set(jnp.where(mask, 0, cnt[tpos.cnt_row]))
+            cnt_on = cnt_on.at[tpos.cnt_row].set(
+                jnp.where(mask, True, cnt_on[tpos.cnt_row]))
+            narm = narm.at[tpos.cnt_row].set(
+                jnp.where(mask, False, narm[tpos.cnt_row]))
+        if tpos.log_row is not None:
+            fl = fl.at[tpos.log_row].set(jnp.where(mask, 0, fl[tpos.log_row]))
+        for ni, r in (tpos.dl_rows or {}).items():
+            w = tpos.nodes[ni].waiting_ms
+            base = ts[None, :] if getattr(ts, "ndim", 1) == 1 else ts
+            dl = dl.at[r].set(jnp.where(mask, base + jnp.int32(w), dl[r]))
+        return cnt, cnt_on, narm, fl, dl
+
+    def _capture_values(self, x, n: PNode) -> dict:
+        """Values written when node n's event is captured into a slot."""
+        vals: dict = {}
+        for a in self.spec.schemas[n.ref].attributes:
+            key = f"{n.scode}.{a.name}"
+            if key not in x:
+                continue
+            vals[f"{n.ref}.{a.name}"] = x[key]
+            vals[f"{n.ref}[last].{a.name}"] = x[key]
+        vals[f"__present__.{n.ref}"] = jnp.ones((self.P,), _I32)
+        return vals
+
+    def _count_capture_values(self, x, n, newc, caps) -> dict:
+        """Capture writes for a count collection: plain/[last]/[last-1]/[i]."""
+        vals: dict = {}
+        for a in self.spec.schemas[n.ref].attributes:
+            key = f"{n.scode}.{a.name}"
+            if key not in x:
+                continue
+            v = x[key]
+            lk = f"{n.ref}[last].{a.name}"
+            pk = f"{n.ref}[last-1].{a.name}"
+            if pk in self._row_of and lk in self._row_of:
+                g, i = self._row_of[lk]
+                vals[pk] = caps[f"caps_{g}"][i]
+            vals[f"{n.ref}.{a.name}"] = v
+            vals[lk] = v
+        vals[f"__present__.{n.ref}"] = jnp.ones((self.P,), _I32)
+        # indexed rows e1[i].attr: written when this collection is the i-th
+        for k in self._row_of:
+            if k.startswith("__"):
+                continue
+            refpart, attr = k.split(".", 1)
+            base, cidx = _base_ref(refpart)
+            if base != n.ref or cidx is None or not cidx.isdigit():
+                continue
+            want = int(cidx) + 1
+            keyx = f"{n.scode}.{attr}"
+            if keyx in x:
+                g, i = self._row_of[k]
+                cur = caps[f"caps_{g}"][i]
+                vals[k] = jnp.where(newc == jnp.int32(want),
+                                    jnp.broadcast_to(x[keyx], cur.shape
+                                                     ).astype(cur.dtype), cur)
+        return vals
+
+    def _logical_step(self, pi, pos, at, nm, x, ts, seq, dl, fl, caps,
+                      cap_writes, advance, dl_fire):
+        """and/or partner pair at position pi (station mask `at`).
+        Returns (fl', dl', kill, transitioned)."""
+        A, P = self.A, self.P
+        r = pos.log_row
+        kill = jnp.zeros((A, P), dtype=bool)
+        trans = jnp.zeros((A, P), dtype=bool)
+        newbits = fl[r]
+        side_due = jnp.zeros((A, P), dtype=bool)
+        for ni, n in enumerate(pos.nodes):
+            m = at & nm[(pi, ni)]
+            if n.kind == "absent":
+                dr = pos.dl_rows.get(ni)
+                if pos.op == "or":
+                    # arrival disarms this side (can no longer complete it)
+                    if dr is not None:
+                        dl = dl.at[dr].set(jnp.where(m, NO_DEADLINE, dl[dr]))
+                else:
+                    kill = kill | m
+                if dr is not None:
+                    due = at & (dl[dr] <= ts[None, :]) & dl_fire[None, :]
+                    side_due = side_due | due
+                    dl = dl.at[dr].set(jnp.where(due, NO_DEADLINE, dl[dr]))
+                continue
+            newbits = jnp.where(m, newbits | (1 << ni), newbits)
+            trans = trans | m
+            vals = self._capture_values(x, n)
+            vals["__comp_ts__"] = ts
+            vals["__comp_seq__"] = seq
+            cap_writes.append((m & ~kill, vals))
+        if pos.op == "or":
+            done = at & ((newbits != 0) | side_due) & ~kill
+        else:
+            need = 0
+            for ni, n in enumerate(pos.nodes):
+                if n.kind != "absent":
+                    need |= (1 << ni)
+            # an absent partner is satisfied by not-having-arrived; a
+            # deadline passage also advances the pair (host semantics)
+            done = at & (((newbits & need) == need) | side_due) & ~kill
+        advance(pi, done)
+        trans = trans | done
+        for dr in (pos.dl_rows or {}).values():
+            dl = dl.at[dr].set(jnp.where(done | kill, NO_DEADLINE, dl[dr]))
+        fl = fl.at[r].set(jnp.where(done, 0, newbits))
+        return fl, dl, kill, trans
+
+    def _head_match(self, x, head: Position, valid):
+        """(P,) mask: does this event arm a new partial match?  Head
+        filters are always pre-evaluated (lower_chain enforces it)."""
+        P = self.P
+        ok = jnp.zeros((P,), dtype=bool)
+        for n in head.nodes:
+            if n.kind == "absent":
+                continue
+            m = valid
+            if len(self.spec.stream_ids) > 1:
+                m = m & (x["__scode__"] == n.scode)
+            if n.pre_key is not None:
+                m = m & x[n.pre_key]
+            ok = ok | m
+        return ok
+
+    def _alloc_head(self, x, head: Position, hot, occ, cnt, cnt_on, narm,
+                    fl, dl, caps, ts, seq, PARK):
+        """Entry writes for a freshly allocated slot (mask `hot`)."""
+        # clear stale capture/present/deadline rows from the slot's
+        # previous life (a stale armed deadline on a live slot would wedge
+        # the timer scheduler in a fire-nothing loop)
+        zero = {}
+        for pos in self.spec.positions:
+            for n in pos.nodes:
+                zero[f"__present__.{n.ref}"] = jnp.zeros((self.P,), _I32)
+        caps = self._write_caps(caps, hot, zero)
+        if self.Ka:
+            dl = jnp.where(hot[None], NO_DEADLINE, dl)
+
+        if head.op is not None:
+            r = head.log_row
+            bits = jnp.zeros((self.A, self.P), dtype=_I32)
+            for ni, n in enumerate(head.nodes):
+                if n.kind == "absent":
+                    continue
+                m0 = x["__valid__"]
+                if len(self.spec.stream_ids) > 1:
+                    m0 = m0 & (x["__scode__"] == n.scode)
+                if n.pre_key is not None:
+                    m0 = m0 & x[n.pre_key]
+                mm = hot & m0[None, :]
+                bits = jnp.where(mm, bits | (1 << ni), bits)
+                vals = self._capture_values(x, n)
+                vals["__comp_ts__"] = ts
+                vals["__comp_seq__"] = seq
+                caps = self._write_caps(caps, mm, vals)
+            occ = jnp.where(hot, 1, occ)
+            fl = fl.at[r].set(jnp.where(hot, bits, fl[r]))
+            if head.op == "or":
+                # one side suffices: complete (S==1) or advance immediately
+                done = hot & (bits != 0)
+                occ = jnp.where(done, PARK if self.spec.S == 1 else 2, occ)
+                if self.spec.S > 1:
+                    cnt, cnt_on, narm, fl, dl = self._enter_position(
+                        1, done, cnt, cnt_on, narm, fl, dl, ts)
+        elif head.is_count:
+            c = head.cnt_row
+            occ = jnp.where(hot, 1, occ)
+            one = jnp.where(hot, 1, cnt[c])
+            cnt = cnt.at[c].set(one)
+            cnt_on = cnt_on.at[c].set(
+                jnp.where(hot, head.max_count > 1, cnt_on[c]))
+            if self.spec.S > 1:
+                narm = narm.at[c].set(
+                    jnp.where(hot, head.min_count <= 1, narm[c]))
+            vals = self._count_capture_values(x, head.nodes[0], one, caps)
+            if self.spec.S == 1:
+                vals["__comp_ts__"] = ts
+                vals["__comp_seq__"] = seq
+            caps = self._write_caps(caps, hot, vals)
+            if self.spec.S == 1 and head.min_count <= 1:
+                occ = jnp.where(hot, PARK, occ)   # immediate first emission
+        else:
+            occ = jnp.where(hot, 2, occ)
+            vals = self._capture_values(x, head.nodes[0])
+            caps = self._write_caps(caps, hot, vals)
+            if self.spec.S > 1:
+                cnt, cnt_on, narm, fl, dl = self._enter_position(
+                    1, hot, cnt, cnt_on, narm, fl, dl, ts)
+        return occ, cnt, cnt_on, narm, fl, dl, caps
+
+    def _emit_single(self, x, n: PNode, ts, seq, ok0):
+        """Single-(1,1)-stream-position chain: direct lane emission."""
+        P = self.P
+        ev_env = {}
+        for a in self.spec.schemas[n.ref].attributes:
+            key = f"{n.scode}.{a.name}"
+            if key in x:
+                ev_env[f"{n.ref}.{a.name}"] = x[key]
+                ev_env[f"{n.ref}[last].{a.name}"] = x[key]
+        ev_env[f"__present__.{n.ref}"] = jnp.ones((P,), _I32)
+        irows = [ok0.astype(_I32)[None, :]]
+        frows = []
+        for k in self.rows_f:
+            v = ev_env.get(k, jnp.zeros((P,), self.fdt))
+            frows.append(jnp.broadcast_to(v, (P,)).astype(self.fdt)[None, :])
+        for k in self.rows_i:
+            v = ev_env.get(k, jnp.zeros((P,), _I32))
+            irows.append(jnp.broadcast_to(v, (P,)).astype(_I32)[None, :])
+        irows.append(seq[None, :])      # __head_seq__
+        for k in self.rows_l:
+            v = jnp.broadcast_to(ev_env.get(k, jnp.zeros((P,), jnp.int64)),
+                                 (P,)).astype(jnp.int64)
+            irows.append(_hi32(v)[None, :])
+            irows.append(_lo32(v)[None, :])
+        irows.append(ts[None, :])       # __comp_ts__ (tail rows)
+        irows.append(seq[None, :])      # __comp_seq__
+        y = {"i": jnp.stack(irows, axis=0)}           # (Ci, 1=E, P)
+        if frows:
+            y["f"] = jnp.stack(frows, axis=0)
+        return y
+
+    def _drain_done(self, occ, head_seq, caps, emit_now=None):
+        """Emit up to E parked completions (freed) + direct emissions
+        (count survivors, not freed) per partition from slot storage.
+        Returns (occ', y, lost): lost marks direct emissions that found
+        no lane (host doubles E and retries the block)."""
         spec, P, A, E = self.spec, self.P, self.A, self.E
-        done = sidx == spec.S
+        PARK = spec.S + 1
+        parked = occ == PARK
+        done = parked if emit_now is None else (parked | emit_now)
         rank = jnp.cumsum(done.astype(_I32), axis=0, dtype=_I32) - done
         sels = [done & (rank == e) for e in range(E)]       # one-hot over A
         lv = jnp.stack([s.any(axis=0) for s in sels], axis=0)   # (E, P)
-        # i-grid: i32 cap rows + head_seq + hi/lo pairs of LONG rows
         igrid = [caps["caps_i"], head_seq[None]]
         if self.rows_l:
             cl = caps["caps_l"]
@@ -504,23 +1057,26 @@ class NFAKernel:
             igrid.append(_lo32(cl))
         igrid = jnp.concatenate(igrid, axis=0)              # (Ki', A, P)
         ilanes = jnp.stack(
-            [jnp.where(s[None], igrid, 0).sum(axis=1, dtype=_I32) for s in sels],
-            axis=1)                                         # (Ki', E, P)
+            [jnp.where(s[None], igrid, 0).sum(axis=1, dtype=_I32)
+             for s in sels], axis=1)                        # (Ki', E, P)
         y = {"i": jnp.concatenate([lv.astype(_I32)[None], ilanes], axis=0)}
         if self.rows_f:
             fgrid = caps["caps_f"]
             y["f"] = jnp.stack(
-                [jnp.where(s[None], fgrid, 0).sum(axis=1, dtype=fgrid.dtype) for s in sels],
-                axis=1)                                     # (Kf, E, P)
+                [jnp.where(s[None], fgrid, 0).sum(axis=1, dtype=fgrid.dtype)
+                 for s in sels], axis=1)                    # (Kf, E, P)
         emitted = done & (rank < E)
-        return jnp.where(emitted, 0, sidx), y
+        freed = parked & emitted
+        lost = (jnp.zeros((A, P), bool) if emit_now is None
+                else (emit_now & ~parked & ~emitted))
+        return jnp.where(freed, 0, occ), y, lost
 
     # lane-grid row order for y["i"] (after the lv row)
     def _ilane_names(self) -> list:
         names = list(self.rows_i) + ["__head_seq__"]
         for k in self.rows_l:
             names += [f"{k}.hi", f"{k}.lo"]
-        if self.spec.S == 1:
+        if not self._parked_emission:
             names += ["__comp_ts__", "__comp_seq__"]
         return names
 
@@ -542,27 +1098,26 @@ class NFAKernel:
         """Evaluate event-only filter conjuncts over the whole (T, P) block
         in one fused pass (outside the scan)."""
         out = {}
-        for si, st in enumerate(self.spec.states):
-            if not st.pre_conjs:
+        for gi, n in enumerate(self.spec.all_nodes):
+            if not n.pre_conjs:
+                n.pre_key = None
                 continue
             env = {}
-            for a in self.spec.schemas[st.ref].attributes:
-                key = f"{st.scode}.{a.name}"
+            for a in self.spec.schemas[n.ref].attributes:
+                key = f"{n.scode}.{a.name}"
                 if key in ev:
-                    env[f"{st.ref}.{a.name}"] = ev[key]
+                    env[f"{n.ref}.{a.name}"] = ev[key]
             env["__timestamp__"] = ev["__base_ts__"] \
                 + ev["__ts__"].astype(jnp.int64)
             m = None
-            for ce in st.pre_conjs:
+            for ce in n.pre_conjs:
                 p = ce.fn(env)
                 m = p if m is None else (m & p)
-            out[f"__pre{si}__"] = jnp.broadcast_to(m, ev["__ts__"].shape)
+            n.pre_key = f"__pre{gi}__"
+            out[n.pre_key] = jnp.broadcast_to(m, ev["__ts__"].shape)
         return out
 
     def _make_block(self, M: int) -> Callable:
-        """M = flat match-buffer capacity for the whole block (host retries
-        with 2M on overflow; state is functional so a retry is exact)."""
-
         def block(state, ev):
             with compute_dtypes(self._mode):
                 return self._block_impl(state, ev, M)
@@ -573,7 +1128,6 @@ class NFAKernel:
         ev = dict(ev)
         ev.update(self._pre_masks(ev))
         base_ts = ev["__base_ts__"]
-        base_seq = ev["__base_seq__"]
         xs = {k: v for k, v in ev.items()
               if k not in ("__base_ts__", "__base_seq__")}
         T = xs["__ts__"].shape[0]
@@ -584,16 +1138,13 @@ class NFAKernel:
             return self._step(carry, x)
 
         carry, ys = lax.scan(step, dict(state), xs)
-        if spec.S > 1:
-            # drain parked completions so a flush returns every match
-            # produced by its events: ceil(A/E) lane rounds empty any
-            # backlog (each round frees E slots per partition)
+        if self._parked_emission:
             def drain_step(c, _):
-                sidx2, y2 = self._drain_done(c["sidx"], c["head_seq"],
-                                             {k: c[k] for k in
-                                              ("caps_f", "caps_i", "caps_l")})
+                occ2, y2, _lost = self._drain_done(
+                    c["occ"], c["head_seq"],
+                    {k: c[k] for k in ("caps_f", "caps_i", "caps_l")})
                 c2 = dict(c)
-                c2["sidx"] = sidx2
+                c2["occ"] = occ2
                 return c2, y2
             rounds = -(-self.A // self.E)
             carry, ys2 = lax.scan(drain_step, carry, None, length=rounds)
@@ -601,11 +1152,9 @@ class NFAKernel:
                 lambda a, b: jnp.concatenate([a, b], axis=0), ys, ys2)
 
         # compact the (T', C, E, P) lane grids into flat (M,) buffers: one
-        # i32 cumsum for positions + ONE scatter per row.  (searchsorted+
-        # gather lowers to an O(M)-serialized loop on TPU — measured 460 ms
-        # at M=131k vs 0.1 ms for the scatter form; i32 everywhere keeps
-        # XLA from the x64 pair-splitting that made round-2's scatters
-        # trigger whole-buffer layout copies.)
+        # i32 cumsum for positions + ONE scatter per row (searchsorted+
+        # gather lowers to an O(M)-serialized loop on TPU: 460 ms at M=131k
+        # vs 0.1 ms for the scatter form)
         ys_i = ys["i"]                        # (T', Ci, E, P) i32
         ys_f = ys.get("f")                    # (T', Cf, E, P) f32
         lv = ys_i[:, 0].reshape(-1) != 0      # (T'*E*P,)
@@ -642,6 +1191,17 @@ class NFAKernel:
         sel["__timestamp__"] = cols["__comp_ts__"]
         sel["__seq__"] = cols["__comp_seq__"]
         sel["__head_seq__"] = cols["__head_seq__"]
+        for name in self.out_names:
+            if name.startswith("__present__."):
+                sel[name] = cols.get(name, jnp.ones((M,), _I32))
+
+        # earliest pending deadline (for the host scheduler's next_wakeup)
+        if self.Ka:
+            live = (carry["occ"] > 0) & (carry["occ"] <= spec.S)
+            min_dl = jnp.where(live[None], carry["dl"],
+                               NO_DEADLINE).min().astype(_I32)
+        else:
+            min_dl = jnp.int32(NO_DEADLINE)
 
         # pack ALL outputs into ONE i32 matrix: the device->host pull through
         # a tunneled TPU costs ~100 ms of fixed latency per transfer, so one
@@ -650,7 +1210,9 @@ class NFAKernel:
         # correct but slower, documented.)
         meta = (jnp.zeros((M,), _I32)
                 .at[0].set(n)
-                .at[1].set(carry["of_slots"].sum(dtype=_I32)))
+                .at[1].set(carry["of_slots"].sum(dtype=_I32))
+                .at[2].set(carry["of_lanes"].sum(dtype=_I32))
+                .at[3].set(min_dl))
         irows = [meta]
         if self.having is not None:     # else the host derives valid from n
             irows.append(valid.astype(_I32))
@@ -670,7 +1232,6 @@ class NFAKernel:
         if frows:
             out["f"] = jnp.stack(frows, axis=0)
         return carry, out
-
 
 def pow2_at_least(n: int, lo: int = 8) -> int:
     return max(lo, 1 << max(0, math.ceil(math.log2(max(1, n)))))

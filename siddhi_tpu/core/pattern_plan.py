@@ -86,12 +86,12 @@ class DevicePatternPlan(QueryPlan):
         names, types, fns = [], [], []
         if sel.select_all:
             seen = set()
-            for s in self.spec.states:
-                for a in self.spec.schemas[s.ref].attributes:
-                    nm = a.name if a.name not in seen else f"{s.ref}_{a.name}"
+            for nd in self.spec.all_nodes:
+                for a in self.spec.schemas[nd.ref].attributes:
+                    nm = a.name if a.name not in seen else f"{nd.ref}_{a.name}"
                     seen.add(nm)
                     ce = compile_expression(
-                        ast.Variable(a.name, stream_ref=s.ref), sctx)
+                        ast.Variable(a.name, stream_ref=nd.ref), sctx)
                     names.append(nm)
                     types.append(ce.type)
                     fns.append(ce)
@@ -118,12 +118,15 @@ class DevicePatternPlan(QueryPlan):
             ast.Attribute(n, t) for n, t in zip(names, types)))
 
         self.kernel = NFAKernel(self.spec, dict(zip(names, fns)), having,
-                                self.P, slots, f64=self.f64)
+                                self.P, slots, f64=self.f64,
+                                playback=rt._playback)
         self.state = self.kernel.init_state()
         self._ts_base: Optional[int] = None
         self._seq_base: Optional[int] = None
         self._m_hint = 16           # last match-buffer capacity that sufficed
         self._of_slots_seen = 0     # accepted (at-cap) overflow totals
+        self._next_deadline: Optional[int] = None   # absent-state wakeup
+        self._last_seq = 0
         self._buffered: list = []   # (stream_id, EventBatch)
         self._scode = {sid: i for i, sid in enumerate(self.spec.stream_ids)}
         # device grids shipped per block: only attrs some predicate or
@@ -140,16 +143,18 @@ class DevicePatternPlan(QueryPlan):
     def _needed_grid_attrs(self) -> set:
         """(scode, attr, AttrType) triples whose (T, P) grids the kernel
         reads (predicate inputs + capture writes)."""
+        from .nfa_device import _base_ref
         keys: set = set()
-        for st in self.spec.states:
-            for ce in st.pre_conjs + st.step_conjs:
+        for nd in self.spec.all_nodes:
+            for ce in nd.pre_conjs + nd.step_conjs:
                 keys.update(k for k in ce.reads if "." in k)
         keys.update(k for k in self.kernel._row_of if not k.startswith("__"))
-        ref_scode = {st.ref: st.scode for st in self.spec.states}
+        ref_scode = {nd.ref: nd.scode for nd in self.spec.all_nodes}
         ref_schema = self.spec.schemas
         out = set()
         for k in keys:
-            ref, attr = k.split(".", 1)
+            refpart, attr = k.split(".", 1)
+            ref, _idx = _base_ref(refpart)
             if ref in ref_scode and attr in ref_schema[ref].types:
                 out.add((ref_scode[ref], attr, ref_schema[ref].type_of(attr)))
         return out
@@ -204,7 +209,8 @@ class DevicePatternPlan(QueryPlan):
         import jax.numpy as jnp
         old = jax.tree_util.tree_map(np.asarray, self.state)
         kern = NFAKernel(self.spec, self.kernel.sel_fns, self.kernel.having,
-                         new_p, self.kernel.A, self.kernel.E, f64=self.f64)
+                         new_p, self.kernel.A, self.kernel.E, f64=self.f64,
+                         playback=self.rt._playback)
         fresh = kern.init_state()
         self.state = jax.tree_util.tree_map(
             lambda f, o: jnp.asarray(
@@ -218,7 +224,8 @@ class DevicePatternPlan(QueryPlan):
         import jax.numpy as jnp
         old = jax.tree_util.tree_map(np.asarray, self.state)
         kern = NFAKernel(self.spec, self.kernel.sel_fns, self.kernel.having,
-                         self.P, new_a, self.kernel.E, f64=self.f64)
+                         self.P, new_a, self.kernel.E, f64=self.f64,
+                         playback=self.rt._playback)
         fresh = kern.init_state()
 
         def pad(f, o):
@@ -230,6 +237,12 @@ class DevicePatternPlan(QueryPlan):
         self.state = jax.tree_util.tree_map(pad, fresh, old)
         self.kernel = kern
 
+    def _rebuild_kernel(self, E: int) -> None:
+        import jax.numpy as jnp
+        self.kernel = NFAKernel(self.spec, self.kernel.sel_fns,
+                                self.kernel.having, self.P, self.kernel.A,
+                                E, f64=self.f64, playback=self.rt._playback)
+
     def _rebase(self, min_ts: int, min_seq: int) -> None:
         """Shift the plan's ts/seq bases forward and adjust persistent slot
         offsets so i32 locals never overflow.  Ancient slots clamp to
@@ -240,6 +253,12 @@ class DevicePatternPlan(QueryPlan):
             d = min_ts - self._ts_base
             st["first_ts"] = np.maximum(
                 st["first_ts"].astype(np.int64) - d, -LOCAL_SPAN).astype(_I32)
+            if st["dl"].size:
+                no_dl = st["dl"] == np.int32(2**31 - 1)
+                st["dl"] = np.where(
+                    no_dl, st["dl"],
+                    np.maximum(st["dl"].astype(np.int64) - d,
+                               -LOCAL_SPAN).astype(_I32))
             self._ts_base = min_ts
         if self._seq_base is not None and min_seq > self._seq_base:
             d = min_seq - self._seq_base
@@ -309,6 +328,7 @@ class DevicePatternPlan(QueryPlan):
                          max(int(seq.min()), int(seq.max()) - budget))
         ts32 = np.clip(ts - self._ts_base, -LOCAL_SPAN, LOCAL_SPAN).astype(_I32)
         seq32 = np.clip(seq - self._seq_base, -LOCAL_SPAN, LOCAL_SPAN).astype(_I32)
+        self._last_seq = max(self._last_seq, int(seq.max()))
 
         # 4. run dense (T, P) blocks (chunked if one partition hogs the
         # batch); T_CAP widens for small P so single-partition patterns
@@ -374,18 +394,27 @@ class DevicePatternPlan(QueryPlan):
             for j, pre, ev, T, M, out in dispatched:
                 ipack = np.asarray(out["i"])   # ONE device->host transfer
                 fpack = np.asarray(out["f"]) if "f" in out else None
-                n, ofs = int(ipack[0, 0]), int(ipack[0, 1])
+                n, ofs, ofl = (int(ipack[0, 0]), int(ipack[0, 1]),
+                               int(ipack[0, 2]))
                 while n > M:                   # exact re-run, bigger buffer
                     M = _m_bucket(n)
                     fn = self.kernel.block_fn(T, M)
                     _st2, out = fn(pre, ev)
                     ipack = np.asarray(out["i"])
                     fpack = np.asarray(out["f"]) if "f" in out else None
-                    n, ofs = int(ipack[0, 0]), int(ipack[0, 1])
+                    n, ofs, ofl = (int(ipack[0, 0]), int(ipack[0, 1]),
+                                   int(ipack[0, 2]))
                 self._m_hint = max(self._m_hint, M)
                 if ofs > self._of_slots_seen and self.kernel.A < self.A_CAP:
                     self.state = pre
                     self._grow_slots(min(2 * self.kernel.A, self.A_CAP))
+                    restart = j
+                    break
+                if ofl > 0:
+                    # a count-survivor emission burst outran the E lanes:
+                    # widen E (recompile) and re-run from this block
+                    self.state = pre
+                    self._rebuild_kernel(E=self.kernel.E * 2)
                     restart = j
                     break
                 if ofs > self._of_slots_seen:
@@ -396,6 +425,9 @@ class DevicePatternPlan(QueryPlan):
                         f"matches dropped so far (raise @app:deviceSlotCap)",
                         RuntimeWarning, stacklevel=2)
                     self._of_slots_seen = ofs
+                dlm = int(ipack[0, 3])
+                self._next_deadline = (None if dlm >= 2**31 - 1
+                                       else self._ts_base + dlm)
                 results[j] = self._unpack_block(ipack, fpack, n)
             if restart is None:
                 self.state = st
@@ -437,7 +469,14 @@ class DevicePatternPlan(QueryPlan):
             if t == ast.AttrType.BOOL:
                 col = col != 0
             data[nm] = col.astype(dtype_of(t))
-        return (tss, seqs, hseqs, data)
+        nulls = {}
+        for nm, ref in self.kernel.null_outputs.items():
+            pres = row.get(f"__present__.{ref}")
+            if pres is not None:
+                mask = pres[valid] == 0
+                if mask.any():
+                    nulls[nm] = mask
+        return (tss, seqs, hseqs, data, nulls)
 
     def _rows_to_batches(self, chunks: list) -> list:
         """chunks: list of (tss, seqs, hseqs, data) columnar match tables."""
@@ -449,6 +488,14 @@ class DevicePatternPlan(QueryPlan):
         hseqs = np.concatenate([c[2] for c in chunks])
         data = {nm: np.concatenate([c[3][nm] for c in chunks])
                 for nm in self._names}
+        nulls_all = {}
+        if any(c[4] for c in chunks):
+            for nm in self._names:
+                parts = [c[4].get(nm, np.zeros(len(c[0]), bool))
+                         for c in chunks]
+                m = np.concatenate(parts)
+                if m.any():
+                    nulls_all[nm] = m
         # emit in completion order; same-event ties by head arrival
         # (reference emits pending-list == arrival order)
         o = np.lexsort((hseqs, seqs))
@@ -459,9 +506,39 @@ class DevicePatternPlan(QueryPlan):
         if not len(o):
             return []
         cols = {nm: data[nm][o] for nm in self._names}
+        nulls = {nm: m[o] for nm, m in nulls_all.items()} or None
         batch = EventBatch(self.out_schema, tss[o].astype(TIMESTAMP_DTYPE),
-                           cols, len(o), seqs[o])
+                           cols, len(o), seqs[o], nulls)
         return [OutputBatch(self.output_target, batch)]
+
+    # -- timers (absent-state deadlines) ---------------------------------
+
+    def next_wakeup(self) -> Optional[int]:
+        return self._next_deadline
+
+    def on_timer(self, now_ms: int) -> list:
+        """Fire pending absent-state deadlines <= now via a 1-step tick
+        block (valid=False cells with the timer's timestamp)."""
+        if not self.kernel.has_absent or self._ts_base is None \
+                or self._next_deadline is None or now_ms < self._next_deadline:
+            return []
+        import jax.numpy as jnp
+        T = 1
+        ev = {"__ts__": np.full((T, self.P),
+                                np.clip(now_ms - self._ts_base, -LOCAL_SPAN,
+                                        LOCAL_SPAN), _I32),
+              "__seq__": np.full((T, self.P),
+                                 np.clip(self._last_seq - self._seq_base,
+                                         -LOCAL_SPAN, LOCAL_SPAN), _I32),
+              "__valid__": np.zeros((T, self.P), bool),
+              "__tick__": np.ones((T, self.P), bool)}
+        if len(self.spec.stream_ids) > 1:
+            ev["__scode__"] = np.full((T, self.P), -1, _I32)
+        for si, attr, t in self._grid_attrs:
+            ev[f"{si}.{attr}"] = np.zeros((T, self.P), self._np_dtype(t))
+        ev["__base_ts__"] = np.int64(self._ts_base)
+        ev["__base_seq__"] = np.int64(self._seq_base)
+        return self._rows_to_batches(self._run_chunks([(ev, T)]))
 
     # -- snapshot ------------------------------------------------------------
 
@@ -473,11 +550,11 @@ class DevicePatternPlan(QueryPlan):
     def load_state_dict(self, d: dict) -> None:
         import jax.numpy as jnp
         st = d["state"]
-        a, p = st["sidx"].shape
+        a, p = st["occ"].shape
         if p != self.P or a != self.kernel.A:  # snapshot taken after growth
             self.kernel = NFAKernel(self.spec, self.kernel.sel_fns,
                                     self.kernel.having, p, a, self.kernel.E,
-                                    f64=self.f64)
+                                    f64=self.f64, playback=self.rt._playback)
             self.P = p
         self.state = jax.tree_util.tree_map(jnp.asarray, st)
         self._key_to_part = dict(d["key_to_part"])

@@ -64,9 +64,15 @@ class SiddhiAppRuntime:
         self._playback = qast.find_annotation(app.annotations, "app:playback") is not None
         self._clock_ms: Optional[int] = None   # virtual/playback clock
         # device pattern matching: "auto" (device when partitioned),
-        # "always" (device or error), "never" (sequential host matcher)
+        # "always" (device or error), "prefer" (device when supported, host
+        # fallback), "never" (sequential host matcher).  The
+        # SIDDHI_DEVICE_PATTERNS env var overrides the default for apps
+        # without the annotation (the device test lane runs the whole
+        # pattern suite with SIDDHI_DEVICE_PATTERNS=prefer).
+        import os as _os
         dp = qast.find_annotation(app.annotations, "app:devicePatterns")
-        self.device_patterns = dp.element() if dp is not None else "auto"
+        self.device_patterns = dp.element() if dp is not None else \
+            _os.environ.get("SIDDHI_DEVICE_PATTERNS", "auto")
         # starting partition-axis capacity for device pattern plans (grows
         # by doubling as new keys arrive; each growth recompiles the kernel)
         pc = qast.find_annotation(app.annotations, "app:partitionCapacity")

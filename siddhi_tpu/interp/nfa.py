@@ -350,9 +350,14 @@ class PatternMatcher:
         # standing `every` arms clone; the armed original never leaves
         if node.id in pm.sticky_at:
             work = pm.clone()
-            # a fresh clone is pending at the same node (non-sticky semantics)
+            # a fresh clone is pending at the same node (non-sticky semantics);
+            # logical pairs pend at BOTH partners so the other side can fill
+            # (reference: both Pre processors share the pending StateEvent)
             work.nodes.add(node.id)
             self.pendings[node.id].append(work)
+            if node.partner_id is not None:
+                work.nodes.add(node.partner_id)
+                self.pendings[node.partner_id].append(work)
             work_is_clone = True
         else:
             work = pm

@@ -1,0 +1,218 @@
+"""Device NFA pattern-algebra differential tests: count quantifiers,
+logical and/or, absent (not..for), and their interactions — the batched
+kernel must reproduce the sequential host matcher's match sets exactly
+(the host is pinned against reference semantics in test_patterns.py).
+"""
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+DEV = "@app:devicePatterns('always')\n"
+SEQ = "@app:devicePatterns('never')\n"
+
+
+@pytest.fixture
+def mgr():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def run_app(mgr, app, sends, out_stream="O", set_time=None):
+    rt = mgr.create_app_runtime(app)
+    out = []
+    rt.add_callback(out_stream, lambda evs: out.extend(e.data for e in evs))
+    handlers = {}
+    rt.start()
+    for sid, row, ts in sends:
+        h = handlers.get(sid) or handlers.setdefault(sid, rt.input_handler(sid))
+        h.send(row, timestamp=ts)
+    rt.flush()
+    if set_time is not None:
+        rt.set_time(set_time)
+    return out, rt
+
+
+def both(mgr, body, sends, out_stream="O", set_time=None):
+    dev, drt = run_app(mgr, DEV + body, sends, out_stream, set_time)
+    host, _ = run_app(mgr, SEQ + body, sends, out_stream, set_time)
+    from siddhi_tpu.core.pattern_plan import DevicePatternPlan
+    assert any(isinstance(p, DevicePatternPlan) for p in drt._plans), \
+        "expected the device plan to engage"
+    return dev, host
+
+
+COUNT_BODY = """
+define stream T (temp double);
+@info(name='q') from e1=T[temp > 30]<2:3> -> e2=T[temp < 10]
+select e1[0].temp as t0, e1[1].temp as t1, e2.temp as tl insert into O;
+"""
+
+
+def test_count_basic(mgr):
+    sends = [("T", (31.0,), 1000), ("T", (32.0,), 1001), ("T", (5.0,), 1002)]
+    dev, host = both(mgr, COUNT_BODY, sends)
+    assert dev == host == [(31.0, 32.0, 5.0)]
+
+
+def test_count_max_and_survivor(mgr):
+    # 3 collects (max), then two closing events: the pending count match
+    # keeps emitting (host semantics: count-final pms survive)
+    sends = [("T", (31.0,), 1000), ("T", (32.0,), 1001), ("T", (33.0,), 1002),
+             ("T", (5.0,), 1003), ("T", (4.0,), 1004)]
+    dev, host = both(mgr, COUNT_BODY, sends)
+    assert dev == host
+
+
+def test_count_plus_sequence_every(mgr):
+    body = """
+    define stream S (v int);
+    @info(name='q') from every e1=S[v > 0]+, e2=S[v == 0]
+    select e1[0].v as first, e1[last].v as last_, e2.v as z insert into O;
+    """
+    sends = [("S", (1,), 1000), ("S", (2,), 1001), ("S", (0,), 1002),
+             ("S", (3,), 1003), ("S", (0,), 1004), ("S", (0,), 1005)]
+    dev, host = both(mgr, body, sends)
+    assert sorted(dev) == sorted(host)
+
+
+def test_logical_and(mgr):
+    body = """
+    define stream A (x int);
+    define stream B (y int);
+    define stream C (z int);
+    @info(name='q') from e1=A and e2=B -> e3=C
+    select e1.x as x, e2.y as y, e3.z as z insert into O;
+    """
+    sends = [("B", (2,), 1000), ("C", (9,), 1001), ("A", (1,), 1002),
+             ("C", (3,), 1003)]
+    dev, host = both(mgr, body, sends)
+    assert dev == host == [(1, 2, 3)]
+
+
+def test_logical_or_null_side(mgr):
+    body = """
+    define stream A (x int);
+    define stream B (y int);
+    @info(name='q') from e1=A or e2=B select e1.x as x, e2.y as y insert into O;
+    """
+    sends = [("B", (42,), 1000)]
+    dev, host = both(mgr, body, sends)
+    assert dev == host == [(None, 42)]
+
+
+def test_logical_and_head_every(mgr):
+    body = """
+    define stream A (x int);
+    define stream B (y int);
+    @info(name='q') from every e1=A and e2=B
+    select e1.x as x, e2.y as y insert into O;
+    """
+    sends = [("A", (1,), 1000), ("B", (2,), 1001), ("A", (3,), 1002),
+             ("B", (4,), 1003)]
+    dev, host = both(mgr, body, sends)
+    assert sorted(dev) == sorted(host)
+
+
+ABSENT_BODY = """
+@app:playback
+define stream A (x int);
+define stream B (y int);
+@info(name='q') from e1=A -> not B for 1 sec
+select e1.x as x insert into O;
+"""
+
+
+def test_absent_fires_on_deadline(mgr):
+    sends = [("A", (7,), 1000)]
+    dev, host = both(mgr, ABSENT_BODY, sends, set_time=2100)
+    assert dev == host == [(7,)]
+
+
+def test_absent_suppressed(mgr):
+    sends = [("A", (7,), 1000), ("B", (1,), 1500)]
+    dev, host = both(mgr, ABSENT_BODY, sends, set_time=3000)
+    assert dev == host == []
+
+
+def test_absent_and_present(mgr):
+    body = """
+    define stream R (t double);
+    define stream T (t double);
+    define stream H (h double);
+    @info(name='q') from e1=R -> not T[t > e1.t] and e2=H
+    select e1.t as rt_, e2.h as h insert into O;
+    """
+    sends = [("R", (20.0,), 1000), ("H", (55.0,), 1001),
+             ("R", (30.0,), 1002), ("T", (35.0,), 1003), ("H", (60.0,), 1004)]
+    dev, host = both(mgr, body, sends)
+    assert dev == host == [(20.0, 55.0)]
+
+
+def test_absent_mid_chain(mgr):
+    body = """
+    @app:playback
+    define stream A (x int);
+    define stream B (y int);
+    define stream C (z int);
+    @info(name='q') from e1=A -> not B for 500 milliseconds -> e3=C
+    select e1.x as x, e3.z as z insert into O;
+    """
+    # deadline passes quietly -> C completes
+    sends = [("A", (1,), 1000), ("C", (9,), 1700)]
+    dev, host = both(mgr, body, sends)
+    assert dev == host
+    # B arrives inside the window -> killed
+    sends2 = [("A", (1,), 1000), ("B", (5,), 1200), ("C", (9,), 1700)]
+    dev2, host2 = both(mgr, body, sends2)
+    assert dev2 == host2
+
+
+def test_differential_random_algebra(mgr):
+    """Fuzz the new shapes against the host oracle."""
+    rng = np.random.default_rng(11)
+    count_body = """
+    define stream S (p double);
+    @info(name='q') from every e1=S[p > 100]<2:4> -> e2=S[p < 95]
+    select e1[0].p as p0, e1[last].p as pl, e2.p as px insert into O;
+    """
+    and_body = """
+    define stream A (x double);
+    define stream B (y double);
+    @info(name='q') from every e1=A[x > 50] and e2=B[y > 50] -> e3=A[x > e1.x]
+    select e1.x as x, e2.y as y, e3.x as z insert into O;
+    """
+    for name, body, streams in (("count", count_body, ("S",)),
+                                ("and", and_body, ("A", "B"))):
+        for trial in range(3):
+            n = 40
+            ps = np.round(rng.uniform(40, 110, size=n) * 4) / 4
+            ts = 1000 + np.cumsum(rng.integers(1, 30, size=n))
+            sids = [streams[int(i)] for i in rng.integers(0, len(streams), n)]
+            sends = [(sid, (float(p),), int(t))
+                     for sid, p, t in zip(sids, ps, ts)]
+            dev, host = both(mgr, body, sends)
+            assert dev == host, f"{name} trial {trial}: {dev} != {host}"
+
+
+def test_partitioned_count_device(mgr):
+    body = """
+    @app:partitionCapacity(8)
+    define stream S (sym string, p double);
+    partition with (sym of S)
+    begin
+      @info(name='q') from every e1=S[p > 100]<2:3> -> e2=S[p < 95]
+      select e1[0].p as p0, e1[last].p as pl, e2.p as px insert into O;
+    end;
+    """
+    rng = np.random.default_rng(3)
+    syms = ["K%d" % i for i in range(5)]
+    sends = []
+    for i in range(150):
+        sends.append((
+            "S", (syms[int(rng.integers(5))],
+                  float(np.round(rng.uniform(85, 115) * 4) / 4)), 1000 + i))
+    dev, drt = run_app(mgr, body, sends)
+    host, _ = run_app(mgr, "@app:devicePatterns('never')\n" + body, sends)
+    assert sorted(dev) == sorted(host)
