@@ -188,7 +188,8 @@ class Source:
         with self.rt._lock:
             for ts, row in rows:
                 self.rt._send_locked(self.stream_id, row, ts)
-            self.rt.flush()
+        self.rt._drain_async_outbox()
+        self.rt.flush()      # async: barrier outside the lock
 
     def connect_with_retry(self, max_tries: int = 5,
                            base_delay_s: float = 0.05) -> None:

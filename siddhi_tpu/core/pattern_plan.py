@@ -80,6 +80,19 @@ class DevicePatternPlan(QueryPlan):
         self.part_key_fns = part_key_fns        # stream_id -> fn(batch)->codes
         self._key_to_part: dict = {}            # key value -> partition index
 
+        # multi-chip mesh: shard the partition axis (last axis of every
+        # state leaf / event grid) over jax.devices() — the production
+        # analog of the reference's per-key clone fan-out scaled across
+        # chips (SURVEY §2.3 item 2: our DP ≅ their partitions)
+        self.mesh = None
+        mode = getattr(rt, "device_mesh", "auto")
+        ndev = len(jax.devices())
+        if mode == "always" or (mode == "auto" and ndev > 1
+                                and partitions >= ndev):
+            from jax.sharding import Mesh
+            self.mesh = Mesh(np.array(jax.devices()), ("part",))
+            self.P = -(-self.P // ndev) * ndev     # even shards
+
         # selector over capture refs
         sel = q.selector
         sctx = MultiStreamContext(self.spec.schemas, rt.strings)
@@ -120,7 +133,7 @@ class DevicePatternPlan(QueryPlan):
         self.kernel = NFAKernel(self.spec, dict(zip(names, fns)), having,
                                 self.P, slots, f64=self.f64,
                                 playback=rt._playback)
-        self.state = self.kernel.init_state()
+        self.state = self._shard(self.kernel.init_state())
         self._ts_base: Optional[int] = None
         self._seq_base: Optional[int] = None
         self._m_hint = 16           # last match-buffer capacity that sufficed
@@ -158,6 +171,21 @@ class DevicePatternPlan(QueryPlan):
             if ref in ref_scode and attr in ref_schema[ref].types:
                 out.add((ref_scode[ref], attr, ref_schema[ref].type_of(attr)))
         return out
+
+    def _part_sharding(self, ndim: int):
+        from jax.sharding import NamedSharding, PartitionSpec
+        if ndim == 0:
+            return NamedSharding(self.mesh, PartitionSpec())
+        return NamedSharding(self.mesh,
+                             PartitionSpec(*((None,) * (ndim - 1) + ("part",))))
+
+    def _shard(self, tree):
+        """Place every leaf with its partition-axis sharding (no-op when
+        no mesh is configured)."""
+        if self.mesh is None:
+            return tree
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, self._part_sharding(np.ndim(a))), tree)
 
     def _np_dtype(self, t: ast.AttrType):
         if not self.f64 and t == ast.AttrType.DOUBLE:
@@ -207,15 +235,18 @@ class DevicePatternPlan(QueryPlan):
         """Double the partition axis (last axis of every state leaf): pad,
         rebuild the kernel (the next block jit-compiles at the new P)."""
         import jax.numpy as jnp
+        if self.mesh is not None:
+            nd = len(self.mesh.devices)
+            new_p = -(-new_p // nd) * nd
         old = jax.tree_util.tree_map(np.asarray, self.state)
         kern = NFAKernel(self.spec, self.kernel.sel_fns, self.kernel.having,
                          new_p, self.kernel.A, self.kernel.E, f64=self.f64,
                          playback=self.rt._playback)
         fresh = kern.init_state()
-        self.state = jax.tree_util.tree_map(
-            lambda f, o: jnp.asarray(
-                np.concatenate([o, np.asarray(f)[..., o.shape[-1]:]], axis=-1)),
-            fresh, old)
+        self.state = self._shard(jax.tree_util.tree_map(
+            lambda f, o: np.concatenate(
+                [o, np.asarray(f)[..., o.shape[-1]:]], axis=-1),
+            fresh, old))
         self.kernel = kern
         self.P = new_p
 
@@ -233,8 +264,8 @@ class DevicePatternPlan(QueryPlan):
             if ax is None or f.shape == o.shape:
                 return jnp.asarray(o)
             filler = np.asarray(f)[(slice(None),) * ax + (slice(o.shape[ax], None),)]
-            return jnp.asarray(np.concatenate([o, filler], axis=ax))
-        self.state = jax.tree_util.tree_map(pad, fresh, old)
+            return np.concatenate([o, filler], axis=ax)
+        self.state = self._shard(jax.tree_util.tree_map(pad, fresh, old))
         self.kernel = kern
 
     def _rebuild_kernel(self, E: int) -> None:
@@ -265,7 +296,7 @@ class DevicePatternPlan(QueryPlan):
             st["head_seq"] = np.maximum(
                 st["head_seq"].astype(np.int64) - d, -LOCAL_SPAN).astype(_I32)
             self._seq_base = min_seq
-        self.state = {k: jnp.asarray(v) for k, v in st.items()}
+        self.state = self._shard(st)
 
     # -- QueryPlan interface -------------------------------------------------
 
@@ -381,6 +412,7 @@ class DevicePatternPlan(QueryPlan):
             st = self.state
             for j in range(i, len(chunk_evs)):
                 ev, T = chunk_evs[j]
+                ev = self._shard(ev)
                 M = max(self._m_hint, _m_bucket(2 * T))
                 fn = self.kernel.block_fn(T, M)
                 pre = st
@@ -551,12 +583,25 @@ class DevicePatternPlan(QueryPlan):
         import jax.numpy as jnp
         st = d["state"]
         a, p = st["occ"].shape
+        if self.mesh is not None:
+            nd = len(self.mesh.devices)
+            p_r = -(-p // nd) * nd
+            if p_r != p:       # snapshot from a differently-sized mesh/host
+                kern = NFAKernel(self.spec, self.kernel.sel_fns,
+                                 self.kernel.having, p_r, a, self.kernel.E,
+                                 f64=self.f64, playback=self.rt._playback)
+                fresh = jax.tree_util.tree_map(np.asarray, kern.init_state())
+                st = jax.tree_util.tree_map(
+                    lambda o, f: np.concatenate(
+                        [o, f[..., o.shape[-1]:]], axis=-1)
+                    if np.ndim(o) else o, dict(st), fresh)
+                p = p_r
         if p != self.P or a != self.kernel.A:  # snapshot taken after growth
             self.kernel = NFAKernel(self.spec, self.kernel.sel_fns,
                                     self.kernel.having, p, a, self.kernel.E,
                                     f64=self.f64, playback=self.rt._playback)
             self.P = p
-        self.state = jax.tree_util.tree_map(jnp.asarray, st)
+        self.state = self._shard(st)
         self._key_to_part = dict(d["key_to_part"])
         self._ts_base = d.get("ts_base")
         self._seq_base = d.get("seq_base")

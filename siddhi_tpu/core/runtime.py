@@ -89,6 +89,15 @@ class SiddhiAppRuntime:
         # "never" (host interpreter — benchmarking / debugging)
         df = qast.find_annotation(app.annotations, "app:deviceFilters")
         self.device_filters = df.element() if df is not None else "auto"
+        # multi-chip mesh for device plans: "auto" (shard the partition
+        # axis over jax.devices() when >1), "always", "never"
+        dm = qast.find_annotation(app.annotations, "app:deviceMesh")
+        self.device_mesh = dm.element() if dm is not None else "auto"
+        # @Async analog (reference StreamJunction Disruptor ring): ingest
+        # worker decouples send() from flush/compute so host batch assembly
+        # overlaps device execution
+        self._async = qast.find_annotation(app.annotations, "app:async") \
+            is not None
 
         # stream schemas: defined + inferred from query outputs
         self.schemas: dict = {}
@@ -135,6 +144,10 @@ class SiddhiAppRuntime:
         self._sink_outbox: list = []
         self._sched_thread = None
         self._sched_stop = None
+        self._ingest_q = None
+        self._ingest_thread = None
+        self._ingest_err = None
+        self._async_outbox: list = []   # full builders staged under the lock
 
         from .stats import StatisticsManager
         self.stats = StatisticsManager(self)
@@ -219,6 +232,8 @@ class SiddhiAppRuntime:
                     for ob in p.fire_start(now):
                         self._emit(p, ob)
             self._drain()
+        if self._async and self._ingest_thread is None:
+            self._start_ingest_worker()
         for s in self.sources:
             if not s.connected:
                 s.connect_with_retry()
@@ -228,6 +243,37 @@ class SiddhiAppRuntime:
                 s.connected = True
         if not self._playback:
             self._start_scheduler()
+
+    def _start_ingest_worker(self) -> None:
+        """@app:async: frozen micro-batches queue to a worker that runs
+        the device/interp plans, so the producer thread keeps assembling
+        the next batch while the previous one computes (the reference's
+        Disruptor + StreamHandler drain, StreamJunction.java:280-316)."""
+        import queue as _queue
+        import threading
+        self._ingest_q = _queue.Queue(maxsize=8)   # bounded: backpressure
+
+        def worker():
+            while True:
+                item = self._ingest_q.get()
+                try:
+                    if item is None:
+                        return
+                    if self._ingest_err is not None:
+                        continue   # latched: drop (but ack) until surfaced
+                    sid, batch = item
+                    with self._lock:
+                        self._pending.append((sid, batch))
+                        self._drain()
+                    self._flush_sink_outbox()
+                except BaseException as e:   # surface at the flush barrier
+                    self._ingest_err = e
+                finally:
+                    self._ingest_q.task_done()
+
+        self._ingest_thread = threading.Thread(
+            target=worker, name="siddhi-ingest", daemon=True)
+        self._ingest_thread.start()
 
     def _start_scheduler(self) -> None:
         """Wall-clock timer pump: fires due timers (time windows, rate
@@ -297,6 +343,14 @@ class SiddhiAppRuntime:
             if s.connected:
                 s.disconnect()
                 s.connected = False
+        if self._ingest_thread is not None:
+            try:
+                self._async_barrier()    # deliver everything still queued
+            finally:
+                self._ingest_q.put(None)
+                self._ingest_thread.join(timeout=5)
+                self._ingest_thread = None
+                self._ingest_q = None    # flush() falls back to sync path
         if self._sched_stop is not None:
             self._sched_stop.set()
             self._sched_thread.join(timeout=2)
@@ -317,6 +371,8 @@ class SiddhiAppRuntime:
         in wakeup order so timer-driven emissions interleave deterministically
         (reference: core:util/Scheduler.java:89 notifyAt semantics)."""
         from .trigger import TriggerRuntime
+        if self._async and self._ingest_q is not None:
+            self._async_barrier()
         with self._lock:
             self.flush()
             # entering virtual time (clock was wall) re-anchors all triggers
@@ -354,7 +410,21 @@ class SiddhiAppRuntime:
     def send(self, stream_id: str, data, timestamp: Optional[int] = None) -> None:
         with self._lock:
             self._send_locked(stream_id, data, timestamp)
+        self._drain_async_outbox()
         self._flush_sink_outbox()
+
+    def _drain_async_outbox(self) -> None:
+        """Enqueue batches staged by _send_locked — outside the lock, so a
+        full (bounded) queue blocks the producer without wedging the
+        worker."""
+        if not self._async_outbox:
+            return
+        while True:
+            try:
+                item = self._async_outbox.pop(0)
+            except IndexError:
+                return
+            self._ingest_q.put(item)
 
     def _send_locked(self, stream_id: str, data, timestamp: Optional[int]) -> None:
         schema = self.schemas[stream_id]
@@ -387,18 +457,43 @@ class SiddhiAppRuntime:
                 advance(ts)
             b.append(ts, tuple(data), nseq())
         if b.full:
-            self.flush()
+            if self._async and self._ingest_q is not None:
+                # stage; the public entry enqueues AFTER releasing the lock
+                # (a blocking put under the lock would deadlock against the
+                # worker, which needs the lock to process)
+                self._async_outbox.append((stream_id, b.freeze_and_clear()))
+            else:
+                self.flush()
 
     # -- dispatch ------------------------------------------------------------
 
     def flush(self) -> None:
-        """Drain all pending builders through the compiled plans."""
+        """Drain all pending builders through the compiled plans.  In
+        @app:async mode this is the barrier: leftovers enqueue to the
+        ingest worker and the call returns once the queue is empty (all
+        callbacks delivered).  Must NOT be called while holding the
+        runtime lock in async mode (the worker needs it) — internal
+        callers use _async_barrier() before locking."""
+        if self._async and self._ingest_q is not None:
+            self._async_barrier()
+            return
         with self._lock:
             for sid, b in self._builders.items():
                 if len(b):
                     self._pending.append((sid, b.freeze_and_clear()))
             self._drain()
         self._flush_sink_outbox()
+
+    def _async_barrier(self) -> None:
+        with self._lock:
+            leftovers = [(sid, b.freeze_and_clear())
+                         for sid, b in self._builders.items() if len(b)]
+        self._async_outbox.extend(leftovers)
+        self._drain_async_outbox()
+        self._ingest_q.join()
+        if self._ingest_err is not None:
+            err, self._ingest_err = self._ingest_err, None
+            raise err
 
     def _flush_sink_outbox(self) -> None:
         """Deliver staged sink payloads outside the runtime lock.  When
@@ -539,6 +634,8 @@ class SiddhiAppRuntime:
     # -- persistence (full snapshot; reference SiddhiAppRuntime.persist:595) --
 
     def snapshot(self) -> dict:
+        if self._async and self._ingest_q is not None:
+            self._async_barrier()
         with self._lock:
             return self._snapshot_locked()
 
