@@ -238,7 +238,7 @@ def main():
 
     configs["2_window_agg"] = bench_config(
         "window", DEV["windows"] + C2, HOST["windows"] + C2,
-        n=1 << 16, batch=1 << 14)
+        n=1 << 17, batch=1 << 16)
 
     configs["3_sequence"] = bench_config(
         "sequence", DEV["patterns"] + C3, HOST["patterns"] + C3,

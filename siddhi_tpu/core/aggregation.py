@@ -194,6 +194,18 @@ class AggregationRuntime(QueryPlan):
         self.n_bases = sum(len(_BASES[s.name]) for s in self.sites)
         self.store: dict = {d: {} for d in self.durations}
 
+        # opt-in device path for the segmented reductions (SURVEY §5: the
+        # incremental tree as segmented scans on TPU).  Default is the host
+        # numpy path: through a tunneled chip every device->host pull pays
+        # ~100 ms latency, which dwarfs the reduction itself at typical
+        # batch sizes — on a locally-attached TPU flip it on.
+        da = ast.find_annotation(rt.app.annotations, "app:deviceAggregations")
+        self.device = (da is not None
+                       and str(da.element()).lower() in ("always", "true")
+                       and Duration.MONTHS not in self.durations
+                       and Duration.YEARS not in self.durations)
+        self._dev_cache: dict = {}      # padded n -> jitted kernel
+
     # -- ingest (vectorized segmented reduction) -----------------------------
 
     def process(self, stream_id: str, batch: EventBatch) -> list:
@@ -238,13 +250,36 @@ class AggregationRuntime(QueryPlan):
 
         # integer views of group columns for exact vectorized unique
         gints = [self._int_view(c) for c in gcols]
+        if self.device:
+            per_dur = self._reduce_device(ts, gints, vals)
+        else:
+            per_dur = self._reduce_host(ts, gints, vals)
+        for dur, (buckets_of, rows_any, reduced) in zip(self.durations,
+                                                        per_dur):
+            st = self.store[dur]
+            for j in range(len(rows_any)):
+                r = int(rows_any[j])
+                gkey = tuple(self._decode_gval(c[r], a)
+                             for c, a in zip(gcols, self.group_attrs))
+                key = (int(buckets_of[j]), gkey)
+                new = [float(red[j]) for red in reduced]
+                old = st.get(key)
+                if old is None:
+                    st[key] = new
+                else:
+                    st[key] = self._merge(old, new)
+        return []
+
+    def _reduce_host(self, ts, gints, vals):
+        """numpy segmented reduction; returns per duration
+        (bucket_start_per_segment, any_row_of_segment, reduced[nb][m])."""
+        out = []
         for dur in self.durations:
             buckets = bucket_starts(ts, dur)
             segs = np.stack([buckets, *gints], axis=1) if gints \
                 else buckets[:, None]
             uniq, inv = np.unique(segs, axis=0, return_inverse=True)
             m = len(uniq)
-            # segmented reduction of every base field
             reduced: list[np.ndarray] = []
             for s, v in zip(self.sites, vals):
                 for base in _BASES[s.name]:
@@ -260,22 +295,114 @@ class AggregationRuntime(QueryPlan):
                         acc = np.full(m, -np.inf)
                         np.maximum.at(acc, inv, v)
                         reduced.append(acc)
-            # merge the (few) unique segments into the bucket store
-            st = self.store[dur]
             first_rows = np.empty(m, dtype=np.int64)
             first_rows[inv[::-1]] = np.arange(len(inv))[::-1]
-            for j in range(m):
-                r = int(first_rows[j])
-                gkey = tuple(self._decode_gval(c[r], a)
-                             for c, a in zip(gcols, self.group_attrs))
-                key = (int(uniq[j, 0]), gkey)
-                new = [red[j] for red in reduced]
-                old = st.get(key)
-                if old is None:
-                    st[key] = new
-                else:
-                    st[key] = self._merge(old, new)
-        return []
+            out.append((uniq[:, 0], first_rows, reduced))
+        return out
+
+    # -- device segmented reduction (sort + segmented scans; no scatters —
+    #    TPU scatters serialize).  One packed i32 pull for ALL durations.
+    def _reduce_device(self, ts, gints, vals):
+        import jax
+        import jax.numpy as jnp
+
+        n = len(ts)
+        npad = 8
+        while npad < n:
+            npad *= 2
+        spans = [d.approx_millis for d in self.durations]
+        nb = self.n_bases
+        base_ops = [b for s in self.sites for b in _BASES[s.name]]
+        val_of_base = []
+        for i, s in enumerate(self.sites):
+            for _b in _BASES[s.name]:
+                val_of_base.append(i)
+
+        fn = self._dev_cache.get(npad)
+        if fn is None:
+            def kernel(ts64, g64, v32):
+                outs_i, outs_f = [], []
+                pos = jnp.arange(npad, dtype=jnp.int64)
+                for w in spans:
+                    bucket = (ts64 // w) * w
+                    keys = [pos] + [g64[gi] for gi in
+                                    range(g64.shape[0])][::-1] + [bucket]
+                    order = jnp.lexsort(keys)
+                    sb = bucket[order]
+                    starts = jnp.concatenate(
+                        [jnp.array([True]), sb[1:] != sb[:-1]])
+                    for gi in range(g64.shape[0]):
+                        sg = g64[gi][order]
+                        starts = starts | jnp.concatenate(
+                            [jnp.array([True]), sg[1:] != sg[:-1]])
+                    start_idx = jax.lax.associative_scan(
+                        jnp.maximum, jnp.where(starts,
+                                               jnp.arange(npad), 0))
+                    rows = []
+                    for bi, b in enumerate(base_ops):
+                        if b == "count":
+                            v = jnp.ones(npad, jnp.float32)
+                        else:
+                            v = v32[val_of_base[bi]][order]
+                        if b in ("sum", "count"):
+                            # segmented associative scan in f64: a global
+                            # f32 prefix difference cancels catastrophically
+                            # for large values (advisor finding)
+                            def comb_add(a, c):
+                                af, av = a
+                                cf, cv = c
+                                return (af | cf,
+                                        jnp.where(cf, cv, av + cv))
+                            _f, run = jax.lax.associative_scan(
+                                comb_add, (starts, v.astype(jnp.float64)))
+                            rows.append(run)
+                        else:
+                            is_max = b == "max"
+                            op = jnp.maximum if is_max else jnp.minimum
+
+                            def comb(a, c):
+                                af, av = a
+                                cf, cv = c
+                                return (af | cf,
+                                        jnp.where(cf, cv, op(av, cv)))
+                            _f, run = jax.lax.associative_scan(
+                                comb, (starts, v.astype(jnp.float64)))
+                            rows.append(run)
+                    outs_i.append(jnp.stack(
+                        [order.astype(jnp.int32), starts.astype(jnp.int32)]))
+                    outs_f.append(jnp.stack(rows))
+                return {"i": jnp.concatenate(outs_i, axis=0),
+                        "f": jnp.concatenate(outs_f, axis=0)}
+            fn = self._dev_cache[npad] = jax.jit(kernel)
+
+        ts_p = np.full(npad, np.int64(2**62))
+        ts_p[:n] = ts
+        g_p = np.zeros((len(gints), npad), np.int64)
+        for i, g in enumerate(gints):
+            g_p[i, :n] = g
+        v_p = np.zeros((len(vals), npad), np.float32)
+        for i, v in enumerate(vals):
+            v_p[i, :n] = v
+        res = fn(ts_p, g_p, v_p)
+        try:
+            res["i"].copy_to_host_async()
+        except Exception:
+            pass
+        ipack = np.asarray(res["i"])
+        fpack = np.asarray(res["f"])
+        out = []
+        for di, dur in enumerate(self.durations):
+            order = ipack[2 * di]
+            starts = ipack[2 * di + 1] != 0
+            runs = fpack[di * nb:(di + 1) * nb]
+            sidx = np.flatnonzero(starts)
+            sidx = sidx[sidx < n]               # drop padding segments
+            ends = np.concatenate([sidx[1:], [n]]) - 1
+            rows_any = order[sidx]
+            buckets_of = bucket_starts(ts[rows_any], dur)
+            reduced = [runs[bi][ends] for bi in range(nb)]
+            out.append((buckets_of, rows_any, reduced))
+        return out
 
     def _merge(self, a: list, b: list) -> list:
         out = []

@@ -148,6 +148,7 @@ class SiddhiAppRuntime:
         self._ingest_thread = None
         self._ingest_err = None
         self._async_outbox: list = []   # full builders staged under the lock
+        self._outbox_mutex = threading.Lock()   # orders producer enqueues
 
         from .stats import StatisticsManager
         self.stats = StatisticsManager(self)
@@ -419,12 +420,15 @@ class SiddhiAppRuntime:
         worker."""
         if not self._async_outbox:
             return
-        while True:
-            try:
-                item = self._async_outbox.pop(0)
-            except IndexError:
-                return
-            self._ingest_q.put(item)
+        # pop+put under a dedicated mutex so two producers can't reorder
+        # staged batches (the worker never takes this mutex — no deadlock)
+        with self._outbox_mutex:
+            while True:
+                try:
+                    item = self._async_outbox.pop(0)
+                except IndexError:
+                    return
+                self._ingest_q.put(item)
 
     def _send_locked(self, stream_id: str, data, timestamp: Optional[int]) -> None:
         schema = self.schemas[stream_id]
@@ -485,6 +489,33 @@ class SiddhiAppRuntime:
         self._flush_sink_outbox()
 
     def _async_barrier(self) -> None:
+        import queue as _queue
+        owned = getattr(self._lock, "_is_owned", lambda: False)()
+        if owned:
+            # the caller holds the runtime lock (query()/snapshot()/
+            # set_time() nested flush): the worker can't run, so drain the
+            # queue inline ourselves — FIFO first, then builder leftovers —
+            # preserving order without deadlocking on queue.join()
+            while True:
+                try:
+                    item = self._ingest_q.get_nowait()
+                except _queue.Empty:
+                    break
+                try:
+                    if item is not None:
+                        sid, batch = item
+                        self._pending.append((sid, batch))
+                        self._drain()
+                finally:
+                    self._ingest_q.task_done()
+            for sid, b in self._builders.items():
+                if len(b):
+                    self._pending.append((sid, b.freeze_and_clear()))
+            self._drain()
+            if self._ingest_err is not None:
+                err, self._ingest_err = self._ingest_err, None
+                raise err
+            return
         with self._lock:
             leftovers = [(sid, b.freeze_and_clear())
                          for sid, b in self._builders.items() if len(b)]

@@ -41,7 +41,7 @@ from ..query import ast
 from ..query.ast import AttrType
 from .batch import EventBatch
 from .expr import (CompiledExpr, ExprError, SingleStreamContext,
-                   compile_expression, jnp_dtype)
+                   compile_expression, compute_dtypes, F32_MODE, jnp_dtype)
 from .planner import (AGGREGATOR_NAMES, OutputBatch, PlanError, QueryPlan,
                       selector_has_aggregators)
 from .schema import StreamSchema, TIMESTAMP_DTYPE, dtype_of
@@ -186,6 +186,10 @@ class DeviceWindowAggPlan(QueryPlan):
         self.name = name
         self.rt = rt
         self.output_target = target
+        prec = ast.find_annotation(rt.app.annotations, "app:devicePrecision")
+        self.f64 = prec is not None and str(prec.element()).lower() == "f64"
+        self._mode = None if self.f64 else F32_MODE
+        self.fdt = jnp.float64 if self.f64 else jnp.float32
         if q.rate is not None:
             raise DeviceWindowUnsupported("output rate limiting")
         if getattr(q.output, "events_for", ast.OutputEventsFor.CURRENT) \
@@ -368,8 +372,9 @@ class DeviceWindowAggPlan(QueryPlan):
               "valid": jnp.zeros(C, dtype=bool),
               "seen": jnp.int64(0)}
         for k in self._carry_cols():
-            st[f"c.{k}"] = jnp.zeros(
-                C, dtype=jnp_dtype(self.in_schema.types[k]))
+            with compute_dtypes(self._mode):
+                st[f"c.{k}"] = jnp.zeros(
+                    C, dtype=jnp_dtype(self.in_schema.types[k]))
         return st
 
     def _dummy(self, T: int) -> dict:
@@ -420,14 +425,16 @@ class DeviceWindowAggPlan(QueryPlan):
         L = getattr(self, "L", 0)
         D = getattr(self, "D", 0)
         N = C + T
+        FDT = self.fdt
+        out_types = [a.type for a in self.out_schema.attributes]
 
         def site_vals(env_all, n):
             out = []
             for nm, arg, _t in sites:
                 if arg is None or nm == "count":
-                    out.append(jnp.ones(n))
+                    out.append(jnp.ones(n, FDT))
                 else:
-                    out.append(arg.fn(env_all).astype(F64))
+                    out.append(arg.fn(env_all).astype(FDT))
             return out
 
         def group_seg(env_all, gvalid, n):
@@ -494,11 +501,11 @@ class DeviceWindowAggPlan(QueryPlan):
                     aggs_full.append(_range_reduce(
                         table, jnp.minimum(left, gpos), gpos, nm == "max"))
                     continue
-                v = (all_valid.astype(F64) if nm == "count"
+                v = (all_valid.astype(FDT) if nm == "count"
                      else jnp.where(all_valid, vals[i], 0.0))
                 s = _seg_window_sum(seg, v, left, gpos, N)
                 if nm == "avg":
-                    c1 = _seg_window_sum(seg, all_valid.astype(F64), left,
+                    c1 = _seg_window_sum(seg, all_valid.astype(FDT), left,
                                          gpos, N)
                     s = s / jnp.maximum(c1, 1.0)
                 aggs_full.append(s)
@@ -551,11 +558,11 @@ class DeviceWindowAggPlan(QueryPlan):
                     vv = jnp.where(all_valid, vals[i], neutral)
                     aggs.append(_seg_running_minmax(segb, vv, nm == "max", N))
                 else:
-                    v = (all_valid.astype(F64) if nm == "count"
+                    v = (all_valid.astype(FDT) if nm == "count"
                          else jnp.where(all_valid, vals[i], 0.0))
                     s = _seg_running_sum(segb, v, N)
                     if nm == "avg":
-                        c1 = _seg_running_sum(segb, all_valid.astype(F64), N)
+                        c1 = _seg_running_sum(segb, all_valid.astype(FDT), N)
                         s = s / jnp.maximum(c1, 1.0)
                     aggs.append(s)
             total = base + jnp.sum(all_valid)
@@ -570,19 +577,65 @@ class DeviceWindowAggPlan(QueryPlan):
                 nst[f"c.{c}"] = sl(env_all[c])
             return nst, outs, row_ok, row_ts, jnp.int32(0)
 
-        def step(state, env):
-            mask = env["__valid__"]
-            if filt is not None:
-                mask = mask & filt.fn(env)
-            order = jnp.argsort(~mask, stable=True)
-            k = jnp.sum(mask)
-            bvalid = jnp.arange(T) < k
-            bts = jnp.where(bvalid, env["__timestamp__"][order], _TS_PAD)
-            bcols = {c: env[c][order] for c in cols}
-            if kind == "lengthbatch":
-                return step_lengthbatch(state, bts, bvalid, bcols, k)
-            return step_sliding(state, bts, bvalid, bcols, k)
+        def compact(mask, arr, fill):
+            pos = jnp.cumsum(mask.astype(jnp.int32), dtype=jnp.int32) - mask
+            wpos = jnp.where(mask, pos, T)
+            return jnp.full((T,), fill, arr.dtype).at[wpos].set(
+                arr, mode="drop")
 
+        def step(state, env):
+            with compute_dtypes(mode):
+                mask = env["__valid__"]
+                if filt is not None:
+                    mask = mask & filt.fn(env)
+                # compact filtered events to the front: one i32 cumsum + one
+                # scatter per column (a stable argsort here cost 244s of
+                # XLA compile at T=16K and dominated runtime)
+                k = jnp.sum(mask, dtype=jnp.int32)
+                bvalid = jnp.arange(T, dtype=jnp.int32) < k
+                bts = compact(mask, env["__timestamp__"], _TS_PAD)
+                bcols = {c: compact(mask, env[c], 0) for c in cols}
+                if kind == "lengthbatch":
+                    res = step_lengthbatch(state, bts, bvalid, bcols, k)
+                else:
+                    res = step_sliding(state, bts, bvalid, bcols, k)
+                return pack(res)
+
+        def pack(res):
+            """ONE i32 output matrix (+ separate f64 pack only in f64
+            mode): ~100ms fixed latency per device->host pull through the
+            tunnel, so outputs travel together.  Row 0 = [overflow, ...],
+            row 1 = ok, rows 2-3 = ts hi/lo, then the out columns (f32
+            bitcast, i64 as hi/lo pairs, i32/bool as-is)."""
+            nst, outs, row_ok, row_ts, overflow = res
+            n = row_ok.shape[0]
+            meta = jnp.zeros((n,), jnp.int32).at[0].set(overflow)
+            row_ts = row_ts.astype(jnp.int64)
+            irows = [meta, row_ok.astype(jnp.int32),
+                     _w_hi32(row_ts), _w_lo32(row_ts)]
+            frows = []
+            # encode by DECLARED type so the host unpack (which switches on
+            # the out schema) always reads the matching rows — the raw
+            # device dtype may be widened (e.g. INT aggregates ride i64)
+            for colv, t in zip(outs, out_types):
+                colv = jnp.asarray(colv)
+                if t == AttrType.DOUBLE and FDT == jnp.float64:
+                    frows.append(colv.astype(jnp.float64))
+                elif t in (AttrType.DOUBLE, AttrType.FLOAT):
+                    irows.append(jax.lax.bitcast_convert_type(
+                        colv.astype(jnp.float32), jnp.int32))
+                elif t == AttrType.LONG:
+                    colv = colv.astype(jnp.int64)
+                    irows.append(_w_hi32(colv))
+                    irows.append(_w_lo32(colv))
+                else:
+                    irows.append(colv.astype(jnp.int32))
+            out = {"i": jnp.stack(irows, axis=0), "nst": nst}
+            if frows:
+                out["f"] = jnp.stack(frows, axis=0)
+            return out
+
+        mode = self._mode
         return jax.jit(step)
 
     # -- QueryPlan interface --------------------------------------------------
@@ -594,22 +647,46 @@ class DeviceWindowAggPlan(QueryPlan):
         env = {"__timestamp__": _pad(batch.timestamps, T, 0),
                "__valid__": _pad(np.ones(batch.n, bool), T, False)}
         for c in self.cols:
-            env[c] = _pad(batch.columns[c], T, 0)
+            col = batch.columns[c]
+            if not self.f64 and col.dtype == np.float64:
+                col = col.astype(np.float32)     # device DOUBLE policy
+            env[c] = _pad(col, T, 0)
         while True:
             fn = self._step_fn(T, self.C)
-            state2, outs, row_ok, row_ts, overflow = fn(self.state, env)
-            if int(np.asarray(overflow)):
+            res = fn(self.state, env)
+            try:        # start the D2H pull while the device computes
+                res["i"].copy_to_host_async()
+            except Exception:
+                pass
+            ipack = np.asarray(res["i"])         # ONE pull (+f in f64 mode)
+            fpack = np.asarray(res["f"]) if "f" in res else None
+            if int(ipack[0, 0]):
                 self._grow(2 * self.C)
                 continue
             break
-        self.state = state2
-        ok = np.asarray(row_ok)
+        self.state = res["nst"]
+        ok = ipack[1] != 0
         if not ok.any():
             return []
+        from .nfa_device import join64_np
+        ts_out = join64_np(ipack[2], ipack[3])[ok].astype(TIMESTAMP_DTYPE)
         cols = {}
-        for a, colv in zip(self.out_schema.attributes, outs):
-            cols[a.name] = np.asarray(colv)[ok].astype(dtype_of(a.type))
-        ts_out = np.asarray(row_ts)[ok].astype(TIMESTAMP_DTYPE)
+        ii, fi = 4, 0
+        for a in self.out_schema.attributes:
+            dt = np.dtype(jnp_dtype(a.type)) if a.type != AttrType.DOUBLE \
+                else np.dtype(np.float64 if self.f64 else np.float32)
+            if dt == np.float64:
+                col = fpack[fi]; fi += 1
+            elif dt == np.float32:
+                col = ipack[ii].view(np.float32); ii += 1
+            elif dt == np.int64:
+                col = join64_np(ipack[ii], ipack[ii + 1]); ii += 2
+            else:
+                col = ipack[ii]; ii += 1
+            v = col[ok]
+            if a.type == AttrType.BOOL:
+                v = v != 0
+            cols[a.name] = v.astype(dtype_of(a.type))
         out = EventBatch(self.out_schema, ts_out, cols, int(ok.sum()))
         return [OutputBatch(self.output_target, out)]
 
@@ -624,6 +701,9 @@ class DeviceWindowAggPlan(QueryPlan):
         if c != self.C:
             self.C = c
         self.state = {k: jnp.asarray(v) for k, v in d["state"].items()}
+
+
+from .nfa_device import _hi32 as _w_hi32, _lo32 as _w_lo32  # noqa: E402
 
 
 def _cast_site(a: jnp.ndarray, t: AttrType) -> jnp.ndarray:

@@ -151,3 +151,43 @@ def test_wildcard_within_pattern(mgr):
     assert sorted(r for _t, r in rows) == [(5,), (6,)]
     rows = rt.query("from A within '2017-06-02 **:**:**' per 'hours' select s")
     assert rows == []
+
+
+def test_device_aggregation_differential(mgr):
+    """Opt-in device segmented-reduction path == host numpy path."""
+    import numpy as np
+    body = """
+    define stream Trades (sym string, price double, vol long);
+    define aggregation TradeAgg
+    from Trades select sym, sum(price) as total, avg(price) as ap,
+                      min(price) as lo, max(price) as hi, count() as n
+    group by sym
+    aggregate every sec, min, hour;
+    """
+    rng = np.random.default_rng(9)
+    sends = []
+    for i in range(500):
+        sends.append((f"S{int(rng.integers(6))}",
+                      float(np.round(rng.uniform(10, 50) * 4) / 4),
+                      int(rng.integers(1, 100)),
+                      1_700_000_000_000 + int(rng.integers(0, 3_600_000))))
+    results = {}
+    for mode in ("@app:deviceAggregations('always')\n", ""):
+        rt = mgr.create_app_runtime(mode + body)
+        h = rt.input_handler("Trades")
+        rt.start()
+        for sym, p, v, ts in sends:
+            h.send((sym, p, v), timestamp=ts)
+        rt.flush()
+        agg = rt.aggregations["TradeAgg"]
+        assert agg.device == bool(mode)
+        rows = rt.query("from TradeAgg within 1700000000000L, 1800000000000L "
+                        "per 'hours' select sym, total, ap, lo, hi, n")
+        results[mode or "host"] = sorted((t, r) for t, r in rows)
+    dev, host = results.values()
+    assert len(dev) == len(host) > 0
+    for (td, rd), (th, rh) in zip(dev, host):
+        assert td == th and rd[0] == rh[0]
+        for a, b in zip(rd[1:], rh[1:]):
+            assert float(b) == pytest.approx(float(a), rel=2e-5, abs=2e-4), \
+                (rd, rh)

@@ -41,7 +41,7 @@ def differential(query, rows, seed=0):
         assert d[0] == h[0], (d, h)
         for a, b in zip(d[1], h[1]):
             if isinstance(a, float):
-                assert b == pytest.approx(a, rel=1e-9, abs=1e-9), (d, h)
+                assert b == pytest.approx(a, rel=2e-5, abs=2e-4), (d, h)
             else:
                 assert a == b, (d, h)
 
@@ -126,7 +126,7 @@ def test_device_snapshot_restore():
     rt3.flush()
     a = [v for row in out + out2 for v in row]
     b = [v for row in out3 for v in row]
-    assert a == pytest.approx(b, rel=1e-9, abs=1e-9)
+    assert a == pytest.approx(b, rel=2e-5, abs=2e-4)
     m.shutdown(); m2.shutdown(); m3.shutdown()
 
 
