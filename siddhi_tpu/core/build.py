@@ -22,9 +22,16 @@ def build_app(rt) -> None:
         if tid in rt.schemas:
             raise PlanError(f"{tid!r} defined as both stream and table")
         try:
-            rt.tables[tid] = InMemoryTable(td, rt.strings)
+            from .record_table import build_record_table
+            bridge = build_record_table(td, rt.strings)
+            rt.tables[tid] = bridge if bridge is not None \
+                else InMemoryTable(td, rt.strings)
         except TableError as e:
             raise PlanError(str(e)) from None
+        except PlanError:
+            raise
+        except Exception as e:      # store connect failures etc.
+            raise PlanError(f"table {tid!r}: {e}") from e
 
     from ..interp.named_window import NamedWindowRuntime
     from .schema import StreamSchema

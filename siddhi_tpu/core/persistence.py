@@ -1,0 +1,152 @@
+"""Persistence stores: file-system, incremental (base + op-log deltas),
+and asynchronous write-out.
+
+Reference: core:util/persistence/FileSystemPersistenceStore,
+IncrementalFileSystemPersistenceStore.java:37,
+core:util/snapshot/AsyncSnapshotPersistor.java:70,
+core:event/stream/holder/SnapshotableStreamEventQueue (op-log snapshots),
+core:table/holder/IndexEventHolder.java:74-76 (change-log with the 2.1x
+full-snapshot threshold).
+
+TPU-framework twist: device plan state is a handful of dense arrays, so a
+full snapshot of a plan is already one host copy + pickle — the op-log
+machinery pays off for TABLES, where mutation rate is low relative to
+size.  Incremental revisions therefore carry table op-logs plus full
+state for everything else, mirroring where the reference's incremental
+path actually saves work.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from typing import Optional
+
+
+class FileSystemPersistenceStore:
+    """One file per revision under <dir>/<app>/ (reference:
+    FileSystemPersistenceStore)."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _app_dir(self, app: str) -> str:
+        d = os.path.join(self.dir, app.replace(os.sep, "_") or "_app")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def save(self, app: str, revision: str, blob: bytes) -> None:
+        path = os.path.join(self._app_dir(app), f"{revision}.snapshot")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)       # atomic publish
+
+    def load(self, app: str, revision: str) -> bytes:
+        with open(os.path.join(self._app_dir(app),
+                               f"{revision}.snapshot"), "rb") as f:
+            return f.read()
+
+    def revisions(self, app: str) -> list:
+        d = self._app_dir(app)
+        revs = [f[:-len(".snapshot")] for f in os.listdir(d)
+                if f.endswith(".snapshot")]
+        return sorted(revs, key=_rev_time)
+
+    def last_revision(self, app: str) -> Optional[str]:
+        revs = self.revisions(app)
+        return revs[-1] if revs else None
+
+    def clear(self, app: str) -> None:
+        for r in self.revisions(app):
+            os.remove(os.path.join(self._app_dir(app), f"{r}.snapshot"))
+
+
+def _rev_time(rev: str) -> int:
+    """Embedded time_ns of a revision id ('[FI]-<app>-<time_ns>')."""
+    try:
+        return int(rev.rsplit("-", 1)[1])
+    except (IndexError, ValueError):
+        return 0
+
+
+class IncrementalFileSystemPersistenceStore(FileSystemPersistenceStore):
+    """Full revisions (`F-`) and incremental deltas (`I-`): restore loads
+    the last full revision and replays every later delta in order
+    (reference: IncrementalFileSystemPersistenceStore.java:37)."""
+
+    def save_incremental(self, app: str, revision: str, blob: bytes,
+                         is_full: bool) -> None:
+        prefix = "F-" if is_full else "I-"
+        self.save(app, prefix + revision, blob)
+
+    def restore_chain(self, app: str) -> Optional[tuple]:
+        """(full_blob, [delta_blobs...], newest_time) for the newest full
+        revision; deltas are selected by their embedded timestamp, NOT by
+        string order (the 'I-'/'F-' prefixes don't sort together)."""
+        revs = self.revisions(app)
+        fulls = [r for r in revs if r.startswith("F-")]
+        if not fulls:
+            return None
+        base = fulls[-1]
+        deltas = [r for r in revs
+                  if r.startswith("I-") and _rev_time(r) > _rev_time(base)]
+        newest = _rev_time(deltas[-1] if deltas else base)
+        return (self.load(app, base), [self.load(app, d) for d in deltas],
+                newest)
+
+
+class AsyncSnapshotPersistor:
+    """Fire-and-forget snapshot write-out on a daemon thread (reference:
+    AsyncSnapshotPersistor.java:70).  `errors` collects write failures."""
+
+    def __init__(self):
+        self.errors: list = []
+        self._threads: list = []
+
+    def persist(self, fn, *args) -> threading.Thread:
+        def run():
+            try:
+                fn(*args)
+            except Exception as e:      # surfaced via .errors
+                self.errors.append(e)
+        t = threading.Thread(target=run, name="siddhi-persist", daemon=True)
+        t.start()
+        self._threads.append(t)
+        return t
+
+    def wait(self, timeout: float = 10.0) -> None:
+        for t in self._threads:
+            t.join(timeout)
+        self._threads = [t for t in self._threads if t.is_alive()]
+
+
+class PeriodicPersistence:
+    """Persist the runtime every `interval_s` on a daemon thread until
+    stopped (the scheduler-driven persistence the reference wires via
+    SiddhiContext.persistenceStore + external triggers)."""
+
+    def __init__(self, rt, interval_s: float, incremental: bool = False):
+        self.rt = rt
+        self.interval_s = interval_s
+        self.incremental = incremental
+        self.revisions: list = []
+        self.errors: list = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="siddhi-periodic-persist")
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.revisions.append(
+                    self.rt.persist(incremental=self.incremental))
+            except Exception as e:
+                self.errors.append(e)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)

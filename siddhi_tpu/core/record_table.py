@@ -1,0 +1,540 @@
+"""External-store table SPI — the analog of the reference's
+AbstractRecordTable + ExpressionBuilder condition pushdown
+(reference: core:table/record/AbstractRecordTable.java:424,
+core:table/record/ExpressionBuilder.java:405,
+core:util/collection/expression/* compiled-condition model).
+
+A table defined with `@store(type='x', ...)` lives OUTSIDE the engine
+(RDBMS, KV store, ...).  The engine compiles each table condition ONCE
+into a backend-neutral `StoreCondition` tree where:
+
+  * table columns are `("col", name)` leaves,
+  * stream-side subexpressions (anything not touching the table) are
+    lifted into named parameters `("param", key)` whose values are
+    computed per probe event and shipped with the operation — exactly
+    the reference's ExpressionBuilder constant/variable lifting,
+  * the store renders the tree into its query language (SQL etc.); a
+    default `evaluate(record, params)` interpreter lets simple stores
+    filter generically.
+
+All engine operations reach the store through the SPI verbs
+(add/find/update/delete/update_or_add/contains) with pushed-down
+conditions — never row handles: external rows have no engine identity
+(reference semantics).  `set` values for record tables may reference
+stream/output attributes only (computed host-side and shipped as plain
+values; the reference ships the same computed update-set maps).
+
+The engine-facing `RecordTableBridge` mirrors the InMemoryTable access
+surface (compiled-condition find + row_env/row_tuple over a per-probe
+fetch cache) so joins, store queries, writers, and `in Table` membership
+work unchanged via the dispatch hook in compile_table_condition.
+"""
+from __future__ import annotations
+
+import time
+import warnings
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..query import ast
+from ..query.ast import AttrType
+from .schema import StreamSchema, StringTable
+from .table import TableError
+
+# ---------------------------------------------------------------------------
+# backend-neutral compiled condition
+# ---------------------------------------------------------------------------
+
+_CMP = {
+    ast.CompareOp.LT: "<", ast.CompareOp.LE: "<=", ast.CompareOp.GT: ">",
+    ast.CompareOp.GE: ">=", ast.CompareOp.EQ: "==", ast.CompareOp.NEQ: "!=",
+}
+_MATH = {ast.MathOp.ADD: "+", ast.MathOp.SUB: "-", ast.MathOp.MUL: "*",
+         ast.MathOp.DIV: "/", ast.MathOp.MOD: "%"}
+
+
+class StoreCondition:
+    """Immutable pushdown tree.  Node forms (nested tuples):
+      ("col", name) | ("param", key) | ("const", value)
+      ("cmp", op, l, r) | ("and", l, r) | ("or", l, r) | ("not", e)
+      ("math", op, l, r) | ("isnull", e) | ("true",)
+    """
+
+    __slots__ = ("node", "param_fns")
+
+    def __init__(self, node, param_fns: dict):
+        self.node = node
+        self.param_fns = param_fns      # key -> fn(env) -> value
+
+    def params(self, env: dict) -> dict:
+        return {k: f(env) for k, f in self.param_fns.items()}
+
+    def evaluate(self, record: dict, params: dict) -> bool:
+        return bool(_eval(self.node, record, params))
+
+    def __repr__(self):
+        return f"StoreCondition({self.node!r})"
+
+
+def _eval(n, rec, params):
+    tag = n[0]
+    if tag == "true":
+        return True
+    if tag == "col":
+        return rec.get(n[1])
+    if tag == "param":
+        return params[n[1]]
+    if tag == "const":
+        return n[1]
+    if tag == "and":
+        return bool(_eval(n[1], rec, params)) and bool(_eval(n[2], rec, params))
+    if tag == "or":
+        return bool(_eval(n[1], rec, params)) or bool(_eval(n[2], rec, params))
+    if tag == "not":
+        return not bool(_eval(n[1], rec, params))
+    if tag == "isnull":
+        return _eval(n[1], rec, params) is None
+    l, r = _eval(n[2], rec, params), _eval(n[3], rec, params)
+    if tag == "cmp":
+        if l is None or r is None:
+            return False
+        op = n[1]
+        return {"<": l < r, "<=": l <= r, ">": l > r, ">=": l >= r,
+                "==": l == r, "!=": l != r}[op]
+    if tag == "math":
+        if l is None or r is None:
+            return None
+        op = n[1]
+        if op == "+":
+            return l + r
+        if op == "-":
+            return l - r
+        if op == "*":
+            return l * r
+        if op == "/":
+            return l / r
+        return l % r
+    raise TableError(f"bad store-condition node {tag!r}")
+
+
+class StoreExpressionBuilder:
+    """ast.Expression -> StoreCondition (reference ExpressionBuilder's
+    visitor).  Subtrees that never touch the table become parameters."""
+
+    def __init__(self, table_refs: set, schema: StreamSchema, stream_ctx):
+        self.table_refs = table_refs
+        self.schema = schema
+        self.stream_ctx = stream_ctx
+        self.param_fns: dict = {}
+
+    def build(self, expr: Optional[ast.Expression]) -> StoreCondition:
+        node = ("true",) if expr is None else self._walk(expr)
+        return StoreCondition(node, self.param_fns)
+
+    # -- helpers ----------------------------------------------------------
+
+    def _is_table_col(self, e) -> Optional[str]:
+        if isinstance(e, ast.Variable) and e.index is None:
+            if e.stream_ref in self.table_refs:
+                return e.attribute
+            if e.stream_ref is None and e.attribute in self.schema.types \
+                    and not self._stream_resolves(e):
+                return e.attribute
+        return None
+
+    def _stream_resolves(self, e: ast.Variable) -> bool:
+        try:
+            self.stream_ctx.resolve(e)
+            return True
+        except Exception:
+            return False
+
+    def _touches_table(self, e) -> bool:
+        if self._is_table_col(e) is not None:
+            return True
+        for nm in ("left", "right", "expr"):
+            sub = getattr(e, nm, None)
+            if isinstance(sub, ast.Expression) and self._touches_table(sub):
+                return True
+        for sub in getattr(e, "args", ()) or ():
+            if isinstance(sub, ast.Expression) and self._touches_table(sub):
+                return True
+        return False
+
+    def _param(self, e: ast.Expression):
+        from ..interp.expr import compile_py
+        key = f"p{len(self.param_fns)}"
+        fn, _t = compile_py(e, self.stream_ctx)
+        self.param_fns[key] = fn
+        return ("param", key)
+
+    def _walk(self, e: ast.Expression):
+        col = self._is_table_col(e)
+        if col is not None:
+            return ("col", col)
+        if not self._touches_table(e):
+            if isinstance(e, ast.Constant):
+                return ("const", e.value)
+            return self._param(e)
+        if isinstance(e, ast.And):
+            return ("and", self._walk(e.left), self._walk(e.right))
+        if isinstance(e, ast.Or):
+            return ("or", self._walk(e.left), self._walk(e.right))
+        if isinstance(e, ast.Not):
+            return ("not", self._walk(e.expr))
+        if isinstance(e, ast.Compare):
+            return ("cmp", _CMP[e.op], self._walk(e.left), self._walk(e.right))
+        if isinstance(e, ast.Math):
+            return ("math", _MATH[e.op], self._walk(e.left), self._walk(e.right))
+        if isinstance(e, ast.IsNull) and e.expr is not None:
+            return ("isnull", self._walk(e.expr))
+        raise TableError(
+            f"record-store condition: cannot push down "
+            f"{type(e).__name__} over table columns")
+
+
+# ---------------------------------------------------------------------------
+# the SPI
+# ---------------------------------------------------------------------------
+
+class RecordTable:
+    """Extension base for external table stores.  Subclass and register
+    with `register_store_type`; records are dicts of decoded python
+    values keyed by attribute name, plus "__timestamp__"."""
+
+    def __init__(self, defn: ast.TableDefinition, options: dict):
+        self.defn = defn
+        self.options = options
+        self.connected = False
+
+    # -- lifecycle (reference: Table.connectWithRetry) --------------------
+
+    def connect(self) -> None:
+        pass
+
+    def disconnect(self) -> None:
+        pass
+
+    def connect_with_retry(self, max_tries: int = 5,
+                           base_delay_s: float = 0.05) -> None:
+        delay = base_delay_s
+        for attempt in range(max_tries):
+            try:
+                self.connect()
+                self.connected = True
+                return
+            except Exception as e:
+                if attempt == max_tries - 1:
+                    raise
+                warnings.warn(
+                    f"store {type(self).__name__} for table "
+                    f"{self.defn.id!r}: connect failed ({e}); retrying in "
+                    f"{delay:.2f}s", RuntimeWarning)
+                time.sleep(delay)
+                delay *= 2
+
+    # -- operations (reference AbstractRecordTable verbs) -----------------
+
+    def add(self, records: list) -> None:
+        raise NotImplementedError
+
+    def find(self, condition: StoreCondition, params: dict) -> list:
+        raise NotImplementedError
+
+    def update(self, condition: StoreCondition, params: dict,
+               set_values: dict) -> int:
+        raise NotImplementedError
+
+    def delete(self, condition: StoreCondition, params: dict) -> int:
+        raise NotImplementedError
+
+    def update_or_add(self, condition: StoreCondition, params: dict,
+                      set_values: dict, record: dict) -> None:
+        if self.update(condition, params, set_values) == 0:
+            self.add([record])
+
+    def contains(self, condition: StoreCondition, params: dict) -> bool:
+        return bool(self.find(condition, params))
+
+    # -- optional snapshot participation ----------------------------------
+
+    def snapshot(self):
+        return None
+
+    def restore(self, state) -> None:
+        pass
+
+
+class InMemoryRecordStore(RecordTable):
+    """Reference implementation / test double (the analog of the
+    reference's TestStoreContainingInMemoryTable)."""
+
+    def __init__(self, defn, options):
+        super().__init__(defn, options)
+        self.records: list = []
+        self.op_counts = {"add": 0, "find": 0, "update": 0, "delete": 0}
+
+    def add(self, records: list) -> None:
+        self.op_counts["add"] += 1
+        self.records.extend(dict(r) for r in records)
+
+    def find(self, condition, params) -> list:
+        self.op_counts["find"] += 1
+        return [r for r in self.records if condition.evaluate(r, params)]
+
+    def update(self, condition, params, set_values) -> int:
+        self.op_counts["update"] += 1
+        n = 0
+        for r in self.records:
+            if condition.evaluate(r, params):
+                r.update(set_values)
+                n += 1
+        return n
+
+    def delete(self, condition, params) -> int:
+        self.op_counts["delete"] += 1
+        before = len(self.records)
+        self.records = [r for r in self.records
+                        if not condition.evaluate(r, params)]
+        return before - len(self.records)
+
+    def snapshot(self):
+        return [dict(r) for r in self.records]
+
+    def restore(self, state) -> None:
+        self.records = [dict(r) for r in (state or [])]
+
+
+STORE_TYPES: dict = {"memory": InMemoryRecordStore,
+                     "teststore": InMemoryRecordStore}
+
+
+def register_store_type(name: str, cls) -> None:
+    STORE_TYPES[name.lower()] = cls
+
+
+# ---------------------------------------------------------------------------
+# engine-facing bridge
+# ---------------------------------------------------------------------------
+
+class RecordTableBridge:
+    """Quacks like InMemoryTable for the engine's consumers; every
+    operation round-trips through the SPI with a pushed-down condition.
+    Fetched records are cached under virtual row indices for the duration
+    of one probe (find -> row_env/row_tuple access pattern)."""
+
+    is_record = True
+
+    def __init__(self, defn: ast.TableDefinition, strings: StringTable,
+                 store: RecordTable):
+        self.defn = defn
+        self.id = defn.id
+        self.schema = StreamSchema(defn.id, tuple(defn.attributes))
+        self.strings = strings
+        self.store = store
+        self.pk_attrs: tuple = tuple(defn.primary_keys())
+        self._fetch: list = []       # virtual row index -> record dict
+
+    # -- fetch cache -------------------------------------------------------
+
+    def cache_records(self, records: list) -> np.ndarray:
+        base = len(self._fetch)
+        self._fetch.extend(records)
+        if len(self._fetch) > 1 << 16:      # bound the cache across probes
+            self._fetch = list(records)
+            base = 0
+        return np.arange(base, base + len(records), dtype=np.int64)
+
+    def _rec(self, row: int) -> dict:
+        return self._fetch[int(row)]
+
+    def row_env(self, row: int, refs: tuple = ()) -> dict:
+        rec = self._rec(row)
+        env = {}
+        for a in self.defn.attributes:
+            v = rec.get(a.name)
+            for r in refs:
+                env[f"{r}.{a.name}"] = v
+        return env
+
+    def row_tuple(self, row: int) -> tuple:
+        rec = self._rec(row)
+        return tuple(rec.get(a.name) for a in self.defn.attributes)
+
+    def row_ts(self, row: int) -> int:
+        return int(self._rec(row).get("__timestamp__", 0) or 0)
+
+    # -- InMemoryTable-surface operations ---------------------------------
+
+    def insert_batch(self, batch) -> None:
+        rows = batch.rows(self.strings)
+        recs = []
+        for ts, row in zip(batch.timestamps, rows):
+            rec = {a.name: v for a, v in zip(self.defn.attributes, row)}
+            rec["__timestamp__"] = int(ts)
+            recs.append(rec)
+        self.store.add(recs)
+
+    def all_rows(self) -> list:
+        cond = StoreCondition(("true",), {})
+        return [tuple(r.get(a.name) for a in self.defn.attributes)
+                for r in self.store.find(cond, {})]
+
+    def __len__(self) -> int:
+        return len(self.store.find(StoreCondition(("true",), {}), {}))
+
+    # -- snapshot ---------------------------------------------------------
+
+    def state_dict(self) -> dict:
+        return {"store": self.store.snapshot()}
+
+    def load_state_dict(self, st: dict) -> None:
+        self.store.restore(st.get("store"))
+
+
+class CompiledRecordCondition:
+    """CompiledTableCondition-compatible probe over the SPI."""
+
+    uses_index = False
+
+    def __init__(self, bridge: RecordTableBridge, cond: StoreCondition):
+        self.table = bridge
+        self.cond = cond
+
+    def find(self, env: dict) -> np.ndarray:
+        records = self.table.store.find(self.cond, self.cond.params(env))
+        return self.table.cache_records(records)
+
+    def contains(self, env: dict) -> bool:
+        return self.table.store.contains(self.cond, self.cond.params(env))
+
+
+def compile_record_condition(expr: Optional[ast.Expression],
+                             bridge: RecordTableBridge,
+                             refs, stream_ctx) -> CompiledRecordCondition:
+    b = StoreExpressionBuilder(set(refs), bridge.schema, stream_ctx)
+    cond = b.build(expr)
+    # a bare value expression (`expr in T`) means primary-key membership
+    # (reference InConditionExpressionExecutor)
+    if cond.node[0] in ("col", "param", "const", "math"):
+        if len(bridge.pk_attrs) != 1:
+            raise TableError(
+                f"'in {bridge.id}': needs exactly one @PrimaryKey attribute")
+        cond = StoreCondition(
+            ("cmp", "==", ("col", bridge.pk_attrs[0]), cond.node),
+            cond.param_fns)
+    return CompiledRecordCondition(bridge, cond)
+
+
+# ---------------------------------------------------------------------------
+# record-table writers (reference: RecordTableHandler add/update/delete)
+# ---------------------------------------------------------------------------
+
+class _RecordConditionedWriter:
+    def __init__(self, bridge, out_schema, on, set_clauses=(), strings=None):
+        from ..interp.expr import PyExprContext, compile_py
+
+        self.bridge = bridge
+        self.out_schema = out_schema
+        self.strings = strings or bridge.strings
+        self._out_ref = f"#out#{out_schema.id}"
+        sctx = PyExprContext({self._out_ref: out_schema},
+                             default_ref=self._out_ref)
+        b = StoreExpressionBuilder({bridge.id}, bridge.schema, sctx)
+        self.cond = b.build(on)
+        # set values: stream/output side only (computed host-side, shipped
+        # as plain values; table-column references can't be pushed down)
+        self.sets: list = []
+        for sc in set_clauses:
+            attr = sc.attribute.attribute
+            if attr not in bridge.schema.types:
+                raise TableError(f"set: table {bridge.id!r} has no "
+                                 f"attribute {attr!r}")
+            if b._touches_table(sc.value):
+                raise TableError(
+                    f"record table {bridge.id!r}: set values may reference "
+                    f"stream attributes only (store-side expressions are "
+                    f"not pushed down)")
+            f, _t = compile_py(sc.value, sctx)
+            self.sets.append((attr, f))
+        if not set_clauses:
+            self.sets = [
+                (a.name, (lambda env, _n=a.name: env.get(_n)))
+                for a in bridge.schema.attributes if a.name in out_schema.types]
+
+    def _row_envs(self, batch):
+        names = [a.name for a in self.out_schema.attributes]
+        rows = batch.rows(self.strings)
+        for ts, row in zip(batch.timestamps, rows):
+            env = dict(zip(names, row))
+            env["__timestamp__"] = int(ts)
+            yield env, row
+
+
+class RecordInsertWriter:
+    def __init__(self, bridge, out_schema):
+        self.bridge = bridge
+        if [a.type for a in out_schema.attributes] != \
+                [a.type for a in bridge.schema.attributes]:
+            raise TableError(
+                f"insert into record table {bridge.id!r}: schema mismatch")
+
+    def apply(self, batch) -> None:
+        self.bridge.insert_batch(batch)
+
+
+class RecordUpdateWriter(_RecordConditionedWriter):
+    def apply(self, batch) -> None:
+        for env, _row in self._row_envs(batch):
+            sets = {attr: f(env) for attr, f in self.sets}
+            self.bridge.store.update(self.cond, self.cond.params(env), sets)
+
+
+class RecordDeleteWriter(_RecordConditionedWriter):
+    def apply(self, batch) -> None:
+        for env, _row in self._row_envs(batch):
+            self.bridge.store.delete(self.cond, self.cond.params(env))
+
+
+class RecordUpdateOrInsertWriter(_RecordConditionedWriter):
+    def apply(self, batch) -> None:
+        for env, row in self._row_envs(batch):
+            sets = {attr: f(env) for attr, f in self.sets}
+            rec = {a.name: v for a, v in
+                   zip(self.bridge.defn.attributes, row)}
+            rec["__timestamp__"] = env["__timestamp__"]
+            self.bridge.store.update_or_add(
+                self.cond, self.cond.params(env), sets, rec)
+
+
+def make_record_table_writer(action, bridge, out_schema):
+    if isinstance(action, ast.InsertInto):
+        return RecordInsertWriter(bridge, out_schema)
+    if isinstance(action, ast.UpdateTable):
+        return RecordUpdateWriter(bridge, out_schema, action.on,
+                                  action.set_clauses)
+    if isinstance(action, ast.DeleteFrom):
+        return RecordDeleteWriter(bridge, out_schema, action.on)
+    if isinstance(action, ast.UpdateOrInsertTable):
+        return RecordUpdateOrInsertWriter(bridge, out_schema, action.on,
+                                          action.set_clauses)
+    raise TableError(f"unsupported table action {type(action).__name__}")
+
+
+def build_record_table(defn: ast.TableDefinition, strings: StringTable):
+    """@store(type='x', ...) table -> bridge, or None for in-memory."""
+    sa = ast.find_annotation(defn.annotations, "store")
+    if sa is None:
+        return None
+    typ = sa.element("type")
+    if typ is None:
+        raise TableError(f"table {defn.id!r}: @store needs a type")
+    cls = STORE_TYPES.get(str(typ).lower())
+    if cls is None:
+        raise TableError(f"table {defn.id!r}: unknown store type {typ!r}; "
+                         f"register_store_type() first")
+    opts = {k: v for k, v in sa.elements if k is not None}
+    store = cls(defn, opts)
+    store.connect_with_retry()
+    return RecordTableBridge(defn, strings, store)

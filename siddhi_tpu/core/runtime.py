@@ -693,14 +693,69 @@ class SiddhiAppRuntime:
                 self.tables[k].load_state_dict(st)
         self._clock_ms = snap.get("clock")
 
-    def persist(self) -> str:
+    def persist(self, incremental: bool = False,
+                asynchronous: bool = False) -> str:
+        """Write a revision to the configured persistence store.
+        incremental=True writes table op-log deltas (full state for
+        everything else — see persistence.py); asynchronous=True hands the
+        store write to a daemon thread (AsyncSnapshotPersistor)."""
         if self.manager is None or self.manager.persistence_store is None:
             raise RuntimeError("no persistence store configured")
         import pickle
+        store = self.manager.persistence_store
         rev = f"{self.app.name}-{time.time_ns()}"
-        self.manager.persistence_store.save(self.app.name, rev,
-                                            pickle.dumps(self.snapshot()))
+        if incremental and hasattr(store, "save_incremental"):
+            with self._lock:
+                self.flush()
+                deltas = {k: t.incremental_state()
+                          for k, t in self.tables.items()
+                          if hasattr(t, "incremental_state")}
+                body = {"snapshot": {
+                            "strings": self.strings.state(),
+                            "plans": {p.name: p.state_dict()
+                                      for p in self._plans},
+                            "tables": {k: t.state_dict()
+                                       for k, t in self.tables.items()
+                                       if not hasattr(t, "incremental_state")},
+                            "clock": self._clock_ms},
+                        "table_deltas": deltas}
+                is_full = all("full" in d for d in deltas.values()) \
+                    if deltas else True
+            blob = pickle.dumps(body)
+            if asynchronous:
+                self.persistor().persist(store.save_incremental,
+                                          self.app.name, rev, blob, is_full)
+            else:
+                store.save_incremental(self.app.name, rev, blob, is_full)
+            return rev
+        blob = pickle.dumps(self.snapshot())
+        if asynchronous:
+            self.persistor().persist(store.save, self.app.name, rev, blob)
+        else:
+            store.save(self.app.name, rev, blob)
         return rev
+
+    def persistor(self):
+        """The async snapshot persistor: .wait() joins outstanding
+        writes, .errors lists write failures (a rev id returned by
+        persist(asynchronous=True) is not durable until wait() returns
+        with no errors)."""
+        if getattr(self, "_async_persistor", None) is None:
+            from .persistence import AsyncSnapshotPersistor
+            self._async_persistor = AsyncSnapshotPersistor()
+        return self._async_persistor
+
+    def persist_every(self, interval_s: float, incremental: bool = False):
+        """Periodic persistence; returns a handle with .stop()."""
+        from .persistence import PeriodicPersistence
+        return PeriodicPersistence(self, interval_s, incremental)
+
+    def _apply_incremental_blob(self, body: dict) -> None:
+        snap = body["snapshot"]
+        self.restore({**snap, "tables": dict(snap.get("tables", {}))})
+        for k, delta in body.get("table_deltas", {}).items():
+            if k in self.tables:
+                self.tables[k].apply_incremental(delta)
 
     def restore_revision(self, rev: str) -> None:
         import pickle
@@ -708,7 +763,24 @@ class SiddhiAppRuntime:
         self.restore(pickle.loads(data))
 
     def restore_last_state(self) -> None:
-        rev = self.manager.persistence_store.last_revision(self.app.name)
+        import pickle
+        store = self.manager.persistence_store
+        chain = store.restore_chain(self.app.name) \
+            if hasattr(store, "restore_chain") else None
+        rev = store.last_revision(self.app.name)
+        if chain is not None:
+            # prefer whichever is NEWER: the incremental chain or a plain
+            # full snapshot written later in the same store
+            from .persistence import _rev_time
+            base, deltas, chain_time = chain
+            plain = [r for r in getattr(store, "revisions")(self.app.name)
+                     if not r.startswith(("F-", "I-"))]
+            if not plain or _rev_time(plain[-1]) < chain_time:
+                self._apply_incremental_blob(pickle.loads(base))
+                for d in deltas:
+                    self._apply_incremental_blob(pickle.loads(d))
+                return
+            rev = plain[-1]
         if rev is not None:
             self.restore_revision(rev)
 

@@ -98,8 +98,9 @@ class StoreQueryExec:
                 env = dict(zip(names, row))
                 for n, v in zip(names, row):
                     env[f"{sid}.{n}"] = v
-                env["__timestamp__"] = int(t._ts[i])
-                out.append((int(t._ts[i]), env))
+                ts_i = t.row_ts(i)
+                env["__timestamp__"] = ts_i
+                out.append((ts_i, env))
             return out
         for ev in self.named_window.contents():
             env = dict(zip(names, ev.data))

@@ -67,6 +67,11 @@ class InMemoryTable:
         self._live = 0               # live row count
         self._pk: dict = {}          # pk value tuple/scalar -> row idx
         self._index: dict[str, dict] = {a: {} for a in self.index_attrs}
+        # incremental-snapshot op-log (reference IndexEventHolder
+        # operationChangeLog): content-addressed ops since the last full
+        # snapshot; beyond ~2.1x the live size a full snapshot is cheaper
+        self._oplog: list = []
+        self._oplog_active = False
 
     # -- geometry ------------------------------------------------------------
 
@@ -130,7 +135,19 @@ class InMemoryTable:
 
     # -- mutation ------------------------------------------------------------
 
+    def _log(self, op) -> None:
+        if self._oplog_active:
+            self._oplog.append(op)
+
     def insert_batch(self, batch) -> None:
+        """Append a batch of rows (logging for incremental snapshots
+        only when active — the payload decode isn't free)."""
+        if self._oplog_active:
+            self._log(("ins", [int(t) for t in batch.timestamps],
+                       batch.rows(self.strings)))
+        self._insert_batch_impl(batch)
+
+    def _insert_batch_impl(self, batch) -> None:
         """Append an EventBatch (same positional types as the table schema).
         Column names may differ; mapping is positional like the reference's
         stream->table event conversion."""
@@ -174,6 +191,11 @@ class InMemoryTable:
                 s.discard(i)
 
     def delete_rows(self, idx) -> int:
+        if self._oplog_active and len(idx):
+            self._log(("del", [self.row_tuple(int(i)) for i in idx]))
+        return self._delete_rows_impl(idx)
+
+    def _delete_rows_impl(self, idx) -> int:
         cnt = 0
         for i in np.atleast_1d(np.asarray(idx, dtype=np.int64)):
             i = int(i)
@@ -186,6 +208,11 @@ class InMemoryTable:
         return cnt
 
     def set_row_value(self, row: int, attr: str, value) -> None:
+        if self._oplog_active:
+            self._log(("set", self.row_tuple(int(row)), attr, value))
+        self._set_row_value_impl(row, attr, value)
+
+    def _set_row_value_impl(self, row: int, attr: str, value) -> None:
         """Write one attribute of a live row, maintaining indexes."""
         t = self.schema.type_of(attr)
         reindex = attr in self.pk_attrs or attr in self.index_attrs
@@ -234,6 +261,9 @@ class InMemoryTable:
                 env[f"{r}.{a.name}"] = v
         return env
 
+    def row_ts(self, row: int) -> int:
+        return int(self._ts[row])
+
     def row_tuple(self, row: int) -> tuple:
         out = []
         for a in self.defn.attributes:
@@ -253,6 +283,49 @@ class InMemoryTable:
 
     # -- snapshot (reference: InMemoryTable implements Snapshotable) ---------
 
+    def incremental_state(self) -> dict:
+        """Op-log delta since the last full/incremental snapshot; switches
+        to a full snapshot past the 2.1x threshold (reference
+        IndexEventHolder.java:74-76).  Starts op-logging on first call."""
+        if not self._oplog_active:
+            self._oplog_active = True
+            self._oplog = []
+            return {"full": self.state_dict()}
+        if len(self._oplog) > max(16, int(2.1 * max(self._live, 1))):
+            self._oplog = []
+            return {"full": self.state_dict()}
+        ops, self._oplog = self._oplog, []
+        return {"ops": ops}
+
+    def apply_incremental(self, delta: dict) -> None:
+        if "full" in delta:
+            self.load_state_dict(delta["full"])
+            return
+        from .batch import BatchBuilder
+        for op in delta["ops"]:
+            if op[0] == "ins":
+                _tag, tss, rows = op
+                bb = BatchBuilder(self.schema, self.strings)
+                for ts, row in zip(tss, rows):
+                    bb.append(ts, row)
+                self._insert_batch_impl(bb.freeze())   # replay: no re-log
+            elif op[0] == "del":
+                for row in op[1]:
+                    i = self._find_content_row(row)
+                    if i is not None:
+                        self._delete_rows_impl(np.asarray([i]))
+            else:
+                _tag, row, attr, value = op
+                i = self._find_content_row(row)
+                if i is not None:
+                    self._set_row_value_impl(int(i), attr, value)
+
+    def _find_content_row(self, row: tuple):
+        for i in self.live_idx():
+            if self.row_tuple(int(i)) == tuple(row):
+                return int(i)
+        return None
+
     def state_dict(self) -> dict:
         keep = self.live_idx()
         return {
@@ -262,6 +335,7 @@ class InMemoryTable:
         }
 
     def load_state_dict(self, st: dict) -> None:
+        self._oplog = []        # a restore resets the delta baseline
         n = len(st["ts"])
         self._cap = max(64, int(2 ** np.ceil(np.log2(max(n, 1) + 1))))
         self._cols = {k: np.zeros(self._cap, dtype=v.dtype)
@@ -356,6 +430,17 @@ def _normalize_key(k):
 
 
 def compile_table_condition(expr: Optional[ast.Expression],
+                            table=None, refs=None, stream_ctx=None,
+                            **_kw):
+    """Dispatch: record-store tables compile to pushdown conditions
+    (reference CollectionExpressionParser vs ExpressionBuilder split)."""
+    if getattr(table, "is_record", False):
+        from .record_table import compile_record_condition
+        return compile_record_condition(expr, table, refs, stream_ctx)
+    return _compile_inmemory_condition(expr, table, refs, stream_ctx)
+
+
+def _compile_inmemory_condition(expr: Optional[ast.Expression],
                             table: InMemoryTable,
                             table_refs: tuple[str, ...],
                             stream_ctx) -> CompiledTableCondition:
@@ -773,8 +858,11 @@ class TableUpdateOrInsertWriter(_ConditionedWriter):
                 self.table.insert_batch(bb.freeze())
 
 
-def make_table_writer(action: ast.OutputStreamAction, table: InMemoryTable,
+def make_table_writer(action: ast.OutputStreamAction, table,
                       out_schema: StreamSchema) -> TableWriter:
+    if getattr(table, "is_record", False):
+        from .record_table import make_record_table_writer
+        return make_record_table_writer(action, table, out_schema)
     if isinstance(action, ast.InsertInto):
         return TableInsertWriter(table, out_schema)
     if isinstance(action, ast.UpdateTable):

@@ -1,0 +1,118 @@
+"""File / incremental / async / periodic persistence (reference:
+managment/PersistenceTestCase + IncrementalPersistenceTestCase,
+IncrementalFileSystemPersistenceStore, AsyncSnapshotPersistor)."""
+import time
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.persistence import (FileSystemPersistenceStore,
+                                         IncrementalFileSystemPersistenceStore)
+
+APP = """
+define stream S (sym string, p double);
+@PrimaryKey('sym')
+define table T (sym string, p double);
+@info(name='ins') from S select sym, p update or insert into T on T.sym == sym;
+@info(name='w') from S#window.length(3) select sym, sum(p) as total
+insert into O;
+"""
+
+
+@pytest.fixture
+def mgr():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def _mk(mgr, store):
+    mgr.set_persistence_store(store)
+    rt = mgr.create_app_runtime(APP)
+    rt.start()
+    return rt
+
+
+def test_file_store_roundtrip(mgr, tmp_path):
+    store = FileSystemPersistenceStore(str(tmp_path))
+    rt = _mk(mgr, store)
+    h = rt.input_handler("S")
+    h.send(("A", 1.0)); h.send(("B", 2.0))
+    rt.flush()
+    rev = rt.persist()
+    assert store.last_revision(rt.app.name) == rev
+    assert (tmp_path / rt.app.name).exists()
+
+    m2 = SiddhiManager()
+    m2.set_persistence_store(FileSystemPersistenceStore(str(tmp_path)))
+    rt2 = m2.create_app_runtime(APP)
+    rt2.restore_last_state()
+    assert sorted(rt2.tables["T"].all_rows()) == [("A", 1.0), ("B", 2.0)]
+    # window state carried over: next events continue the length-3 window
+    out = []
+    rt2.add_callback("O", lambda evs: out.extend(e.data for e in evs))
+    rt2.input_handler("S").send(("C", 4.0))
+    rt2.flush()
+    assert out[-1] == ("C", 7.0)    # 1 + 2 + 4
+    m2.shutdown()
+
+
+def test_incremental_store_chain(mgr, tmp_path):
+    store = IncrementalFileSystemPersistenceStore(str(tmp_path))
+    rt = _mk(mgr, store)
+    h = rt.input_handler("S")
+    h.send(("A", 1.0)); rt.flush()
+    rt.persist(incremental=True)        # F- base
+    h.send(("B", 2.0)); rt.flush()
+    rt.persist(incremental=True)        # I- delta (op-log)
+    h.send(("A", 9.0)); rt.flush()      # update-or-insert -> set op
+    rt.persist(incremental=True)        # I- delta
+    revs = store.revisions(rt.app.name)
+    assert sum(r.startswith("F-") for r in revs) == 1
+    assert sum(r.startswith("I-") for r in revs) == 2
+
+    m2 = SiddhiManager()
+    m2.set_persistence_store(
+        IncrementalFileSystemPersistenceStore(str(tmp_path)))
+    rt2 = m2.create_app_runtime(APP)
+    rt2.restore_last_state()
+    assert sorted(rt2.tables["T"].all_rows()) == [("A", 9.0), ("B", 2.0)]
+    m2.shutdown()
+
+
+def test_incremental_threshold_refull(mgr, tmp_path):
+    store = IncrementalFileSystemPersistenceStore(str(tmp_path))
+    rt = _mk(mgr, store)
+    h = rt.input_handler("S")
+    h.send(("A", 1.0)); rt.flush()
+    rt.persist(incremental=True)
+    # mutate far past 2.1x the live size -> next incremental re-fulls
+    for i in range(200):
+        h.send((f"K{i % 3}", float(i)))
+    rt.flush()
+    rt.persist(incremental=True)
+    revs = store.revisions(rt.app.name)
+    assert sum(r.startswith("F-") for r in revs) == 2
+
+
+def test_async_persist(mgr, tmp_path):
+    store = FileSystemPersistenceStore(str(tmp_path))
+    rt = _mk(mgr, store)
+    rt.input_handler("S").send(("A", 1.0))
+    rt.flush()
+    rev = rt.persist(asynchronous=True)
+    rt.persistor().wait()
+    assert rt.persistor().errors == []
+    assert store.last_revision(rt.app.name) == rev
+
+
+def test_periodic_persistence(mgr, tmp_path):
+    store = FileSystemPersistenceStore(str(tmp_path))
+    rt = _mk(mgr, store)
+    rt.input_handler("S").send(("A", 1.0))
+    rt.flush()
+    handle = rt.persist_every(0.05)
+    time.sleep(0.3)
+    handle.stop()
+    assert len(handle.revisions) >= 2 and handle.errors == []
+    assert store.last_revision(rt.app.name) is not None

@@ -1,0 +1,163 @@
+"""External-store table SPI (reference: AbstractRecordTable +
+ExpressionBuilder pushdown; test double = InMemoryRecordStore, the analog
+of TestStoreContainingInMemoryTable)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.record_table import (InMemoryRecordStore, RecordTable,
+                                          StoreCondition, register_store_type)
+
+
+@pytest.fixture
+def mgr():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+APP = """
+define stream S (sym string, price double);
+@store(type='testStore')
+@PrimaryKey('sym')
+define table T (sym string, price double);
+@info(name='ins') from S[price > 0] select sym, price insert into T;
+"""
+
+
+def _store_of(rt, tid="T"):
+    return rt.tables[tid].store
+
+
+def test_insert_and_store_query(mgr):
+    rt = mgr.create_app_runtime(APP)
+    h = rt.input_handler("S")
+    rt.start()
+    h.send(("IBM", 101.0)); h.send(("WSO2", 55.0))
+    rt.flush()
+    st = _store_of(rt)
+    assert len(st.records) == 2 and st.op_counts["add"] >= 1
+    rows = rt.query("from T on price > 60.0 select sym, price")
+    assert [r for _t, r in rows] == [("IBM", 101.0)]
+
+
+def test_update_delete_update_or_insert(mgr):
+    rt = mgr.create_app_runtime(APP + """
+define stream U (sym string, price double);
+@info(name='upd') from U select sym, price update T on T.sym == sym;
+define stream D (sym string);
+@info(name='del') from D select sym delete T on T.sym == sym;
+define stream UO (sym string, price double);
+@info(name='uoi') from UO select sym, price update or insert into T
+  on T.sym == sym;
+""")
+    rt.start()
+    rt.input_handler("S").send(("IBM", 100.0))
+    rt.input_handler("U").send(("IBM", 200.0))
+    rt.flush()
+    assert rt.tables["T"].all_rows() == [("IBM", 200.0)]
+    rt.input_handler("UO").send(("NEW", 7.0))      # no match -> insert
+    rt.input_handler("UO").send(("IBM", 300.0))    # match -> update
+    rt.flush()
+    assert sorted(rt.tables["T"].all_rows()) == [("IBM", 300.0), ("NEW", 7.0)]
+    rt.input_handler("D").send(("IBM",))
+    rt.flush()
+    assert rt.tables["T"].all_rows() == [("NEW", 7.0)]
+
+
+def test_join_against_record_table(mgr):
+    rt = mgr.create_app_runtime(APP + """
+define stream Probe (sym string);
+@info(name='j') from Probe join T on T.sym == Probe.sym
+select Probe.sym as sym, T.price as price insert into O;
+""")
+    out = []
+    rt.add_callback("O", lambda evs: out.extend(e.data for e in evs))
+    rt.start()
+    rt.input_handler("S").send(("IBM", 42.0))
+    rt.flush()
+    rt.input_handler("Probe").send(("IBM",))
+    rt.input_handler("Probe").send(("MISS",))
+    rt.flush()
+    assert out == [("IBM", 42.0)]
+
+
+def test_in_table_membership(mgr):
+    rt = mgr.create_app_runtime(APP + """
+define stream C (sym string, x int);
+@info(name='m') from C[sym in T] select sym, x insert into O;
+""")
+    out = []
+    rt.add_callback("O", lambda evs: out.extend(e.data for e in evs))
+    rt.start()
+    rt.input_handler("S").send(("IBM", 1.0))
+    rt.flush()
+    rt.input_handler("C").send(("IBM", 1))
+    rt.input_handler("C").send(("NOPE", 2))
+    rt.flush()
+    assert out == [("IBM", 1)]
+
+
+def test_condition_pushdown_shape(mgr):
+    """The store receives a compiled tree with lifted stream params —
+    not row-by-row engine probes."""
+    seen = []
+
+    class SpyStore(InMemoryRecordStore):
+        def find(self, condition, params):
+            seen.append((condition.node, dict(params)))
+            return super().find(condition, params)
+
+    register_store_type("spyStore", SpyStore)
+    rt = mgr.create_app_runtime("""
+define stream S (sym string, price double);
+@store(type='spyStore')
+define table T (sym string, price double);
+define stream P (sym string, lo double);
+@info(name='q') from P join T on T.sym == P.sym and T.price > lo + 1.0
+select P.sym as sym, T.price as price insert into O;
+""")
+    out = []
+    rt.add_callback("O", lambda evs: out.extend(e.data for e in evs))
+    rt.start()
+    rt.tables["T"].store.add([{"sym": "A", "price": 10.0},
+                              {"sym": "A", "price": 3.0}])
+    rt.input_handler("P").send(("A", 5.0))
+    rt.flush()
+    assert out == [("A", 10.0)]
+    node, params = seen[-1]
+    assert node[0] == "and"
+    assert ("col", "sym") in (node[1][2], node[1][3])
+    assert any(isinstance(v, float) and v == 6.0 for v in params.values())
+
+
+def test_snapshot_restore_record_table(mgr):
+    rt = mgr.create_app_runtime(APP)
+    rt.start()
+    rt.input_handler("S").send(("IBM", 9.0))
+    rt.flush()
+    snap = rt.snapshot()
+    rt2 = mgr.create_app_runtime(APP)
+    rt2.restore(snap)
+    assert rt2.tables["T"].all_rows() == [("IBM", 9.0)]
+
+
+def test_connect_retry_and_unknown_type(mgr):
+    calls = []
+
+    class Flaky(InMemoryRecordStore):
+        def connect(self):
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionError("transient")
+
+    register_store_type("flakyStore", Flaky)
+    with pytest.warns(RuntimeWarning):
+        rt = mgr.create_app_runtime("""
+@store(type='flakyStore')
+define table T (x int);
+""")
+    assert len(calls) == 3 and rt.tables["T"].store.connected
+
+    from siddhi_tpu.core.planner import PlanError
+    with pytest.raises(PlanError, match="unknown store type"):
+        mgr.create_app_runtime("@store(type='nosuch')\ndefine table X (x int);")
