@@ -1,0 +1,27 @@
+"""Quickstart: filter + projection (reference:
+siddhi-samples/quick-start-samples/.../SimpleFilterSample.java).
+
+    python samples/simple_filter.py
+"""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from siddhi_tpu import SiddhiManager
+
+APP = """
+define stream StockStream (symbol string, price double, volume int);
+@info(name='filterQuery')
+from StockStream[price > 100] select symbol, price insert into OutStream;
+"""
+
+mgr = SiddhiManager()
+rt = mgr.create_app_runtime(APP)
+rt.add_callback("OutStream",
+                lambda evs: [print("match:", e.data) for e in evs])
+rt.start()
+h = rt.input_handler("StockStream")
+h.send(("WSO2", 151.25, 100))
+h.send(("ACME", 32.5, 20))
+h.send(("IBM", 120.0, 5))
+rt.flush()
+mgr.shutdown()

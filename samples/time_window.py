@@ -1,0 +1,28 @@
+"""Quickstart: sliding time window aggregation (reference:
+quick-start-samples/.../TimeWindowSample.java) under the virtual clock.
+
+    python samples/time_window.py
+"""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from siddhi_tpu import SiddhiManager
+
+APP = """
+@app:playback
+define stream Temps (room string, temp double);
+@info(name='avgQuery')
+from Temps#window.time(10 sec) select room, avg(temp) as avgTemp
+group by room insert into Out;
+"""
+
+mgr = SiddhiManager()
+rt = mgr.create_app_runtime(APP)
+rt.add_callback("Out", lambda evs: [print("avg:", e.data) for e in evs])
+rt.start()
+h = rt.input_handler("Temps")
+h.send(("r1", 20.0), timestamp=1_000)
+h.send(("r1", 24.0), timestamp=5_000)
+h.send(("r1", 28.0), timestamp=12_000)   # the 20.0 reading has expired
+rt.flush()
+mgr.shutdown()

@@ -1,0 +1,44 @@
+"""Config provider SPI (reference: core:util/config/ConfigManager.java:33,
+ConfigReader, InMemoryConfigManager): system-level settings for
+extensions, resolved per (namespace, name) — the third config tier next
+to SiddhiQL annotations and programmatic setters (SURVEY §5 config).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ConfigReader:
+    """Per-extension view of the system configuration."""
+
+    def __init__(self, configs: dict):
+        self._configs = dict(configs)
+
+    def read(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        return self._configs.get(key, default)
+
+    def all(self) -> dict:
+        return dict(self._configs)
+
+
+class ConfigManager:
+    """SPI: yields a ConfigReader for one extension instance."""
+
+    def generate_config_reader(self, namespace: str, name: str) -> ConfigReader:
+        return ConfigReader({})
+
+
+class InMemoryConfigManager(ConfigManager):
+    """Keys are '<namespace>.<name>.<key>' (reference
+    InMemoryConfigManager semantics); bare '<key>' entries apply to every
+    extension."""
+
+    def __init__(self, configs: Optional[dict] = None):
+        self._configs = dict(configs or {})
+
+    def generate_config_reader(self, namespace: str, name: str) -> ConfigReader:
+        prefix = f"{namespace}.{name}."
+        out = {k: v for k, v in self._configs.items() if "." not in k}
+        out.update({k[len(prefix):]: v for k, v in self._configs.items()
+                    if k.startswith(prefix)})
+        return ConfigReader(out)

@@ -260,6 +260,64 @@ class Sink:
             self.publish(payload)
 
 
+class DistributedSink(Sink):
+    """Multi-destination fan-out (reference: DistributedTransport +
+    Broadcast/RoundRobin/Partitioned DistributionStrategy,
+    core:stream/output/sink/distributed/DistributionStrategy.java:107,
+    MultiClientDistributedSink): one child sink per @destination, the
+    strategy picks destinations per event."""
+
+    def __init__(self, rt, stream_id, options, mapper, children,
+                 strategy: str, partition_key=None, schema=None):
+        super().__init__(rt, stream_id, options, mapper)
+        self.children = children
+        self.strategy = strategy
+        self._rr = 0
+        self._key_idx = None
+        if strategy == "partitioned":
+            if partition_key is None:
+                raise PlanError(
+                    f"sink on {stream_id!r}: partitioned distribution "
+                    f"needs partitionKey")
+            if partition_key not in schema.index_of:
+                raise PlanError(
+                    f"sink on {stream_id!r}: partitionKey "
+                    f"{partition_key!r} not in schema {schema.names}")
+            self._key_idx = schema.index_of[partition_key]
+
+    def connect(self) -> None:
+        for c in self.children:
+            c.connect()
+            c.connected = True
+
+    def disconnect(self) -> None:
+        for c in self.children:
+            if c.connected:
+                c.disconnect()
+                c.connected = False
+
+    def on_events(self, events: list) -> None:
+        n = len(self.children)
+        if self.strategy == "broadcast":
+            for c in self.children:
+                c.on_events(events)
+            return
+        buckets = [[] for _ in range(n)]
+        for ev in events:
+            if self.strategy == "roundrobin":
+                i = self._rr
+                self._rr = (self._rr + 1) % n
+            else:
+                # stable across processes (builtin hash() is salted for
+                # strings): same key -> same destination, always
+                import zlib
+                i = zlib.crc32(repr(ev.data[self._key_idx]).encode()) % n
+            buckets[i].append(ev)
+        for c, evs in zip(self.children, buckets):
+            if evs:
+                c.on_events(evs)
+
+
 class InMemorySink(Sink):
     def connect(self) -> None:
         if not self.options.get("topic"):
@@ -316,7 +374,9 @@ def build_io(rt) -> None:
                                     f"{sid!r}; have {sorted(SOURCE_TYPES)}")
                 mapper = _mapper_of(a, rt.schemas[sid], SOURCE_MAPPERS,
                                     PassThroughSourceMapper)
-                rt.sources.append(cls(rt, sid, opts, mapper))
+                src = cls(rt, sid, opts, mapper)
+                src.config = rt.config_reader("source", typ)
+                rt.sources.append(src)
             elif nm == "sink":
                 opts = _ann_options(a)
                 typ = opts.get("type", "").lower()
@@ -326,7 +386,35 @@ def build_io(rt) -> None:
                                     f"{sid!r}; have {sorted(SINK_TYPES)}")
                 mapper = _mapper_of(a, rt.schemas[sid], SINK_MAPPERS,
                                     PassThroughSinkMapper)
-                sink = cls(rt, sid, opts, mapper)
+                from ..query.ast import find_annotation
+                dist = find_annotation(a.annotations, "distribution")
+                if dist is not None:
+                    # keyed elements only (the lone-positional fallback of
+                    # Annotation.element would alias strategy/partitionKey)
+                    def _kv(ann, key, default=None):
+                        return next((v for k, v in ann.elements if k == key),
+                                    default)
+                    strategy = (_kv(dist, "strategy") or "roundRobin").lower()
+                    if strategy not in ("broadcast", "roundrobin",
+                                        "partitioned"):
+                        raise PlanError(f"sink on {sid!r}: unknown "
+                                        f"distribution strategy {strategy!r}")
+                    dests = [d for d in dist.annotations
+                             if d.name == "destination"]
+                    if not dests:
+                        raise PlanError(f"sink on {sid!r}: @distribution "
+                                        f"needs @destination entries")
+                    children = []
+                    for d in dests:
+                        child_opts = dict(opts)
+                        child_opts.update(_ann_options(d))
+                        children.append(cls(rt, sid, child_opts, mapper))
+                    sink = DistributedSink(
+                        rt, sid, opts, mapper, children, strategy,
+                        _kv(dist, "partitionKey"), rt.schemas[sid])
+                else:
+                    sink = cls(rt, sid, opts, mapper)
+                sink.config = rt.config_reader("sink", typ)
                 rt.sinks.append(sink)
                 # stage into the runtime's outbox instead of publishing
                 # under the runtime lock (cross-runtime ABBA deadlock —

@@ -118,9 +118,14 @@ class AsyncSnapshotPersistor:
         return t
 
     def wait(self, timeout: float = 10.0) -> None:
+        """Join outstanding writes; raises TimeoutError if any is still
+        in flight (a caller must not conclude durability on a timeout)."""
         for t in self._threads:
             t.join(timeout)
         self._threads = [t for t in self._threads if t.is_alive()]
+        if self._threads:
+            raise TimeoutError(
+                f"{len(self._threads)} snapshot write(s) still in flight")
 
 
 class PeriodicPersistence:

@@ -54,6 +54,19 @@ class InputHandler:
         self._rt.send(self.stream_id, data, timestamp)
 
 
+def _parse_interval_s(text: str) -> float:
+    """'5 sec' / '500 ms' / bare seconds -> float seconds (unit table
+    shared with the SiddhiQL time-constant lexer)."""
+    from ..query.parser import _TIME_UNITS_MS
+    parts = str(text).strip().split()
+    if len(parts) == 1:
+        return float(parts[0])
+    unit = parts[1].lower()
+    if unit not in _TIME_UNITS_MS:
+        raise PlanError(f"unknown time unit {parts[1]!r} in interval {text!r}")
+    return float(parts[0]) * _TIME_UNITS_MS[unit] / 1000.0
+
+
 class SiddhiAppRuntime:
     def __init__(self, app: qast.SiddhiApp, manager: Optional["SiddhiManager"] = None):
         self.app = app
@@ -155,6 +168,13 @@ class SiddhiAppRuntime:
         sa = qast.find_annotation(app.annotations, "app:statistics")
         if sa is not None and (sa.element() or "true").lower() != "false":
             self.stats.enabled = True
+            # keyed elements only: the lone-positional fallback would turn
+            # @app:statistics('true') into interval='true'
+            rep = next((v for k, v in sa.elements if k == "reporter"), None)
+            iv = next((v for k, v in sa.elements if k == "interval"), None)
+            if rep is not None or iv is not None:
+                iv_s = _parse_interval_s(iv) if iv is not None else 5.0
+                self.stats.configure(rep or "console", iv_s)
         self._debugger = None
 
         self._build()
@@ -233,6 +253,8 @@ class SiddhiAppRuntime:
                     for ob in p.fire_start(now):
                         self._emit(p, ob)
             self._drain()
+        if self.stats.enabled and self.stats.reporter is not None:
+            self.stats.start_reporting()
         if self._async and self._ingest_thread is None:
             self._start_ingest_worker()
         for s in self.sources:
@@ -322,6 +344,16 @@ class SiddhiAppRuntime:
             self.flush()
             return exec_.execute()
 
+    def config_reader(self, namespace: str, name: str):
+        """ConfigReader for one extension instance (reference:
+        ConfigManager.generateConfigReader)."""
+        from .config import ConfigManager, ConfigReader
+        cm = getattr(self.manager, "config_manager", None) if self.manager \
+            else None
+        if cm is None:
+            return ConfigReader({})
+        return cm.generate_config_reader(namespace, name)
+
     def sources_for(self, stream_id: str) -> list:
         return [s for s in self.sources if s.stream_id == stream_id]
 
@@ -357,6 +389,7 @@ class SiddhiAppRuntime:
             self._sched_thread.join(timeout=2)
             self._sched_thread = None
             self._sched_stop = None
+        self.stats.stop_reporting()
         self.flush()
         self._started = False
 
@@ -727,7 +760,8 @@ class SiddhiAppRuntime:
                                           self.app.name, rev, blob, is_full)
             else:
                 store.save_incremental(self.app.name, rev, blob, is_full)
-            return rev
+            # the store prefixes full/delta revisions; return the LOADABLE id
+            return ("F-" if is_full else "I-") + rev
         blob = pickle.dumps(self.snapshot())
         if asynchronous:
             self.persistor().persist(store.save, self.app.name, rev, blob)
@@ -760,7 +794,11 @@ class SiddhiAppRuntime:
     def restore_revision(self, rev: str) -> None:
         import pickle
         data = self.manager.persistence_store.load(self.app.name, rev)
-        self.restore(pickle.loads(data))
+        body = pickle.loads(data)
+        if isinstance(body, dict) and "table_deltas" in body:
+            self._apply_incremental_blob(body)   # incremental-format revision
+        else:
+            self.restore(body)
 
     def restore_last_state(self) -> None:
         import pickle
@@ -809,6 +847,7 @@ class SiddhiManager:
 
     def __init__(self):
         self.persistence_store = None
+        self.config_manager = None      # ConfigManager SPI (core/config.py)
         self._runtimes: dict = {}
 
     def create_app_runtime(self, app: Union[str, qast.SiddhiApp]) -> SiddhiAppRuntime:
@@ -822,6 +861,9 @@ class SiddhiManager:
 
     def set_persistence_store(self, store) -> None:
         self.persistence_store = store
+
+    def set_config_manager(self, cm) -> None:
+        self.config_manager = cm
 
     def persist(self) -> None:
         for rt in self._runtimes.values():

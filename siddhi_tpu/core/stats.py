@@ -36,8 +36,34 @@ class Tracker:
         return d
 
 
+REPORTERS: dict = {}
+
+
+def register_stats_reporter(name: str, fn) -> None:
+    """fn(app_name, report_dict) — the reporter SPI (reference:
+    SiddhiStatisticsManager.java:35-85 console/JMX reporters)."""
+    REPORTERS[name.lower()] = fn
+
+
+def _console_reporter(app: str, report: dict) -> None:
+    import json as _json
+    print(f"[siddhi-stats] {app}: {_json.dumps(report, default=str)}")
+
+
+def _log_reporter(app: str, report: dict) -> None:
+    import logging
+    logging.getLogger("siddhi_tpu.stats").info("%s: %s", app, report)
+
+
+REPORTERS["console"] = _console_reporter
+REPORTERS["log"] = _log_reporter
+
+
 class StatisticsManager:
-    """Per-stream throughput + per-query latency (+ state memory sizing)."""
+    """Per-stream throughput + per-query latency (+ state memory sizing).
+    `@app:statistics(reporter='console', interval='5 sec')` starts a
+    periodic reporter thread (reference: @app:statistics reporter/interval,
+    SiddhiAppParser.java:108-144)."""
 
     def __init__(self, rt):
         self.rt = rt
@@ -45,6 +71,41 @@ class StatisticsManager:
         self.stream_in: dict = defaultdict(Tracker)
         self.query: dict = defaultdict(Tracker)
         self._t0 = time.perf_counter()
+        self.reporter = None
+        self.interval_s: float = 5.0
+        self._rep_thread = None
+        self._rep_stop = None
+
+    def configure(self, reporter: str, interval_s: float) -> None:
+        fn = REPORTERS.get((reporter or "console").lower())
+        if fn is None:
+            raise ValueError(f"unknown statistics reporter {reporter!r}; "
+                             f"have {sorted(REPORTERS)}")
+        self.reporter = fn
+        self.interval_s = interval_s
+
+    def start_reporting(self) -> None:
+        import threading
+        if self.reporter is None or self._rep_thread is not None:
+            return
+        self._rep_stop = threading.Event()
+
+        def pump():
+            while not self._rep_stop.wait(self.interval_s):
+                try:
+                    self.reporter(self.rt.app.name, self.report())
+                except Exception:
+                    pass
+        self._rep_thread = threading.Thread(
+            target=pump, name="siddhi-stats-report", daemon=True)
+        self._rep_thread.start()
+
+    def stop_reporting(self) -> None:
+        if self._rep_stop is not None:
+            self._rep_stop.set()
+            self._rep_thread.join(timeout=2)
+            self._rep_thread = None
+            self._rep_stop = None
 
     def on_stream_batch(self, sid: str, n: int) -> None:
         t = self.stream_in[sid]

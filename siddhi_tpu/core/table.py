@@ -335,7 +335,11 @@ class InMemoryTable:
         }
 
     def load_state_dict(self, st: dict) -> None:
-        self._oplog = []        # a restore resets the delta baseline
+        # a restore invalidates the delta baseline: drop the log AND force
+        # the next incremental snapshot to emit a full (ops relative to the
+        # restored state would replay against the wrong on-disk base)
+        self._oplog = []
+        self._oplog_active = False
         n = len(st["ts"])
         self._cap = max(64, int(2 ** np.ceil(np.log2(max(n, 1) + 1))))
         self._cols = {k: np.zeros(self._cap, dtype=v.dtype)

@@ -1,0 +1,84 @@
+"""Extension documentation generator.
+
+Reference: modules/siddhi-doc-gen (Maven mojo generating mkdocs pages
+from @Extension metadata, MarkdownDocumentationGenerationMojo).  Here the
+extension surface IS the registries, so the docs are generated from them
+directly — every registered window type, aggregator, scalar/stream
+function, source/sink/mapper, store type, and statistics reporter.
+
+Run:  python -m siddhi_tpu.docgen [out.md]
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Optional
+
+
+def _rows(registry: dict, describe=None) -> list:
+    out = []
+    for key in sorted(registry, key=str):
+        obj = registry[key]
+        name = key if isinstance(key, str) else \
+            (f"{key[0]}:{key[1]}" if key[0] else key[1])
+        doc = ""
+        if describe is not None:
+            doc = describe(obj)
+        elif inspect.isclass(obj) or inspect.isfunction(obj):
+            doc = (inspect.getdoc(obj) or "").split("\n")[0]
+        out.append((name, doc))
+    return out
+
+
+def generate_markdown() -> str:
+    """One markdown document covering every extension point."""
+    from .core.expr import SCALAR_FUNCTIONS
+    from .core.io import SINK_MAPPERS, SINK_TYPES, SOURCE_MAPPERS, SOURCE_TYPES
+    from .core.record_table import STORE_TYPES
+    from .core.stats import REPORTERS
+    from .interp.expr import PY_FUNCTIONS
+    from .interp.engine import STREAM_FUNCTIONS, WINDOW_TYPES
+    from .interp.aggregators import AGGREGATOR_CLASSES
+
+    sections = [
+        ("Custom window types (`#window.<name>(...)`; 15 built-ins are "
+         "compiled directly)", WINDOW_TYPES, None),
+        ("Aggregators (selector functions)", AGGREGATOR_CLASSES, None),
+        ("Scalar functions (device expression compiler)", SCALAR_FUNCTIONS,
+         None),
+        ("Scalar functions (host interpreter)", PY_FUNCTIONS, None),
+        ("Stream functions (`#<ns>:<name>(...)`)", STREAM_FUNCTIONS, None),
+        ("Source types (`@source(type=...)`)", SOURCE_TYPES, None),
+        ("Sink types (`@sink(type=...)`)", SINK_TYPES, None),
+        ("Source mappers (`@map(type=...)`)", SOURCE_MAPPERS, None),
+        ("Sink mappers (`@map(type=...)`)", SINK_MAPPERS, None),
+        ("Store types (`@store(type=...)`)", STORE_TYPES, None),
+        ("Statistics reporters (`@app:statistics(reporter=...)`)",
+         REPORTERS, None),
+    ]
+    lines = ["# siddhi-tpu extension reference", "",
+             "Generated from the live extension registries "
+             "(`python -m siddhi_tpu.docgen`).", ""]
+    for title, registry, describe in sections:
+        lines.append(f"## {title}")
+        lines.append("")
+        lines.append("| name | description |")
+        lines.append("|---|---|")
+        for name, doc in _rows(registry, describe):
+            lines.append(f"| `{name}` | {doc.replace('|', '/')} |")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main(out: Optional[str] = None) -> None:
+    md = generate_markdown()
+    if out:
+        with open(out, "w") as f:
+            f.write(md)
+        print(f"wrote {out} ({len(md.splitlines())} lines)")
+    else:
+        print(md)
+
+
+if __name__ == "__main__":
+    import sys
+    main(sys.argv[1] if len(sys.argv) > 1 else None)

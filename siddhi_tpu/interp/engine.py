@@ -334,16 +334,85 @@ class SnapshotRateLimiter(RateLimiter):
         self.window_start = st["ws"]
 
 
-def make_rate_limiter(rate) -> Optional[RateLimiter]:
+class GroupedRateLimiter(RateLimiter):
+    """Per-group first/last rate limiting (reference: the GroupByPer*
+    OutputRateLimiter family, e.g. core:query/output/ratelimit/event/
+    GroupByPerEventOutputRateLimiter.java): one child limiter per group
+    key, keyed by the selected group-by columns."""
+
+    def __init__(self, factory: Callable, key_idx: list):
+        self.factory = factory
+        self.key_idx = key_idx
+        self.subs: dict = {}
+        self.needs_timer = factory().needs_timer
+
+    def _sub(self, row):
+        key = tuple(row[i] for i in self.key_idx)
+        sub = self.subs.get(key)
+        if sub is None:
+            sub = self.subs[key] = self.factory()
+        return sub
+
+    def feed(self, kind, ts, row):
+        return self._sub(row).feed(kind, ts, row)
+
+    def on_timer(self, now_ms):
+        out = []
+        for sub in self.subs.values():
+            out.extend(sub.on_timer(now_ms))
+        return out
+
+    def next_wakeup(self):
+        ws = [w for s in self.subs.values()
+              for w in [s.next_wakeup()] if w is not None]
+        return min(ws) if ws else None
+
+    def state(self):
+        return {"groups": [(k, s.state()) for k, s in self.subs.items()]}
+
+    def restore(self, st):
+        self.subs = {}
+        for k, sub_st in st["groups"]:
+            sub = self.factory()
+            sub.restore(sub_st)
+            self.subs[tuple(k)] = sub
+
+
+def _group_key_positions(selector) -> Optional[list]:
+    """Output-row positions of the group-by attributes (None when the
+    selection doesn't carry them — falls back to a global limiter)."""
+    if selector is None or not selector.group_by or selector.select_all:
+        return None
+    pos = []
+    for g in selector.group_by:
+        for i, oa in enumerate(selector.attributes):
+            e = oa.expr
+            if isinstance(e, ast.Variable) and e.attribute == g.attribute \
+                    and e.index is None:
+                pos.append(i)
+                break
+        else:
+            return None
+    return pos
+
+
+def make_rate_limiter(rate, selector=None) -> Optional[RateLimiter]:
     if rate is None:
         return None
     if isinstance(rate, ast.EventOutputRate):
-        return EventRateLimiter(rate.count, rate.type)
-    if isinstance(rate, ast.TimeOutputRate):
-        return TimeRateLimiter(rate.millis, rate.type)
-    if isinstance(rate, ast.SnapshotOutputRate):
+        factory = lambda: EventRateLimiter(rate.count, rate.type)
+    elif isinstance(rate, ast.TimeOutputRate):
+        factory = lambda: TimeRateLimiter(rate.millis, rate.type)
+    elif isinstance(rate, ast.SnapshotOutputRate):
         return SnapshotRateLimiter(rate.millis)
-    raise PlanError(f"unknown output rate {rate}")
+    else:
+        raise PlanError(f"unknown output rate {rate}")
+    # per-group first/last (reference GroupByPer* limiter family)
+    if rate.type in (ast.RateType.FIRST, ast.RateType.LAST):
+        pos = _group_key_positions(selector)
+        if pos is not None:
+            return GroupedRateLimiter(factory, pos)
+    return factory()
 
 
 # ---------------------------------------------------------------------------
@@ -551,7 +620,7 @@ class InterpSingleQueryPlan(QueryPlan):
         self.sel = InterpSelector(q.selector, sctx, work_schema,
                                   target or f"#{name}")
         self.out_schema = self.sel.out_schema
-        self.rate = make_rate_limiter(q.rate)
+        self.rate = make_rate_limiter(q.rate, q.selector)
         self._names = work_schema.names
         self._in_names = schema.names
 
@@ -735,7 +804,7 @@ class InterpPatternQueryPlan(QueryPlan):
         ctx = PyExprContext(schemas, tables=rt.tables)
         self.sel = InterpSelector(sel_ast, ctx, None, target or f"#{name}")
         self.out_schema = self.sel.out_schema
-        self.rate = make_rate_limiter(q.rate)
+        self.rate = make_rate_limiter(q.rate, q.selector)
         self._buffer: list = []      # (seq, stream_id, Event)
 
     # -- QueryPlan interface -------------------------------------------------

@@ -215,6 +215,17 @@ def _compile_fn(expr: ast.FunctionCall, ctx) -> tuple[PyFn, AttrType]:
                       AttrType.LONG: _to_int, AttrType.FLOAT: _to_float,
                       AttrType.DOUBLE: _to_float, AttrType.BOOL: _to_bool}[t]
             return (lambda env: caster(f(env))), t
+        if name == "createset":
+            # reference: core:executor/function/CreateSetFunctionExecutor
+            f, _ft = compile_py(expr.args[0], ctx)
+            def cs(env):
+                v = f(env)
+                return set() if v is None else {v}
+            return cs, AttrType.OBJECT
+        if name == "sizeofset":
+            # reference: core:executor/function/SizeOfSetFunctionExecutor
+            f, _ft = compile_py(expr.args[0], ctx)
+            return (lambda env: len(f(env) or ())), AttrType.INT
         if name == "uuid":
             return (lambda env: str(uuid.uuid4())), AttrType.STRING
         if name == "currenttimemillis":

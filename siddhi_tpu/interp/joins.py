@@ -259,7 +259,7 @@ class InterpJoinQueryPlan(QueryPlan):
         self.sel = InterpSelector(_join_selector(q.selector, self), ctx,
                                   None, target or f"#{name}")
         self.out_schema = self.sel.out_schema
-        self.rate = make_rate_limiter(q.rate)
+        self.rate = make_rate_limiter(q.rate, q.selector)
         self.input_streams = tuple(
             {s.stream_id for s in (self.left, self.right)
              if not getattr(s, "is_table", False)})

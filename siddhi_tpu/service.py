@@ -1,0 +1,147 @@
+"""REST deployment service — run the engine as a server.
+
+Reference: modules/siddhi-service (JAX-RS/MSF4J microservice,
+`POST /siddhi/artifact/deploy`, `GET /siddhi/artifact/undeploy`,
+src/gen/.../api/SiddhiApi.java:31-63).
+
+Endpoints (JSON unless noted):
+  POST /siddhi/artifact/deploy      body = SiddhiQL app text (plain)
+  GET  /siddhi/artifact/undeploy?siddhiApp=<name>
+  GET  /siddhi/artifact/apps
+  POST /siddhi/artifact/event       {"app": ..., "stream": ..., "data": [...],
+                                     "timestamp": optional ms}
+  POST /siddhi/artifact/query       {"app": ..., "query": "from T select ..."}
+  GET  /siddhi/artifact/stats?siddhiApp=<name>
+
+Run:  python -m siddhi_tpu.service [port]     (or SiddhiService(port).start())
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from . import SiddhiManager
+
+
+class SiddhiService:
+    def __init__(self, port: int = 0, manager: Optional[SiddhiManager] = None):
+        self.manager = manager or SiddhiManager()
+        self.runtimes: dict = {}
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):           # quiet
+                pass
+
+            def _reply(self, code: int, body: dict) -> None:
+                blob = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def _body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", 0))
+                return self.rfile.read(n)
+
+            def do_POST(self):
+                path = urlparse(self.path).path
+                try:
+                    if path == "/siddhi/artifact/deploy":
+                        name = service.deploy(self._body().decode())
+                        self._reply(200, {"status": "deployed", "app": name})
+                    elif path == "/siddhi/artifact/event":
+                        req = json.loads(self._body())
+                        service.send_event(req["app"], req["stream"],
+                                           tuple(req["data"]),
+                                           req.get("timestamp"))
+                        self._reply(200, {"status": "ok"})
+                    elif path == "/siddhi/artifact/query":
+                        req = json.loads(self._body())
+                        rows = service.store_query(req["app"], req["query"])
+                        self._reply(200, {"rows": rows})
+                    else:
+                        self._reply(404, {"error": f"no route {path}"})
+                except Exception as e:
+                    self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+
+            def do_GET(self):
+                u = urlparse(self.path)
+                q = parse_qs(u.query)
+                try:
+                    if u.path == "/siddhi/artifact/undeploy":
+                        app = q.get("siddhiApp", [None])[0]
+                        service.undeploy(app)
+                        self._reply(200, {"status": "undeployed", "app": app})
+                    elif u.path == "/siddhi/artifact/apps":
+                        self._reply(200, {"apps": sorted(service.runtimes)})
+                    elif u.path == "/siddhi/artifact/stats":
+                        app = q.get("siddhiApp", [None])[0]
+                        self._reply(200, service.stats(app))
+                    else:
+                        self._reply(404, {"error": f"no route {u.path}"})
+                except Exception as e:
+                    self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- operations -------------------------------------------------------
+
+    def deploy(self, app_text: str) -> str:
+        rt = self.manager.create_app_runtime(app_text)
+        name = rt.app.name
+        old = self.runtimes.pop(name, None)
+        if old is not None:
+            old.shutdown()
+        rt.start()
+        self.runtimes[name] = rt
+        return name
+
+    def undeploy(self, name: str) -> None:
+        rt = self.runtimes.pop(name)
+        rt.shutdown()
+
+    def send_event(self, app: str, stream: str, data: tuple,
+                   timestamp=None) -> None:
+        rt = self.runtimes[app]
+        rt.send(stream, data, timestamp)
+        rt.flush()
+
+    def store_query(self, app: str, text: str) -> list:
+        return [[ts, list(row)] for ts, row in self.runtimes[app].query(text)]
+
+    def stats(self, app: str) -> dict:
+        return self.runtimes[app].stats.report()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "SiddhiService":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name="siddhi-service", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        for rt in list(self.runtimes.values()):
+            rt.shutdown()
+        self.runtimes.clear()
+
+
+if __name__ == "__main__":
+    import sys
+    port = int(sys.argv[1]) if len(sys.argv) > 1 else 8006
+    svc = SiddhiService(port).start()
+    print(f"siddhi-tpu service on http://127.0.0.1:{svc.port}")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        svc.stop()

@@ -1,18 +1,38 @@
 """Test config: force CPU backend with 8 virtual devices so sharding tests
 exercise a multi-chip mesh without TPU hardware (bench.py uses the real chip).
 
+TPU lane: `SIDDHI_TEST_TPU=1 python -m pytest tests/ -q` keeps the real
+chip instead, running the whole suite against device numerics (f64
+emulation, scatter mode="drop", tunnel transfer behavior).  Mesh tests
+that need 8 devices skip themselves on a 1-chip host.
+
 Note: the environment's sitecustomize imports jax with the TPU platform
 pinned before conftest runs, so env vars alone don't stick — we must also
 update jax.config (safe: no backend computation has run yet)."""
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+TPU_LANE = bool(os.environ.get("SIDDHI_TEST_TPU"))
 
-import jax
-jax.config.update("jax_platforms", "cpu")
+if not TPU_LANE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+else:
+    import jax
+    import pytest
+
+    def pytest_collection_modifyitems(config, items):
+        if len(jax.devices()) >= 8:
+            return
+        skip = pytest.mark.skip(reason="TPU lane: needs an 8-device mesh")
+        for item in items:
+            if "test_mesh_async" in str(item.fspath):
+                item.add_marker(skip)
 
 import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,64 @@
+"""REST deployment service (reference: modules/siddhi-service,
+SiddhiApi.java:31-63 deploy/undeploy surface)."""
+import json
+import urllib.request
+
+import pytest
+
+from siddhi_tpu.service import SiddhiService
+
+APP = """
+@app:name('RestApp')
+define stream S (sym string, p double);
+@PrimaryKey('sym')
+define table T (sym string, p double);
+@info(name='q') from S[p > 10] select sym, p update or insert into T
+  on T.sym == sym;
+"""
+
+
+@pytest.fixture
+def svc():
+    s = SiddhiService(port=0).start()
+    yield s
+    s.stop()
+
+
+def _post(svc, path, body, raw=False):
+    data = body.encode() if raw else json.dumps(body).encode()
+    req = urllib.request.Request(f"http://127.0.0.1:{svc.port}{path}",
+                                 data=data, method="POST")
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def _get(svc, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{svc.port}{path}") as r:
+        return json.loads(r.read())
+
+
+def test_deploy_event_query_undeploy(svc):
+    r = _post(svc, "/siddhi/artifact/deploy", APP, raw=True)
+    assert r == {"status": "deployed", "app": "RestApp"}
+    assert _get(svc, "/siddhi/artifact/apps")["apps"] == ["RestApp"]
+
+    _post(svc, "/siddhi/artifact/event",
+          {"app": "RestApp", "stream": "S", "data": ["IBM", 42.0]})
+    _post(svc, "/siddhi/artifact/event",
+          {"app": "RestApp", "stream": "S", "data": ["ACME", 5.0]})
+    rows = _post(svc, "/siddhi/artifact/query",
+                 {"app": "RestApp", "query": "from T select sym, p"})["rows"]
+    assert [r[1] for r in rows] == [["IBM", 42.0]]
+
+    stats = _get(svc, "/siddhi/artifact/stats?siddhiApp=RestApp")
+    assert "streams" in stats
+
+    r = _get(svc, "/siddhi/artifact/undeploy?siddhiApp=RestApp")
+    assert r["status"] == "undeployed"
+    assert _get(svc, "/siddhi/artifact/apps")["apps"] == []
+
+
+def test_bad_app_is_a_400(svc):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(svc, "/siddhi/artifact/deploy", "define nonsense;", raw=True)
+    assert e.value.code == 400
