@@ -56,7 +56,36 @@ def build_app(rt) -> None:
         rt.aggregations[aid] = agg
         rt._register_plan(agg)
 
+    # multi-query device batching pre-pass: >= MIN_GROUP structurally
+    # identical pattern queries fuse into ONE batched kernel whose lanes
+    # are the query instances (BASELINE config 5's "1k concurrent queries")
+    fused: dict = {}
+    if getattr(rt, "device_patterns", "auto") != "never":
+        from .multi_query import MIN_GROUP, query_signature
+        groups: dict = {}
+        for i, elem in enumerate(app.execution_elements):
+            if isinstance(elem, ast.Query):
+                sig = query_signature(elem)
+                if sig is not None:
+                    groups.setdefault(sig, []).append(i)
+        from .multi_query import plan_query_group
+        from .nfa_device import DeviceNFAUnsupported
+        for sig, idxs in groups.items():
+            if len(idxs) < MIN_GROUP:
+                continue
+            qs = [app.execution_elements[i] for i in idxs]
+            names = [q.name(f"query_{i}") for q, i in zip(qs, idxs)]
+            try:
+                plan = plan_query_group(rt, qs, names)
+            except DeviceNFAUnsupported:
+                continue
+            rt._register_plan(plan)
+            for i in idxs:
+                fused[i] = plan
+
     for i, elem in enumerate(app.execution_elements):
+        if i in fused:
+            continue
         if isinstance(elem, ast.Query):
             plan = plan_query(rt, elem, default_name=f"query_{i}")
             rt._register_plan(plan)

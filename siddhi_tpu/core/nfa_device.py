@@ -185,7 +185,8 @@ def _conjuncts(e: ast.Expression) -> list:
 
 
 def lower_chain(state_input, schemas_by_stream: dict, strings: StringTable,
-                filters_by_node: list) -> ChainSpec:
+                filters_by_node: list,
+                param_extra: Optional[dict] = None) -> ChainSpec:
     """Validate + lower a StateInputStream into a device position chain.
 
     Reuses the host NFACompiler lowering so device and host agree on
@@ -287,6 +288,8 @@ def lower_chain(state_input, schemas_by_stream: dict, strings: StringTable,
         for f in elem_filters:
             conjs.extend(_conjuncts(f.expr))
         ctx = PatternFilterContext(spec.schemas, strings, pn.ref)
+        if param_extra:
+            ctx.extra = dict(param_extra)
         is_head = host_n.id in head_ids
         for c in conjs:
             try:
@@ -297,6 +300,8 @@ def lower_chain(state_input, schemas_by_stream: dict, strings: StringTable,
                 raise DeviceNFAUnsupported("non-boolean filter")
             own = {f"{pn.ref}.{a.name}" for a in spec.schemas[pn.ref].attributes}
             own.add("__timestamp__")
+            if param_extra:
+                own.update(param_extra)
             if set(ce.reads) <= own:
                 pn.pre_conjs.append(ce)
             else:
@@ -354,13 +359,19 @@ class NFAKernel:
 
     def __init__(self, spec: ChainSpec, sel_fns: dict, having: Optional[CompiledExpr],
                  P: int, A: int, E: Optional[int] = None, f64: bool = False,
-                 playback: bool = False):
+                 playback: bool = False, params: Optional[dict] = None,
+                 emit_qid: bool = False):
         self.spec = spec
         self.sel_fns = sel_fns          # out name -> CompiledExpr (ref.attr env)
         self.having = having
         self.P, self.A = P, A
         self.f64 = f64
         self.playback = playback
+        # multi-query lanes: per-lane (P,) parameter vectors for lifted
+        # constants, baked into the trace; emit_qid adds a lane-id row so
+        # the host can route each match to its query's output stream
+        self.params = params or {}
+        self.emit_qid = emit_qid
         self._mode = None if f64 else F32_MODE
         self.E = E if E is not None else (1 if spec.S == 1 else min(A, 2))
 
@@ -459,6 +470,8 @@ class NFAKernel:
         # ---- output rows (post-selector) ----------------------------------
         self.out_names = list(sel_fns) + ["__timestamp__", "__seq__",
                                           "__head_seq__"]
+        if emit_qid:
+            self.out_names.append("__qid__")
         for r in sorted(self._maybe_absent & sel_refs):
             self.out_names.append(f"__present__.{r}")
         with compute_dtypes(self._mode):
@@ -467,6 +480,8 @@ class NFAKernel:
         self.out_dtypes["__timestamp__"] = _I32   # local offsets
         self.out_dtypes["__seq__"] = _I32
         self.out_dtypes["__head_seq__"] = _I32
+        if emit_qid:
+            self.out_dtypes["__qid__"] = _I32
         for r in self._maybe_absent & sel_refs:
             self.out_dtypes[f"__present__.{r}"] = _I32
         self._block_cache: dict = {}    # (T, M) -> jitted fn
@@ -515,6 +530,8 @@ class NFAKernel:
             if t == ast.AttrType.BOOL:
                 col = col != 0
             env[k] = col
+        for k, v in self.params.items():
+            env[k] = jnp.asarray(v)         # (P,) broadcasts vs (A, P)
         return env
 
     def _event_env(self, x: dict, n: PNode, base_ts) -> dict:
@@ -1026,6 +1043,8 @@ class NFAKernel:
             v = ev_env.get(k, jnp.zeros((P,), _I32))
             irows.append(jnp.broadcast_to(v, (P,)).astype(_I32)[None, :])
         irows.append(seq[None, :])      # __head_seq__
+        if self.emit_qid:
+            irows.append(jnp.arange(P, dtype=_I32)[None, :])
         for k in self.rows_l:
             v = jnp.broadcast_to(ev_env.get(k, jnp.zeros((P,), jnp.int64)),
                                  (P,)).astype(jnp.int64)
@@ -1051,6 +1070,9 @@ class NFAKernel:
         sels = [done & (rank == e) for e in range(E)]       # one-hot over A
         lv = jnp.stack([s.any(axis=0) for s in sels], axis=0)   # (E, P)
         igrid = [caps["caps_i"], head_seq[None]]
+        if self.emit_qid:
+            igrid.append(jnp.broadcast_to(
+                jnp.arange(P, dtype=_I32)[None, :], (A, P))[None])
         if self.rows_l:
             cl = caps["caps_l"]
             igrid.append(_hi32(cl))
@@ -1074,6 +1096,8 @@ class NFAKernel:
     # lane-grid row order for y["i"] (after the lv row)
     def _ilane_names(self) -> list:
         names = list(self.rows_i) + ["__head_seq__"]
+        if self.emit_qid:
+            names.append("__qid__")
         for k in self.rows_l:
             names += [f"{k}.hi", f"{k}.lo"]
         if not self._parked_emission:
@@ -1109,12 +1133,17 @@ class NFAKernel:
                     env[f"{n.ref}.{a.name}"] = ev[key]
             env["__timestamp__"] = ev["__base_ts__"] \
                 + ev["__ts__"].astype(jnp.int64)
+            for k, v in self.params.items():
+                env[k] = jnp.asarray(v)     # (P,) broadcasts vs (T, P)
             m = None
             for ce in n.pre_conjs:
                 p = ce.fn(env)
                 m = p if m is None else (m & p)
             n.pre_key = f"__pre{gi}__"
-            out[n.pre_key] = jnp.broadcast_to(m, ev["__ts__"].shape)
+            # per-lane params make pre-masks (T, P) even when event grids
+            # are broadcast (T, 1)
+            out[n.pre_key] = jnp.broadcast_to(
+                m, (ev["__ts__"].shape[0], self.P))
         return out
 
     def _make_block(self, M: int) -> Callable:
@@ -1181,6 +1210,10 @@ class NFAKernel:
             else:
                 env[k] = cols[k].astype(jnp_dtype(t))
         env["__timestamp__"] = base_ts + cols["__comp_ts__"].astype(jnp.int64)
+        if self.params:
+            qid = jnp.clip(cols["__qid__"], 0, self.P - 1)
+            for k, v in self.params.items():
+                env[k] = jnp.asarray(v)[qid]
         sel = {name: jnp.broadcast_to(ce.fn(env), (M,))
                for name, ce in self.sel_fns.items()}
         valid = jnp.arange(1, M + 1, dtype=_I32) <= n
@@ -1191,6 +1224,8 @@ class NFAKernel:
         sel["__timestamp__"] = cols["__comp_ts__"]
         sel["__seq__"] = cols["__comp_seq__"]
         sel["__head_seq__"] = cols["__head_seq__"]
+        if self.emit_qid:
+            sel["__qid__"] = cols["__qid__"]
         for name in self.out_names:
             if name.startswith("__present__."):
                 sel[name] = cols.get(name, jnp.ones((M,), _I32))

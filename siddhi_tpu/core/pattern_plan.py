@@ -50,8 +50,13 @@ class DevicePatternPlan(QueryPlan):
 
     def __init__(self, name: str, rt, q: ast.Query, state_input,
                  target: Optional[str], partitions: int = 1,
-                 part_key_fns: Optional[dict] = None, slots: int = 16):
+                 part_key_fns: Optional[dict] = None, slots: int = 16,
+                 param_extra: Optional[dict] = None,
+                 broadcast_events: bool = False,
+                 params: Optional[dict] = None):
         from ..interp.engine import _collect_filters
+        self.param_extra = param_extra
+        self.broadcast_events = broadcast_events
 
         self.name = name
         self.rt = rt
@@ -72,7 +77,7 @@ class DevicePatternPlan(QueryPlan):
 
         self.spec: ChainSpec = lower_chain(
             state_input, rt.schemas, rt.strings,
-            _collect_filters(state_input.state))
+            _collect_filters(state_input.state), param_extra=param_extra)
         self.input_streams = tuple(self.spec.stream_ids)
 
         # partitioning: key fn per input stream (row cols -> np int codes)
@@ -95,7 +100,8 @@ class DevicePatternPlan(QueryPlan):
 
         # selector over capture refs
         sel = q.selector
-        sctx = MultiStreamContext(self.spec.schemas, rt.strings)
+        sctx = MultiStreamContext(self.spec.schemas, rt.strings,
+                                  extra=dict(param_extra or {}))
         names, types, fns = [], [], []
         if sel.select_all:
             seen = set()
@@ -130,9 +136,18 @@ class DevicePatternPlan(QueryPlan):
         self.out_schema = StreamSchema(target or f"#{name}", tuple(
             ast.Attribute(n, t) for n, t in zip(names, types)))
 
+        if params:
+            # pad per-lane parameter vectors to the (possibly mesh-rounded)
+            # lane count; padding lanes never match (they get zero params,
+            # and the host routes by qid < n_queries anyway)
+            params = {k: (np.concatenate([v, np.zeros(self.P - len(v),
+                                                      v.dtype)])
+                          if len(v) < self.P else v)
+                      for k, v in params.items()}
         self.kernel = NFAKernel(self.spec, dict(zip(names, fns)), having,
                                 self.P, slots, f64=self.f64,
-                                playback=rt._playback)
+                                playback=rt._playback, params=params,
+                                emit_qid=broadcast_events)
         self.state = self._shard(self.kernel.init_state())
         self._ts_base: Optional[int] = None
         self._seq_base: Optional[int] = None
@@ -181,11 +196,17 @@ class DevicePatternPlan(QueryPlan):
 
     def _shard(self, tree):
         """Place every leaf with its partition-axis sharding (no-op when
-        no mesh is configured)."""
+        no mesh is configured).  Leaves whose last dim is not the lane
+        axis — e.g. (T, 1) broadcast event grids — replicate."""
         if self.mesh is None:
             return tree
-        return jax.tree_util.tree_map(
-            lambda a: jax.device_put(a, self._part_sharding(np.ndim(a))), tree)
+
+        def put(a):
+            nd = np.ndim(a)
+            if nd and np.shape(a)[-1] == self.P:
+                return jax.device_put(a, self._part_sharding(nd))
+            return jax.device_put(a, self._part_sharding(0))
+        return jax.tree_util.tree_map(put, tree)
 
     def _np_dtype(self, t: ast.AttrType):
         if not self.f64 and t == ast.AttrType.DOUBLE:
@@ -194,7 +215,7 @@ class DevicePatternPlan(QueryPlan):
 
     def _dense_dummy(self, T: int) -> dict:
         import jax.numpy as jnp
-        P = self.P
+        P = 1 if self.broadcast_events else self.P
         ev = {"__ts__": jnp.zeros((T, P), dtype=jnp.int32),
               "__seq__": jnp.zeros((T, P), dtype=jnp.int32),
               "__valid__": jnp.zeros((T, P), dtype=bool),
@@ -241,7 +262,8 @@ class DevicePatternPlan(QueryPlan):
         old = jax.tree_util.tree_map(np.asarray, self.state)
         kern = NFAKernel(self.spec, self.kernel.sel_fns, self.kernel.having,
                          new_p, self.kernel.A, self.kernel.E, f64=self.f64,
-                         playback=self.rt._playback)
+                         playback=self.rt._playback, params=self.kernel.params,
+                         emit_qid=self.kernel.emit_qid)
         fresh = kern.init_state()
         self.state = self._shard(jax.tree_util.tree_map(
             lambda f, o: np.concatenate(
@@ -256,7 +278,8 @@ class DevicePatternPlan(QueryPlan):
         old = jax.tree_util.tree_map(np.asarray, self.state)
         kern = NFAKernel(self.spec, self.kernel.sel_fns, self.kernel.having,
                          self.P, new_a, self.kernel.E, f64=self.f64,
-                         playback=self.rt._playback)
+                         playback=self.rt._playback, params=self.kernel.params,
+                         emit_qid=self.kernel.emit_qid)
         fresh = kern.init_state()
 
         def pad(f, o):
@@ -272,7 +295,9 @@ class DevicePatternPlan(QueryPlan):
         import jax.numpy as jnp
         self.kernel = NFAKernel(self.spec, self.kernel.sel_fns,
                                 self.kernel.having, self.P, self.kernel.A,
-                                E, f64=self.f64, playback=self.rt._playback)
+                                E, f64=self.f64, playback=self.rt._playback,
+                                params=self.kernel.params,
+                                emit_qid=self.kernel.emit_qid)
 
     def _rebase(self, min_ts: int, min_seq: int) -> None:
         """Shift the plan's ts/seq bases forward and adjust persistent slot
@@ -306,6 +331,9 @@ class DevicePatternPlan(QueryPlan):
         return []
 
     def finalize(self) -> list:
+        return self._rows_to_batches(self._finalize_chunks())
+
+    def _finalize_chunks(self) -> list:
         if not self._buffered:
             return []
         bufs, self._buffered = self._buffered, []
@@ -332,17 +360,22 @@ class DevicePatternPlan(QueryPlan):
                     cols[f"{si}.{attr}"][sl] = b.columns[attr]
             o += b.n
 
-        # 2. order by arrival, compute index-within-partition
+        # 2. order by arrival, compute index-within-partition (broadcast
+        # mode: every lane sees every event, so the grid is (T, 1))
         order = np.lexsort((seq,))
         ts, seq, scode, part = ts[order], seq[order], scode[order], part[order]
         for k in cols:
             cols[k] = cols[k][order]
-        by_part = np.lexsort((seq, part))
-        idx_within = np.empty(N, dtype=np.int64)
-        sp = part[by_part]
-        run_start = np.flatnonzero(np.r_[True, sp[1:] != sp[:-1]])
-        run_id = np.cumsum(np.r_[True, sp[1:] != sp[:-1]]) - 1
-        idx_within[by_part] = np.arange(N) - run_start[run_id]
+        if self.broadcast_events:
+            idx_within = np.arange(N, dtype=np.int64)
+            part = np.zeros(N, dtype=_I32)
+        else:
+            by_part = np.lexsort((seq, part))
+            idx_within = np.empty(N, dtype=np.int64)
+            sp = part[by_part]
+            run_start = np.flatnonzero(np.r_[True, sp[1:] != sp[:-1]])
+            run_id = np.cumsum(np.r_[True, sp[1:] != sp[:-1]]) - 1
+            idx_within[by_part] = np.arange(N) - run_start[run_id]
 
         # 3. i32 offset bases (+ rebase persistent state before overflow).
         # The base is chosen from the flush MAX so headroom is always
@@ -365,6 +398,9 @@ class DevicePatternPlan(QueryPlan):
         # batch); T_CAP widens for small P so single-partition patterns
         # amortize per-block overhead over longer scans
         T_CAP = min(8192, max(512, (1 << 19) // max(self.P, 1)))
+        if self.broadcast_events:
+            T_CAP = 4096
+        GW = 1 if self.broadcast_events else self.P    # grid width
         multi = len(self.spec.stream_ids) > 1
         chunk_evs: list = []
         n_chunks = int(idx_within.max()) // T_CAP + 1
@@ -374,13 +410,13 @@ class DevicePatternPlan(QueryPlan):
                 continue
             t_local = (idx_within[m] - c * T_CAP).astype(np.int64)
             T = pow2_at_least(int(t_local.max()) + 1)
-            ev = {"__ts__": np.zeros((T, self.P), _I32),
-                  "__seq__": np.zeros((T, self.P), _I32),
-                  "__valid__": np.zeros((T, self.P), bool)}
+            ev = {"__ts__": np.zeros((T, GW), _I32),
+                  "__seq__": np.zeros((T, GW), _I32),
+                  "__valid__": np.zeros((T, GW), bool)}
             if multi:
-                ev["__scode__"] = np.full((T, self.P), -1, _I32)
+                ev["__scode__"] = np.full((T, GW), -1, _I32)
             for k, v in cols.items():
-                ev[k] = np.zeros((T, self.P), v.dtype)
+                ev[k] = np.zeros((T, GW), v.dtype)
             pm = part[m]
             ev["__ts__"][t_local, pm] = ts32[m]
             ev["__seq__"][t_local, pm] = seq32[m]
@@ -393,7 +429,7 @@ class DevicePatternPlan(QueryPlan):
             ev["__base_seq__"] = np.int64(self._seq_base)
             chunk_evs.append((ev, T))
 
-        return self._rows_to_batches(self._run_chunks(chunk_evs))
+        return self._run_chunks(chunk_evs)
 
     def _run_chunks(self, chunk_evs: list) -> list:
         """Dispatch ALL blocks first (device state threads functionally),
@@ -413,7 +449,13 @@ class DevicePatternPlan(QueryPlan):
             for j in range(i, len(chunk_evs)):
                 ev, T = chunk_evs[j]
                 ev = self._shard(ev)
-                M = max(self._m_hint, _m_bucket(2 * T))
+                if self.broadcast_events:
+                    # multi-query lanes are matchy and this kernel costs
+                    # ~17s to compile: size M generously in pow2 so the
+                    # steady state reuses ONE compiled block
+                    M = max(self._m_hint, pow2_at_least(32 * T))
+                else:
+                    M = max(self._m_hint, _m_bucket(2 * T))
                 fn = self.kernel.block_fn(T, M)
                 pre = st
                 st, out = fn(st, ev)
@@ -429,7 +471,8 @@ class DevicePatternPlan(QueryPlan):
                 n, ofs, ofl = (int(ipack[0, 0]), int(ipack[0, 1]),
                                int(ipack[0, 2]))
                 while n > M:                   # exact re-run, bigger buffer
-                    M = _m_bucket(n)
+                    M = pow2_at_least(n) if self.broadcast_events \
+                        else _m_bucket(n)
                     fn = self.kernel.block_fn(T, M)
                     _st2, out = fn(pre, ev)
                     ipack = np.asarray(out["i"])
@@ -495,6 +538,8 @@ class DevicePatternPlan(QueryPlan):
         tss = row["__timestamp__"][valid].astype(np.int64) + self._ts_base
         seqs = row["__seq__"][valid].astype(np.int64) + self._seq_base
         hseqs = row["__head_seq__"][valid]
+        self._last_qids = (row["__qid__"][valid]
+                           if self.kernel.emit_qid else None)
         data = {}
         for nm, t in zip(self._names, self._types):
             col = row[nm][valid]
@@ -508,13 +553,15 @@ class DevicePatternPlan(QueryPlan):
                 mask = pres[valid] == 0
                 if mask.any():
                     nulls[nm] = mask
-        return (tss, seqs, hseqs, data, nulls)
+        return (tss, seqs, hseqs, data, nulls, self._last_qids)
 
     def _rows_to_batches(self, chunks: list) -> list:
         """chunks: list of (tss, seqs, hseqs, data) columnar match tables."""
         chunks = [c for c in chunks if c is not None]
         if not chunks or self.events_for == ast.OutputEventsFor.EXPIRED:
             return []
+        if self.broadcast_events:
+            raise RuntimeError("multi-query plans use finalize_multi()")
         tss = np.concatenate([c[0] for c in chunks])
         seqs = np.concatenate([c[1] for c in chunks])
         hseqs = np.concatenate([c[2] for c in chunks])
@@ -543,6 +590,24 @@ class DevicePatternPlan(QueryPlan):
                            cols, len(o), seqs[o], nulls)
         return [OutputBatch(self.output_target, batch)]
 
+    def finalize_multi(self):
+        """Multi-query mode: drain buffered events and return the raw
+        columnar match table (tss, seqs, hseqs, data, qids) — the outer
+        MultiQueryDevicePatternPlan routes rows per lane."""
+        chunks = list(getattr(self, "_tick_chunks", ()) or ())
+        self._tick_chunks = []
+        chunks += [c for c in self._finalize_chunks() if c is not None]
+        chunks = [c for c in chunks if c is not None]
+        if not chunks:
+            return None
+        tss = np.concatenate([c[0] for c in chunks])
+        seqs = np.concatenate([c[1] for c in chunks])
+        hseqs = np.concatenate([c[2] for c in chunks])
+        data = {nm: np.concatenate([c[3][nm] for c in chunks])
+                for nm in self._names}
+        qids = np.concatenate([c[5] for c in chunks])
+        return (tss, seqs, hseqs, data, qids)
+
     # -- timers (absent-state deadlines) ---------------------------------
 
     def next_wakeup(self) -> Optional[int]:
@@ -556,7 +621,8 @@ class DevicePatternPlan(QueryPlan):
             return []
         import jax.numpy as jnp
         T = 1
-        ev = {"__ts__": np.full((T, self.P),
+        GW = 1 if self.broadcast_events else self.P
+        ev = {"__ts__": np.full((T, GW),
                                 np.clip(now_ms - self._ts_base, -LOCAL_SPAN,
                                         LOCAL_SPAN), _I32),
               "__seq__": np.full((T, self.P),
@@ -565,12 +631,16 @@ class DevicePatternPlan(QueryPlan):
               "__valid__": np.zeros((T, self.P), bool),
               "__tick__": np.ones((T, self.P), bool)}
         if len(self.spec.stream_ids) > 1:
-            ev["__scode__"] = np.full((T, self.P), -1, _I32)
+            ev["__scode__"] = np.full((T, GW), -1, _I32)
         for si, attr, t in self._grid_attrs:
             ev[f"{si}.{attr}"] = np.zeros((T, self.P), self._np_dtype(t))
         ev["__base_ts__"] = np.int64(self._ts_base)
         ev["__base_seq__"] = np.int64(self._seq_base)
-        return self._rows_to_batches(self._run_chunks([(ev, T)]))
+        chunks = self._run_chunks([(ev, T)])
+        if self.broadcast_events:
+            self._tick_chunks = [c for c in chunks if c is not None]
+            return []
+        return self._rows_to_batches(chunks)
 
     # -- snapshot ------------------------------------------------------------
 
@@ -589,7 +659,9 @@ class DevicePatternPlan(QueryPlan):
             if p_r != p:       # snapshot from a differently-sized mesh/host
                 kern = NFAKernel(self.spec, self.kernel.sel_fns,
                                  self.kernel.having, p_r, a, self.kernel.E,
-                                 f64=self.f64, playback=self.rt._playback)
+                                 f64=self.f64, playback=self.rt._playback,
+                                 params=self.kernel.params,
+                                 emit_qid=self.kernel.emit_qid)
                 fresh = jax.tree_util.tree_map(np.asarray, kern.init_state())
                 st = jax.tree_util.tree_map(
                     lambda o, f: np.concatenate(
@@ -599,7 +671,9 @@ class DevicePatternPlan(QueryPlan):
         if p != self.P or a != self.kernel.A:  # snapshot taken after growth
             self.kernel = NFAKernel(self.spec, self.kernel.sel_fns,
                                     self.kernel.having, p, a, self.kernel.E,
-                                    f64=self.f64, playback=self.rt._playback)
+                                    f64=self.f64, playback=self.rt._playback,
+                                    params=self.kernel.params,
+                                    emit_qid=self.kernel.emit_qid)
             self.P = p
         self.state = self._shard(st)
         self._key_to_part = dict(d["key_to_part"])

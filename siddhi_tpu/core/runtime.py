@@ -659,7 +659,8 @@ class SiddhiAppRuntime:
     def _emit(self, plan: QueryPlan, ob: OutputBatch) -> None:
         if ob.batch.n == 0 and not ob.is_signal:
             return
-        cb_name = getattr(plan, "callback_name", plan.name)
+        cb_name = getattr(ob, "callback_name", None) \
+            or getattr(plan, "callback_name", plan.name)
         for cb in self._query_callbacks.get(cb_name, ()):
             events = self._decode(ob.batch)
             if ob.is_expired:

@@ -1,0 +1,108 @@
+"""Multi-query device batching: structurally identical pattern queries
+fuse into one kernel whose lanes are the query instances (BASELINE
+config 5; reference analog = N QueryRuntimes walking processor chains)."""
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.multi_query import MultiQueryDevicePatternPlan
+
+
+@pytest.fixture
+def mgr():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def _app(n_queries=12, shapes=(0,)):
+    parts = ["define stream S (sym string, price double);"]
+    for i in range(n_queries):
+        lo = 100 + (i % 8)
+        shape = shapes[i % len(shapes)]
+        if shape == 0:
+            parts.append(
+                f"@info(name='q{i}') from every e1=S[price > {lo}.0] -> "
+                f"e2=S[price > e1.price] within 1 sec "
+                f"select e1.price as a{i}, e2.price as b{i} "
+                f"insert into Out{i % 4};")
+        else:
+            parts.append(
+                f"@info(name='q{i}') from e1=S[price > {lo + 1}.0] -> "
+                f"not S[price < {lo - 20}.0] for 500 milliseconds "
+                f"select e1.price as a{i} insert into Out{i % 4};")
+    return "\n".join(parts)
+
+
+def _run(mgr, app, sends, n_out=4):
+    rt = mgr.create_app_runtime(app)
+    got = {f"Out{j}": [] for j in range(n_out)}
+    for j in range(n_out):
+        rt.add_callback(f"Out{j}",
+                        lambda evs, g=got[f"Out{j}"]:
+                        g.extend(e.data for e in evs))
+    rt.start()
+    h = rt.input_handler("S")
+    for p, ts in sends:
+        h.send(("A", p), timestamp=ts)
+    rt.flush()
+    return got, rt
+
+
+def _tape(n=250, seed=4):
+    rng = np.random.default_rng(seed)
+    return [(float(np.round(rng.uniform(95, 112) * 4) / 4), 1000 + k * 20)
+            for k in range(n)]
+
+
+def test_fused_equals_sequential(mgr):
+    app = _app(12)
+    sends = _tape()
+    dev, drt = _run(mgr, app, sends)
+    fused = [p for p in drt._plans
+             if isinstance(p, MultiQueryDevicePatternPlan)]
+    assert len(fused) == 1 and fused[0].n_queries == 12
+    host, hrt = _run(mgr, "@app:devicePatterns('never')\n" + app, sends)
+    assert not any(isinstance(p, MultiQueryDevicePatternPlan)
+                   for p in hrt._plans)
+    for j in range(4):
+        assert sorted(dev[f"Out{j}"]) == sorted(host[f"Out{j}"])
+    assert sum(len(v) for v in dev.values()) > 0
+
+
+def test_mixed_shapes_group_separately(mgr):
+    app = _app(16, shapes=(0, 1))
+    sends = _tape(300)
+    dev, drt = _run(mgr, "@app:playback\n" + app, sends)
+    fused = [p for p in drt._plans
+             if isinstance(p, MultiQueryDevicePatternPlan)]
+    assert sorted(p.n_queries for p in fused) == [8, 8]
+    host, _ = _run(mgr, "@app:playback\n@app:devicePatterns('never')\n" + app,
+                   sends)
+    for j in range(4):
+        assert sorted(dev[f"Out{j}"]) == sorted(host[f"Out{j}"])
+
+
+def test_small_groups_stay_individual(mgr):
+    app = _app(4)          # below MIN_GROUP
+    _got, rt = _run(mgr, app, _tape(40))
+    assert not any(isinstance(p, MultiQueryDevicePatternPlan)
+                   for p in rt._plans)
+
+
+def test_fused_snapshot_restore(mgr):
+    app = _app(12)
+    sends = _tape(120)
+    dev, rt = _run(mgr, app, sends)
+    snap = rt.snapshot()
+    rt2 = mgr.create_app_runtime(app)
+    got2 = {f"Out{j}": [] for j in range(4)}
+    for j in range(4):
+        rt2.add_callback(f"Out{j}", lambda evs, g=got2[f"Out{j}"]:
+                         g.extend(e.data for e in evs))
+    rt2.restore(snap)
+    h = rt2.input_handler("S")
+    # a pending e1 from before the snapshot should complete after restore
+    h.send(("A", 130.0), timestamp=sends[-1][1] + 10)
+    rt2.flush()
+    assert sum(len(v) for v in got2.values()) > 0
