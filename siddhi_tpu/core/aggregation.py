@@ -335,9 +335,6 @@ class AggregationRuntime(QueryPlan):
                         sg = g64[gi][order]
                         starts = starts | jnp.concatenate(
                             [jnp.array([True]), sg[1:] != sg[:-1]])
-                    start_idx = jax.lax.associative_scan(
-                        jnp.maximum, jnp.where(starts,
-                                               jnp.arange(npad), 0))
                     rows = []
                     for bi, b in enumerate(base_ops):
                         if b == "count":

@@ -35,6 +35,10 @@ class CompiledExpr:
     type: AttrType
     # variable env keys this expression reads (for wiring/pruning)
     reads: frozenset
+    # True when the whole expression is one bare Variable — only such
+    # outputs can be null-reconstructed host-side (a derived expression
+    # like `x is null` must EVALUATE the null, not propagate it)
+    is_var: bool = False
 
 
 class ExprError(Exception):
@@ -270,7 +274,8 @@ def compile_expression(expr: ast.Expression, ctx: ExprContext) -> CompiledExpr:
         return CompiledExpr(lambda env: v, AttrType.LONG, frozenset())
     if isinstance(expr, ast.Variable):
         key, t = ctx.resolve(expr)
-        return CompiledExpr(lambda env: env[key], t, frozenset([key]))
+        return CompiledExpr(lambda env: env[key], t, frozenset([key]),
+                            is_var=True)
     if isinstance(expr, ast.Compare):
         return _compile_compare(expr, ctx)
     if isinstance(expr, ast.And):

@@ -413,12 +413,50 @@ class NFAKernel:
         # reconstruction needs them even when the selector doesn't is-null)
         self._maybe_absent = spec.maybe_absent_refs()
         sel_refs = set()
+        sel_rparts = set()
         for ce in sel_fns.values():
             for k in ce.reads:
                 if "." in k and not k.startswith("__"):
+                    sel_rparts.add(k.split(".", 1)[0])
                     sel_refs.add(_base_ref(k.split(".", 1)[0])[0])
         for r in self._maybe_absent & sel_refs:
             cap_keys.add(f"__present__.{r}")
+
+        # indexed captures over count positions that may be UNFILLED at
+        # emission (fewer than i+1 occurrences collected): the host emits
+        # NULL for them (interp/nfa.py env_of_captures leaves the key out
+        # of the env).  Selector reads get a per-index presence bit so the
+        # host can null-reconstruct; predicate/having reads can't express
+        # null semantics on device and fall back.
+        minc_of = {p.nodes[0].ref: p.min_count
+                   for p in spec.positions if p.is_count}
+        self._maybe_unfilled = set()
+        for k in list(cap_keys):
+            if k.startswith("__present__."):
+                continue
+            refpart = k.split(".", 1)[0]
+            base, cidx = _base_ref(refpart)
+            if cidx is None or base not in minc_of:
+                continue
+            if cidx not in ("last", "last-1") and not cidx.isdigit():
+                continue        # the _key_type loop below rejects it
+            want = (1 if cidx == "last" else
+                    2 if cidx == "last-1" else int(cidx) + 1)
+            if want > minc_of[base]:
+                self._maybe_unfilled.add(refpart)
+        if self._maybe_unfilled:
+            conjs = [c for n_ in spec.all_nodes for c in n_.step_conjs]
+            if having is not None:
+                conjs.append(having)
+            for ce in conjs:
+                for k in ce.reads:
+                    if "." in k and k.split(".", 1)[0] in self._maybe_unfilled:
+                        raise DeviceNFAUnsupported(
+                            f"predicate reads maybe-unfilled indexed "
+                            f"capture {k!r}")
+        self._unfilled_sel = sorted(self._maybe_unfilled & sel_rparts)
+        for rp in self._unfilled_sel:
+            cap_keys.add(f"__present__.{rp}")
 
         self._key_type: dict = {}
         for k in sorted(cap_keys):
@@ -454,18 +492,22 @@ class NFAKernel:
         # or-sides whose selected outputs must come back as NULL: selector
         # outputs that are plain variables over maybe-absent refs (anything
         # fancier can't be null-reconstructed host-side)
-        self.null_outputs: dict = {}      # out name -> ref
+        self.null_outputs: dict = {}      # out name -> ref (or indexed refpart)
         for name, ce in sel_fns.items():
             reads = [k for k in ce.reads if "." in k and not k.startswith("__")]
-            refs = {_base_ref(k.split(".", 1)[0])[0] for k in reads}
-            hit = refs & self._maybe_absent
+            rparts = {k.split(".", 1)[0] for k in reads}
+            hit = ({_base_ref(rp)[0] for rp in rparts} & self._maybe_absent) \
+                | (rparts & self._maybe_unfilled)
             if not hit:
                 continue
-            if len(reads) == 1 and len(hit) == 1:
+            if ce.is_var and len(hit) == 1:
                 self.null_outputs[name] = next(iter(hit))
             else:
+                # a derived expression (e.g. `x is null`) must EVALUATE
+                # the null, which the device can't represent — fall back
                 raise DeviceNFAUnsupported(
-                    f"selector output {name!r} mixes maybe-absent refs")
+                    f"selector output {name!r} derives from a maybe-absent "
+                    f"ref (only bare variables null-reconstruct)")
 
         # ---- output rows (post-selector) ----------------------------------
         self.out_names = list(sel_fns) + ["__timestamp__", "__seq__",
@@ -474,6 +516,8 @@ class NFAKernel:
             self.out_names.append("__qid__")
         for r in sorted(self._maybe_absent & sel_refs):
             self.out_names.append(f"__present__.{r}")
+        for rp in self._unfilled_sel:
+            self.out_names.append(f"__present__.{rp}")
         with compute_dtypes(self._mode):
             self.out_dtypes = {n: jnp_dtype(ce.type)
                                for n, ce in sel_fns.items()}
@@ -484,6 +528,8 @@ class NFAKernel:
             self.out_dtypes["__qid__"] = _I32
         for r in self._maybe_absent & sel_refs:
             self.out_dtypes[f"__present__.{r}"] = _I32
+        for rp in self._unfilled_sel:
+            self.out_dtypes[f"__present__.{rp}"] = _I32
         self._block_cache: dict = {}    # (T, M) -> jitted fn
 
     @staticmethod
@@ -652,6 +698,10 @@ class NFAKernel:
                 cnt, cnt_on, narm, fl, dl2 = self._enter_position(
                     pi + 1, due, cnt, cnt_on, narm, fl, dl, dl[r])
                 dl = dl2
+                zero_e = self._present_zero(
+                    {n.ref for n in spec.positions[pi + 1].nodes})
+                if zero_e:  # immediate: same-step collection reads caps
+                    caps = self._write_caps(caps, due, zero_e)
             dl = dl.at[r].set(jnp.where(due, NO_DEADLINE, dl[r]))
         occ = occ0
 
@@ -782,10 +832,8 @@ class NFAKernel:
             # clear stale capture/present rows of the entered position's
             # refs (slots are reused; a previous life's captures must not
             # leak into this match's emission)
-            zero = {}
-            for n in tpos.nodes:
-                zero[f"__present__.{n.ref}"] = jnp.zeros((P,), _I32)
-            caps = self._write_caps(caps, mask, zero)
+            caps = self._write_caps(
+                caps, mask, self._present_zero({n.ref for n in tpos.nodes}))
 
         # --- sequence strictness ------------------------------------------
         if spec.is_sequence:
@@ -830,6 +878,18 @@ class NFAKernel:
         return carry, y
 
     # -- helpers for pieces of the step ----------------------------------
+
+    def _present_zero(self, refs: Optional[set] = None) -> dict:
+        """Zero-writes for presence rows (base + per-index) — applied when
+        a slot is reused or advances into a position, so a previous life's
+        captures can't leak.  refs=None clears every presence row."""
+        out = {}
+        for k in self.rows_i:
+            if not k.startswith("__present__."):
+                continue
+            if refs is None or _base_ref(k[len("__present__."):])[0] in refs:
+                out[k] = jnp.zeros((self.P,), _I32)
+        return out
 
     def _enter_position(self, tpi, mask, cnt, cnt_on, narm, fl, dl, ts):
         """State-row resets/arms when slots advance into position tpi."""
@@ -892,6 +952,18 @@ class NFAKernel:
                 vals[k] = jnp.where(newc == jnp.int32(want),
                                     jnp.broadcast_to(x[keyx], cur.shape
                                                      ).astype(cur.dtype), cur)
+        # per-index presence bits (host nulls unfilled indexed captures)
+        for rp in self._unfilled_sel:
+            pkey = f"__present__.{rp}"
+            if pkey not in self._row_of:
+                continue
+            base, cidx = _base_ref(rp)
+            if base != n.ref:
+                continue
+            want = 2 if cidx == "last-1" else int(cidx) + 1
+            g, i = self._row_of[pkey]
+            cur = caps[f"caps_{g}"][i]
+            vals[pkey] = jnp.where(newc >= jnp.int32(want), jnp.int32(1), cur)
         return vals
 
     def _logical_step(self, pi, pos, at, nm, x, ts, seq, dl, fl, caps,
@@ -964,11 +1036,7 @@ class NFAKernel:
         # clear stale capture/present/deadline rows from the slot's
         # previous life (a stale armed deadline on a live slot would wedge
         # the timer scheduler in a fire-nothing loop)
-        zero = {}
-        for pos in self.spec.positions:
-            for n in pos.nodes:
-                zero[f"__present__.{n.ref}"] = jnp.zeros((self.P,), _I32)
-        caps = self._write_caps(caps, hot, zero)
+        caps = self._write_caps(caps, hot, self._present_zero())
         if self.Ka:
             dl = jnp.where(hot[None], NO_DEADLINE, dl)
 

@@ -625,15 +625,15 @@ class DevicePatternPlan(QueryPlan):
         ev = {"__ts__": np.full((T, GW),
                                 np.clip(now_ms - self._ts_base, -LOCAL_SPAN,
                                         LOCAL_SPAN), _I32),
-              "__seq__": np.full((T, self.P),
+              "__seq__": np.full((T, GW),
                                  np.clip(self._last_seq - self._seq_base,
                                          -LOCAL_SPAN, LOCAL_SPAN), _I32),
-              "__valid__": np.zeros((T, self.P), bool),
-              "__tick__": np.ones((T, self.P), bool)}
+              "__valid__": np.zeros((T, GW), bool),
+              "__tick__": np.ones((T, GW), bool)}
         if len(self.spec.stream_ids) > 1:
             ev["__scode__"] = np.full((T, GW), -1, _I32)
         for si, attr, t in self._grid_attrs:
-            ev[f"{si}.{attr}"] = np.zeros((T, self.P), self._np_dtype(t))
+            ev[f"{si}.{attr}"] = np.zeros((T, GW), self._np_dtype(t))
         ev["__base_ts__"] = np.int64(self._ts_base)
         ev["__base_seq__"] = np.int64(self._seq_base)
         chunks = self._run_chunks([(ev, T)])
@@ -647,7 +647,9 @@ class DevicePatternPlan(QueryPlan):
     def state_dict(self) -> dict:
         st = jax.tree_util.tree_map(np.asarray, self.state)
         return {"state": st, "key_to_part": dict(self._key_to_part),
-                "ts_base": self._ts_base, "seq_base": self._seq_base}
+                "ts_base": self._ts_base, "seq_base": self._seq_base,
+                "next_deadline": self._next_deadline,
+                "last_seq": self._last_seq}
 
     def load_state_dict(self, d: dict) -> None:
         import jax.numpy as jnp
@@ -679,4 +681,22 @@ class DevicePatternPlan(QueryPlan):
         self._key_to_part = dict(d["key_to_part"])
         self._ts_base = d.get("ts_base")
         self._seq_base = d.get("seq_base")
+        # legacy snapshots (no last_seq) fall back to the seq base — a
+        # deadline fired before the next batch must not emit seq 0-based
+        self._last_seq = int(d["last_seq"] if d.get("last_seq") is not None
+                             else (d.get("seq_base") or 0))
         self._of_slots_seen = int(np.asarray(st["of_slots"]).sum())
+        # pending absent-state deadlines must survive the restore, or the
+        # scheduler never wakes to fire them; older snapshots (no key)
+        # recompute the earliest armed deadline from the restored dl rows
+        if "next_deadline" in d:
+            self._next_deadline = d["next_deadline"]
+        elif self.kernel.has_absent and st["dl"].size \
+                and self._ts_base is not None:
+            live = (st["occ"] > 0) & (st["occ"] <= self.spec.S)
+            dls = np.where(live[None], st["dl"], np.int32(2**31 - 1))
+            dlm = int(dls.min()) if dls.size else 2**31 - 1
+            self._next_deadline = (None if dlm >= 2**31 - 1
+                                   else self._ts_base + dlm)
+        else:
+            self._next_deadline = None

@@ -216,3 +216,85 @@ def test_partitioned_count_device(mgr):
     dev, drt = run_app(mgr, body, sends)
     host, _ = run_app(mgr, "@app:devicePatterns('never')\n" + body, sends)
     assert sorted(dev) == sorted(host)
+
+
+def test_count_indexed_capture_unfilled_null(mgr):
+    """An indexed capture never filled in THIS match must emit NULL
+    (host semantics) — not a zero or a stale value leaked from the
+    slot's previous life (round-3 advisor finding)."""
+    body = """
+    define stream T (temp double);
+    @info(name='q') from every e1=T[temp > 30]<1:3> -> e2=T[temp < 10]
+    select e1[0].temp as a, e1[1].temp as b, e2.temp as c insert into O;
+    """
+    sends = [("T", (32.0,), 1000), ("T", (5.0,), 1001),
+             ("T", (41.0,), 1002), ("T", (4.0,), 1003)]
+    dev, host = both(mgr, body, sends)
+    assert dev == host
+    assert (32.0, None, 5.0) in host and (41.0, None, 4.0) in host
+
+
+def test_count_indexed_capture_filled_then_unfilled(mgr):
+    # first life fills e1[1]; the reused slot's second life must not leak it
+    body = """
+    define stream T (temp double);
+    @info(name='q') from every e1=T[temp > 30]<1:3> -> e2=T[temp < 10]
+    select e1[0].temp as a, e1[1].temp as b, e2.temp as c insert into O;
+    """
+    sends = [("T", (32.0,), 1000), ("T", (33.0,), 1001), ("T", (5.0,), 1002),
+             ("T", (41.0,), 1003), ("T", (4.0,), 1004)]
+    dev, host = both(mgr, body, sends)
+    assert sorted(dev, key=str) == sorted(host, key=str)
+
+
+def test_absent_deadline_survives_snapshot_restore(mgr):
+    """A pending `not B for T` deadline armed before a snapshot must still
+    fire after restore into a fresh runtime (round-3 advisor finding)."""
+    body = ABSENT_BODY
+    for variant in ("dev", "host"):
+        prefix = DEV if variant == "dev" else SEQ
+        rt = mgr.create_app_runtime(prefix + body)
+        out = []
+        rt.add_callback("O", lambda evs: out.extend(e.data for e in evs))
+        rt.start()
+        rt.input_handler("A").send((7,), timestamp=1000)
+        rt.flush()
+        snap = rt.snapshot()
+
+        rt2 = mgr.create_app_runtime(prefix + body)
+        out2 = []
+        rt2.add_callback("O", lambda evs: out2.extend(e.data for e in evs))
+        rt2.start()
+        rt2.restore(snap)
+        rt2.set_time(2100)            # past the 1 sec deadline
+        assert out2 == [(7,)], f"{variant}: restored deadline did not fire"
+
+
+def test_indexed_capture_last_n_falls_back(mgr):
+    # 'last-2' is outside the device capture algebra: must fall back to
+    # the host matcher, not crash at plan-build time
+    body = """
+    define stream T (temp double);
+    @info(name='q') from e1=T[temp > 30]<1:3> -> e2=T[temp < 10]
+    select e1[last-2].temp as a, e2.temp as c insert into O;
+    """
+    sends = [("T", (32.0,), 1000), ("T", (33.0,), 1001), ("T", (34.0,), 1002),
+             ("T", (5.0,), 1003)]
+    dev, _ = run_app(mgr, "@app:devicePatterns('auto')\n" + body, sends)
+    host, _ = run_app(mgr, SEQ + body, sends)
+    assert dev == host
+
+
+def test_derived_null_selector_falls_back(mgr):
+    # `e1[1].temp is null` must EVALUATE the null (host semantics), which
+    # the device cannot represent -> host fallback, identical output
+    body = """
+    define stream T (temp double);
+    @info(name='q') from every e1=T[temp > 30]<1:3> -> e2=T[temp < 10]
+    select e1[1].temp is null as b, e2.temp as c insert into O;
+    """
+    sends = [("T", (32.0,), 1000), ("T", (33.0,), 1001), ("T", (5.0,), 1002),
+             ("T", (41.0,), 1003), ("T", (4.0,), 1004)]
+    dev, _ = run_app(mgr, "@app:devicePatterns('auto')\n" + body, sends)
+    host, _ = run_app(mgr, SEQ + body, sends)
+    assert dev == host
