@@ -94,12 +94,14 @@ def run_tape(app, stream, tape, keys, out_streams=("Out",), warm=1):
     for b in batches[:warm]:
         rt._pending.append((stream, b))
         rt._drain()
+    rt.flush()                   # pipelined plans: deliver warm leftovers
     warm_matches = counted[0]
     n_timed = sum(b.n for b in batches[warm:])
     t0 = time.perf_counter()
     for b in batches[warm:]:
         rt._pending.append((stream, b))
         rt._drain()
+    rt.flush()                   # barrier: all outputs delivered in-window
     dt = time.perf_counter() - t0
     mgr.shutdown()
     return n_timed / dt, counted[0] - warm_matches
@@ -194,6 +196,10 @@ DEV = {"filters": "@app:deviceFilters('auto')\n",
 HOST = {"filters": "@app:deviceFilters('never')\n",
         "windows": "@app:deviceWindows('never')\n",
         "patterns": "@app:devicePatterns('never')\n"}
+# throughput mode: overlap batch i's device->host pull with batch i+1..i+3
+# (outputs deliver late; the flush barrier inside the timed window drains
+# them).  Latency runs do NOT use this — p99 is measured unpipelined.
+PIPE = "@app:devicePipeline(3)\n"
 
 
 STREAM = "StockStream"
@@ -201,8 +207,10 @@ STREAM = "StockStream"
 
 def bench_config(name, dev_app, host_app, n, batch, keys=8, dt_ms=1,
                  out_streams=("Out",), warm=1, check_matches=True,
-                 latency=False):
-    """Matched-conditions measurement; returns a result dict."""
+                 latency=False, lat_dev_app=None):
+    """Matched-conditions measurement; returns a result dict.
+    `lat_dev_app` (default dev_app) measures p99 — throughput apps may
+    enable output pipelining, which must NOT be active for latency."""
     tape = make_tape(n + warm * batch, batch, keys=keys, dt_ms=dt_ms)
     dev_eps, dev_matches = run_tape(dev_app, STREAM, tape, keys, out_streams, warm)
     if host_app == dev_app:        # same engine both modes: one measurement
@@ -223,25 +231,43 @@ def bench_config(name, dev_app, host_app, n, batch, keys=8, dt_ms=1,
     }
     if latency:
         lat_tape = make_tape(2048 * 24, 2048, keys=keys, dt_ms=dt_ms)
-        res["p99_detect_ms"] = p99_latency(dev_app, STREAM, lat_tape, keys)
+        lat_app = lat_dev_app or dev_app
+        res["p99_detect_ms"] = p99_latency(lat_app, STREAM, lat_tape, keys)
         res["host_p99_detect_ms"] = p99_latency(host_app, STREAM, lat_tape, keys)
     return res
+
+
+def frontier(dev_app, keys=8, dt_ms=1,
+             batches=(2048, 8192, 32768, 131072)):
+    """Latency/throughput frontier: micro-batch size vs (eps, p99).
+    Small batches = low detect latency; large = high throughput.  Run
+    unpipelined so p99 reflects true event->match delivery."""
+    pts = []
+    for b in batches:
+        n = max(4 * b, 32768)
+        tape = make_tape(n + b, b, keys=keys, dt_ms=dt_ms)
+        eps, _m = run_tape(dev_app, STREAM, tape, keys, ("Out",), warm=1)
+        lat_tape = make_tape(b * 12, b, keys=keys, dt_ms=dt_ms)
+        p99 = p99_latency(dev_app, STREAM, lat_tape, keys, warm=4)
+        pts.append({"batch": b, "eps": round(eps), "p99_ms": p99})
+    return pts
 
 
 def main():
     configs = {}
 
     configs["1_filter"] = bench_config(
-        "filter", DEV["filters"] + C1, HOST["filters"] + C1,
-        n=1 << 19, batch=1 << 18)
+        "filter", PIPE + DEV["filters"] + C1, HOST["filters"] + C1,
+        n=1 << 20, batch=1 << 18)
 
     configs["2_window_agg"] = bench_config(
-        "window", DEV["windows"] + C2, HOST["windows"] + C2,
-        n=1 << 17, batch=1 << 16)
+        "window", PIPE + DEV["windows"] + C2, HOST["windows"] + C2,
+        n=1 << 19, batch=1 << 17)
 
     configs["3_sequence"] = bench_config(
-        "sequence", DEV["patterns"] + C3, HOST["patterns"] + C3,
-        n=1 << 17, batch=1 << 17, latency=True)
+        "sequence", PIPE + DEV["patterns"] + C3, HOST["patterns"] + C3,
+        n=1 << 18, batch=1 << 17, latency=True,
+        lat_dev_app=DEV["patterns"] + C3)
 
     head = ("@app:partitionCapacity(1000)\n@app:deviceSlots(32)\n")
     configs["4_partitioned_1k"] = bench_config(
@@ -257,6 +283,10 @@ def main():
     configs["5_1k_mixed_queries"]["note"] = \
         ("device = 4 fused multi-query kernels (250 lanes each); "
          "host = 1000 sequential matchers")
+
+    # latency/throughput frontier for the CEP sequence config: the
+    # micro-batch size is the knob (VERDICT r3 #3)
+    configs["3_sequence"]["frontier"] = frontier(DEV["patterns"] + C3)
 
     h = configs["4_partitioned_1k"]
     print(json.dumps({

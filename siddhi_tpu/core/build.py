@@ -15,6 +15,23 @@ from .planner import (FilterProjectPlan, PlanError, output_target_of,
 
 def build_app(rt) -> None:
     """Populate rt (SiddhiAppRuntime) with tables and plans from rt.app."""
+    from ..interp.expr import (ExprError, compile_script_function, udf_scope)
+
+    # script UDFs compile first: queries below may call them (reference:
+    # SiddhiAppParser defines scripts before queries, Script.java:27).
+    # Unsupported languages fail HERE, loudly — not at first use.
+    rt.udfs = {}
+    for fid, fd in rt.app.function_definitions.items():
+        try:
+            rt.udfs[fid.lower()] = (compile_script_function(fd),
+                                    fd.return_type)
+        except ExprError as e:
+            raise PlanError(str(e)) from None
+    with udf_scope(rt.udfs):
+        _build_app_scoped(rt)
+
+
+def _build_app_scoped(rt) -> None:
     from .table import InMemoryTable, TableError
 
     app = rt.app
@@ -158,6 +175,15 @@ def _normalize_fault_inputs(node, rt, name: str):
 
 
 def plan_query(rt, q: ast.Query, default_name: str):
+    """Compile one query into a plan.  Re-enters udf_scope: partition
+    groups call this lazily (first event per key), long after build_app's
+    scope has exited — script functions must still resolve."""
+    from ..interp.expr import udf_scope
+    with udf_scope(getattr(rt, "udfs", None)):
+        return _plan_query_scoped(rt, q, default_name)
+
+
+def _plan_query_scoped(rt, q: ast.Query, default_name: str):
     import dataclasses
     name = q.name(default_name)
     target = output_target_of(q)
@@ -198,10 +224,13 @@ def plan_query(rt, q: ast.Query, default_name: str):
                 and not any(isinstance(h, ast.StreamFunction) for h in inp.handlers)):
             try:
                 filters = [f.expr for f in inp.filters]
+                pl = ast.find_annotation(rt.app.annotations,
+                                         "app:devicePipeline")
                 return attach_table_writer(rt, FilterProjectPlan(
                     name, schema, inp.alias, filters, q.selector, rt.strings,
                     target, q.selector.limit, q.selector.offset,
-                    events_for=q.output.events_for), q, name)
+                    events_for=q.output.events_for,
+                    pipeline_depth=int(pl.element()) if pl else 0), q, name)
             except PlanError:
                 raise
             except Exception:

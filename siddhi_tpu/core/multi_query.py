@@ -250,6 +250,9 @@ class MultiQueryDevicePatternPlan:
 
     # -- QueryPlan surface -------------------------------------------------
 
+    def flush_pending(self):
+        return []
+
     def process(self, stream_id, batch):
         return self.inner.process(stream_id, batch)
 

@@ -1028,6 +1028,11 @@ class NFAKernel:
             if n.pre_key is not None:
                 m = m & x[n.pre_key]
             ok = ok | m
+        cs = x.get("__can_start__")
+        if cs is not None:
+            # chunked-halo mode: halo events extend pending matches but
+            # never arm new heads (the lane that OWNS the event arms it)
+            ok = ok & cs
         return ok
 
     def _alloc_head(self, x, head: Position, hot, occ, cnt, cnt_on, narm,
@@ -1183,7 +1188,7 @@ class NFAKernel:
         key = (T, M)
         fn = self._block_cache.get(key)
         if fn is None:
-            fn = self._block_cache[key] = jax.jit(self._make_block(M))
+            fn = self._block_cache[key] = jax.jit(self._make_block(M, T))
         return fn
 
     def _pre_masks(self, ev: dict) -> dict:
@@ -1214,15 +1219,55 @@ class NFAKernel:
                 m, (ev["__ts__"].shape[0], self.P))
         return out
 
-    def _make_block(self, M: int) -> Callable:
+    def _make_block(self, M: int, T: Optional[int] = None) -> Callable:
         def block(state, ev):
             with compute_dtypes(self._mode):
-                return self._block_impl(state, ev, M)
+                return self._block_impl(state, ev, M, T)
         return block
 
-    def _block_impl(self, state, ev, M: int):
+    def _chunk_dedup_row(self) -> int:
+        """Row index (within the packed lane grid, after the lv row) of
+        __comp_seq__ — used to suppress replayed-tail completions on
+        device so they never cross the tunnel."""
+        return 1 + self._ilane_names().index("__comp_seq__")
+
+    def _expand_flat(self, ev: dict, T: int) -> dict:
+        """Chunked-halo mode: the host ships events once as flat (F,)
+        arrays; lane grids are gathered ON DEVICE (lane l reads events
+        [l*CS, l*CS + T)), so the tunnel never carries the halo-duplicated
+        (T, P) grids.  `__can_start__` marks each lane's OWN range (the
+        first CS steps); trailing reads past the event count are invalid
+        cells.  Events past a lane's halo are harmless: `within` expires
+        every owned instance before they could matter (pattern_plan sizes
+        T to cover the worst-case halo)."""
+        P = self.P
+        cs = ev["__cs__"].astype(_I32)          # own-chunk length
+        nev = ev["__nev__"].astype(_I32)        # flat event count
+        lane = jnp.arange(P, dtype=_I32)[None, :]
+        t = jnp.arange(T, dtype=_I32)[:, None]
+        idx = lane * cs + t                     # (T, P) global positions
+        F = ev["__flat.__ts__"].shape[0]
+        safe = jnp.clip(idx, 0, F - 1)
+        out = {}
+        for k, v in ev.items():
+            if k.startswith("__flat."):
+                out[k[len("__flat."):]] = v[safe]
+        if "__seq__" not in out:
+            # single-stream flushes have consecutive seqs: derive instead
+            # of shipping another (F,) array through the tunnel
+            out["__seq__"] = ev["__seq0__"].astype(_I32) + idx
+        out["__valid__"] = idx < nev
+        out["__can_start__"] = jnp.broadcast_to(t < cs, (T, P))
+        out["__base_ts__"] = ev["__base_ts__"]
+        out["__base_seq__"] = ev["__base_seq__"]
+        return out
+
+    def _block_impl(self, state, ev, M: int, T_static: Optional[int] = None):
         spec = self.spec
         ev = dict(ev)
+        prev_seq = ev.pop("__prev_seq__", None)
+        if "__cs__" in ev:
+            ev = self._expand_flat(ev, T_static)
         ev.update(self._pre_masks(ev))
         base_ts = ev["__base_ts__"]
         xs = {k: v for k, v in ev.items()
@@ -1255,6 +1300,12 @@ class NFAKernel:
         ys_i = ys["i"]                        # (T', Ci, E, P) i32
         ys_f = ys.get("f")                    # (T', Cf, E, P) f32
         lv = ys_i[:, 0].reshape(-1) != 0      # (T'*E*P,)
+        if prev_seq is not None:
+            # chunked-halo replay: completions at or before the previous
+            # flush's last seq already emitted — drop them BEFORE the
+            # compaction so they never occupy the M buffer or the tunnel
+            lv = lv & (ys_i[:, self._chunk_dedup_row()].reshape(-1)
+                       > prev_seq.astype(_I32))
         pos = jnp.cumsum(lv.astype(_I32), dtype=_I32) - lv
         n = pos[-1] + lv[-1]
         wpos = jnp.where(lv & (pos < M), pos, M)

@@ -43,6 +43,15 @@ def _m_bucket(n: int) -> int:
     return -(-n // 16384) * 16384
 
 
+def _m_bucket_chunk(n: int) -> int:
+    """Chunked-flat blocks compile ~10s each through the tunnel: coarse
+    64K buckets keep M stable flush-to-flush (a 16K-granular bucket
+    recompiled whenever the match count drifted past the last bucket)."""
+    if n <= 16384:
+        return pow2_at_least(n, lo=16)
+    return -(-n // 65536) * 65536
+
+
 class DevicePatternPlan(QueryPlan):
     """from [every] e1=A[...] -> e2=B[...] within T — batched device NFA."""
 
@@ -157,6 +166,40 @@ class DevicePatternPlan(QueryPlan):
         self._last_seq = 0
         self._buffered: list = []   # (stream_id, EventBatch)
         self._scode = {sid: i for i, sid in enumerate(self.spec.stream_ids)}
+
+        # chunked-halo mode: a within-bounded every-head pattern with no
+        # partition key has P=1, which starves the lane axis (the scan is
+        # fully sequential).  Because every pending instance dies within W
+        # of its head, the event sequence can be split into K own-chunks
+        # processed by K parallel lanes, each reading through a halo of
+        # the events within W after its chunk; heads only arm on OWNED
+        # events (`__can_start__`), so every match is found exactly once.
+        # Cross-flush continuity: the last W of events replays at the next
+        # flush, and completions at or before the previous flush's last
+        # seq are dropped (they already emitted).  Blocks are stateless —
+        # device state never persists, so there is nothing to rebase.
+        self._chunk_cfg = None
+        if (not broadcast_events and part_key_fns is None
+                and self.P == 1 and self.mesh is None
+                and self.spec.every_head and not self.kernel.has_absent
+                and all(p.within_ms is not None for p in self.spec.positions)):
+            lanes_ann = ast.find_annotation(rt.app.annotations,
+                                            "app:deviceChunkLanes")
+            lanes = int(lanes_ann.element()) if lanes_ann is not None else 64
+            if lanes > 1:
+                self._chunk_cfg = {
+                    "W": max(p.within_ms for p in self.spec.positions),
+                    "lanes": lanes}
+                self._tail: Optional[dict] = None   # replayed raw events
+                self._prev_last_seq = -1
+                self._chunk_A = slots
+                self._chunk_E: Optional[int] = None
+                self._kern_by_p: dict = {}
+                self._of_dropped = 0
+                self._chunk_inflight: list = []
+                pl = ast.find_annotation(rt.app.annotations,
+                                         "app:devicePipeline")
+                self.pipeline_depth = int(pl.element()) if pl else 0
         # device grids shipped per block: only attrs some predicate or
         # capture row reads, per scode
         self._grid_attrs: list = sorted(self._needed_grid_attrs())
@@ -231,7 +274,9 @@ class DevicePatternPlan(QueryPlan):
     def dropped(self) -> int:
         """Partial matches / emissions lost to capacity exhaustion — only
         possible once adaptive growth hits the A_CAP ceiling.  Carried in
-        device state, so snapshot-safe."""
+        device state (host-side counter in chunked mode), so snapshot-safe."""
+        if self._chunk_cfg is not None:
+            return self._of_dropped
         return int(np.asarray(self.state["of_slots"]).sum())
 
     def part_of(self, stream_id: str, batch: EventBatch) -> np.ndarray:
@@ -366,6 +411,8 @@ class DevicePatternPlan(QueryPlan):
         ts, seq, scode, part = ts[order], seq[order], scode[order], part[order]
         for k in cols:
             cols[k] = cols[k][order]
+        if self._chunk_cfg is not None:
+            return self._run_chunked_flat(ts, seq, scode, cols)
         if self.broadcast_events:
             idx_within = np.arange(N, dtype=np.int64)
             part = np.zeros(N, dtype=_I32)
@@ -510,6 +557,173 @@ class DevicePatternPlan(QueryPlan):
             i = restart
         return results
 
+    # -- chunked-halo execution (stateless, within-bounded patterns) -----
+
+    def _chunk_kernel(self, K: int) -> NFAKernel:
+        kern = self._kern_by_p.get(K)
+        if kern is None or kern.A != self._chunk_A \
+                or (self._chunk_E is not None and kern.E != self._chunk_E):
+            kern = NFAKernel(self.spec, self.kernel.sel_fns,
+                             self.kernel.having, K, self._chunk_A,
+                             self._chunk_E, f64=self.f64,
+                             playback=self.rt._playback)
+            self._kern_by_p[K] = kern
+        return kern
+
+    def _run_chunked_flat(self, ts, seq, scode, cols) -> list:
+        """One stateless flat block per flush: [replayed tail | new events]
+        split into K own-chunks, gathered into lanes on device.  Blocks
+        carry no device state, so flushes pipeline independently
+        (@app:devicePipeline) and retries are self-contained."""
+        cfg = self._chunk_cfg
+        W = int(cfg["W"])
+        if self._tail is not None:
+            ts = np.concatenate([self._tail["ts"], ts])
+            seq = np.concatenate([self._tail["seq"], seq])
+            scode = np.concatenate([self._tail["scode"], scode])
+            cols = {k: np.concatenate([self._tail["cols"][k], v])
+                    for k, v in cols.items()}
+        N = len(ts)
+        ts_mono = np.maximum.accumulate(ts)
+        # `within` compares RAW event timestamps, but halo/tail bounds
+        # search the running max — a regressed (out-of-order) timestamp
+        # could place a still-completable event past the searched bound.
+        # Widening the window by the worst regression keeps every such
+        # event inside the halo/tail (over-covering is harmless).
+        W = W + int(np.max(ts_mono - ts)) if N else W
+
+        # lane geometry: halo-dominated data (few events per W) gets
+        # fewer, longer chunks; K buckets to pow2 so kernels are reused
+        def _halo(K: int):
+            CS = -(-N // K)
+            ends = np.unique(np.minimum(np.arange(1, K + 1) * CS, N))
+            ends = ends[ends > 0]
+            to = np.searchsorted(ts_mono, ts_mono[ends - 1] + W, side="right")
+            return CS, int(np.max(to - ends))
+        K = min(int(cfg["lanes"]), max(1, N))
+        CS, H = _halo(K)
+        if CS < H:
+            K = pow2_at_least(max(1, N // max(H, 1)), lo=1)
+            K = min(K, int(cfg["lanes"]))
+            CS, H = _halo(K)
+        T = pow2_at_least(CS + H)
+
+        # fresh i32 bases every flush (no persistent device state)
+        ts_base = int(ts_mono[0])
+        seq_base = int(seq[0])
+        ts32 = np.clip(ts - ts_base, -LOCAL_SPAN, LOCAL_SPAN).astype(_I32)
+        self._last_seq = max(self._last_seq, int(seq[-1]))
+        # completions at or before the previous flush's last seq are
+        # replays — suppressed ON DEVICE so they never cross the tunnel
+        prev_off = np.int32(np.clip(self._prev_last_seq - seq_base,
+                                    -LOCAL_SPAN, LOCAL_SPAN))
+
+        # flat-buffer capacity: fine-granular bucket + one granule of
+        # headroom, STICKY per plan — the replay tail appearing after
+        # flush 1 (or drifting in size) must not change F, because every
+        # distinct F is a ~10s recompile through the tunnel.  Shrinks only
+        # when the flush size drops 4x (batch regime change).
+        f_min = (N // 2048 + 2) * 2048
+        F = max(getattr(self, "_chunk_F", 0), f_min)
+        if F > 4 * f_min:
+            F = f_min
+        self._chunk_F = F
+
+        def pad(a):
+            out = np.zeros(F, dtype=a.dtype)
+            out[:N] = a
+            return out
+        ev = {"__flat.__ts__": pad(ts32),
+              "__cs__": np.int32(CS), "__nev__": np.int32(N),
+              "__prev_seq__": prev_off,
+              "__base_ts__": np.int64(ts_base),
+              "__base_seq__": np.int64(seq_base)}
+        if seq[-1] - seq[0] == N - 1:
+            # consecutive seqs derive on device from one scalar
+            ev["__seq0__"] = np.int32(0)
+        else:
+            ev["__flat.__seq__"] = pad(
+                np.clip(seq - seq_base, -LOCAL_SPAN, LOCAL_SPAN).astype(_I32))
+        if len(self.spec.stream_ids) > 1:
+            ev["__flat.__scode__"] = pad(scode)
+        for k, v in cols.items():
+            ev[f"__flat.{k}"] = pad(v)
+
+        last_ts = int(ts_mono[-1])
+        keep = ts_mono >= last_ts - W
+        self._tail = {"ts": ts[keep], "seq": seq[keep],
+                      "scode": scode[keep],
+                      "cols": {k: v[keep] for k, v in cols.items()}}
+        self._prev_last_seq = int(seq[-1])
+
+        # M sizing: the first flush guesses from N (could retry once);
+        # after that the hint PINS it — an N-based floor would drift
+        # across 64K buckets as the replay tail varies, and every drift
+        # is a ~10s recompile through the tunnel
+        M = (self._m_hint if self._m_hint >= 16384
+             else max(self._m_hint, _m_bucket_chunk(N)))
+        self._chunk_inflight.append(self._dispatch_chunk(
+            ev, K, T, M, ts_base, seq_base))
+        out: list = []
+        while len(self._chunk_inflight) > self.pipeline_depth:
+            out.append(self._materialize_chunk(self._chunk_inflight.pop(0)))
+        return out
+
+    def _dispatch_chunk(self, ev, K, T, M, ts_base, seq_base) -> dict:
+        kern = self._chunk_kernel(K)
+        fn = kern.block_fn(T, M)
+        _st, out = fn(kern.init_state(), ev)
+        for key in ("i", "f"):
+            if key in out:
+                try:    # start the D2H pull while the device computes
+                    out[key].copy_to_host_async()
+                except Exception:
+                    pass
+        return {"ev": ev, "K": K, "T": T, "M": M, "out": out,
+                "ts_base": ts_base, "seq_base": seq_base}
+
+    def _materialize_chunk(self, e: dict):
+        while True:
+            ipack = np.asarray(e["out"]["i"])
+            fpack = np.asarray(e["out"]["f"]) if "f" in e["out"] else None
+            n, ofs, ofl = (int(ipack[0, 0]), int(ipack[0, 1]),
+                           int(ipack[0, 2]))
+            if n > e["M"]:
+                e = self._dispatch_chunk(e["ev"], e["K"], e["T"],
+                                         _m_bucket_chunk(n),
+                                         e["ts_base"], e["seq_base"])
+                continue
+            if ofs > 0 and self._chunk_A < self.A_CAP:
+                self._chunk_A = min(2 * self._chunk_A, self.A_CAP)
+                e = self._dispatch_chunk(e["ev"], e["K"], e["T"], e["M"],
+                                         e["ts_base"], e["seq_base"])
+                continue
+            if ofl > 0:
+                self._chunk_E = 2 * self._kern_by_p[e["K"]].E
+                e = self._dispatch_chunk(e["ev"], e["K"], e["T"], e["M"],
+                                         e["ts_base"], e["seq_base"])
+                continue
+            if ofs > 0:
+                import warnings
+                self._of_dropped += ofs
+                warnings.warn(
+                    f"pattern {self.name!r}: pending-match slots hit the "
+                    f"deviceSlotCap ceiling ({self.A_CAP}); {ofs} partial "
+                    f"matches dropped this flush (raise @app:deviceSlotCap)",
+                    RuntimeWarning, stacklevel=2)
+            break
+        self._m_hint = max(self._m_hint, e["M"])
+        # bases are per-flush: _unpack_block must see THIS entry's
+        self._ts_base, self._seq_base = e["ts_base"], e["seq_base"]
+        return self._unpack_block(ipack, fpack, n)
+
+    def flush_pending(self) -> list:
+        if self._chunk_cfg is None or not getattr(self, "_chunk_inflight", None):
+            return []
+        chunks = [self._materialize_chunk(e) for e in self._chunk_inflight]
+        self._chunk_inflight = []
+        return self._rows_to_batches(chunks)
+
     def _unpack_block(self, ipack, fpack, n: int):
         """Columnar match table from one block's packed output."""
         if self.kernel.having is not None:
@@ -646,10 +860,17 @@ class DevicePatternPlan(QueryPlan):
 
     def state_dict(self) -> dict:
         st = jax.tree_util.tree_map(np.asarray, self.state)
-        return {"state": st, "key_to_part": dict(self._key_to_part),
-                "ts_base": self._ts_base, "seq_base": self._seq_base,
-                "next_deadline": self._next_deadline,
-                "last_seq": self._last_seq}
+        d = {"state": st, "key_to_part": dict(self._key_to_part),
+             "ts_base": self._ts_base, "seq_base": self._seq_base,
+             "next_deadline": self._next_deadline,
+             "last_seq": self._last_seq}
+        if self._chunk_cfg is not None:
+            # chunked mode keeps no device state: continuity lives in the
+            # replayed tail + the last-emitted completion seq
+            d["chunk_tail"] = self._tail
+            d["chunk_prev_last_seq"] = self._prev_last_seq
+            d["chunk_of_dropped"] = self._of_dropped
+        return d
 
     def load_state_dict(self, d: dict) -> None:
         import jax.numpy as jnp
@@ -700,3 +921,7 @@ class DevicePatternPlan(QueryPlan):
                                    else self._ts_base + dlm)
         else:
             self._next_deadline = None
+        if self._chunk_cfg is not None and "chunk_prev_last_seq" in d:
+            self._tail = d.get("chunk_tail")
+            self._prev_last_seq = int(d["chunk_prev_last_seq"])
+            self._of_dropped = int(d.get("chunk_of_dropped", 0))

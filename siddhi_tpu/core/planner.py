@@ -138,6 +138,12 @@ class QueryPlan:
         """Next timestamp (ms) this plan needs a timer callback, or None."""
         return None
 
+    def flush_pending(self) -> list:
+        """Deliver any device results still in flight (pipelined plans
+        defer materialization by up to @app:devicePipeline batches); the
+        runtime calls this at its flush barrier."""
+        return []
+
     def finalize(self) -> list:
         """Called when a drain round settles; multi-input plans flush their
         seq-merged buffers here. Returns OutputBatches."""
@@ -166,8 +172,11 @@ class FilterProjectPlan(QueryPlan):
                  filters: list, selector: ast.Selector,
                  strings: StringTable, output_target: Optional[str],
                  limit: Optional[int] = None, offset: Optional[int] = None,
-                 events_for: ast.OutputEventsFor = ast.OutputEventsFor.CURRENT):
+                 events_for: ast.OutputEventsFor = ast.OutputEventsFor.CURRENT,
+                 pipeline_depth: int = 0):
         self.name = name
+        self.pipeline_depth = pipeline_depth
+        self._inflight: list = []
         # a stateless query never expires events; `insert expired events into`
         # therefore emits nothing (matches reference semantics)
         self.emits_nothing = events_for == ast.OutputEventsFor.EXPIRED
@@ -201,7 +210,16 @@ class FilterProjectPlan(QueryPlan):
                 for nm, col, pt in zip(sel.names, outs, sel.passthrough):
                     henv[nm] = env[pt] if pt is not None else col
                 mask = mask & sel.having.fn(henv)
-            return mask, [o for o in outs if o is not None]
+            # the mask travels bit-packed: the tunnel pays per byte, and
+            # the bool row is 8x the packed words
+            pad = -(-n // 32) * 32
+            if pad != n:
+                mask = jnp.concatenate([mask, jnp.zeros(pad - n, bool)])
+            words = (mask.reshape(-1, 32).astype(jnp.uint32)
+                     << jnp.arange(32, dtype=jnp.uint32)[None, :]) \
+                .sum(axis=1).astype(jnp.uint32)   # sum may promote to u64
+            return jax.lax.bitcast_convert_type(words, jnp.int32), \
+                [o for o in outs if o is not None]
         return step
 
     def process(self, stream_id: str, batch: EventBatch) -> list:
@@ -210,8 +228,29 @@ class FilterProjectPlan(QueryPlan):
         host_env = {a.name: batch.columns[a.name] for a in self.in_schema.attributes}
         env = {k: v for k, v in host_env.items() if v.dtype != np.dtype(object)}
         env["__timestamp__"] = host_env["__timestamp__"] = batch.timestamps
-        mask, outs = self._step(env)
-        mask = np.asarray(mask)
+        mask_w, outs = self._step(env)
+        for a in [mask_w] + list(outs):
+            try:        # start D2H pulls early; materialization may defer
+                a.copy_to_host_async()
+            except Exception:
+                pass
+        self._inflight.append((mask_w, outs, host_env, batch))
+        results: list = []
+        while len(self._inflight) > self.pipeline_depth:
+            results.extend(self._materialize(*self._inflight.pop(0)))
+        return results
+
+    def flush_pending(self) -> list:
+        results: list = []
+        while self._inflight:
+            results.extend(self._materialize(*self._inflight.pop(0)))
+        return results
+
+    def _materialize(self, mask_w, outs, host_env, batch) -> list:
+        words = np.asarray(mask_w)
+        mask = ((words.view(np.uint32)[:, None]
+                 >> np.arange(32, dtype=np.uint32)) & 1
+                ).astype(bool).reshape(-1)[:batch.n]
         if not mask.any():
             return []
         ts = batch.timestamps[mask]

@@ -111,6 +111,15 @@ class SiddhiAppRuntime:
         # overlaps device execution
         self._async = qast.find_annotation(app.annotations, "app:async") \
             is not None
+        # auto-batching to a latency target: builders flush when their
+        # oldest buffered event has waited this long, so micro-batch size
+        # adapts to the event rate instead of always filling batchCapacity
+        # (the latency/throughput knob; cf. reference harness latency in
+        # SimpleFilterSingleQueryPerformance.java:40-77)
+        mbl = qast.find_annotation(app.annotations, "app:maxBatchLatency")
+        self.max_batch_latency_s = (_parse_interval_s(mbl.element())
+                                    if mbl is not None else None)
+        self._builder_t0: dict = {}     # stream -> first-append wall time
 
         # stream schemas: defined + inferred from query outputs
         self.schemas: dict = {}
@@ -306,10 +315,33 @@ class SiddhiAppRuntime:
             return
         self._sched_stop = threading.Event()
 
+        tick = 0.02
+        if self.max_batch_latency_s is not None:
+            tick = min(tick, max(self.max_batch_latency_s / 2, 0.001))
+
         def pump():
-            while not self._sched_stop.wait(0.02):
+            while not self._sched_stop.wait(tick):
                 with self._lock:
-                    if self._clock_ms is not None:
+                    virtual = self._clock_ms is not None
+                    if not virtual and self.max_batch_latency_s is not None:
+                        # age-out partially filled builders (quiescent
+                        # streams would otherwise hold events past the
+                        # latency target until the next send).  In async
+                        # mode aged batches MUST ride the ingest queue —
+                        # draining them here would jump ahead of earlier
+                        # batches the worker hasn't popped yet.
+                        now_w = time.perf_counter()
+                        for sid, b in self._builders.items():
+                            if len(b) and now_w - self._builder_t0.get(
+                                    sid, 0.0) >= self.max_batch_latency_s:
+                                frozen = b.freeze_and_clear()
+                                if self._async and self._ingest_q is not None:
+                                    self._async_outbox.append((sid, frozen))
+                                else:
+                                    self._pending.append((sid, frozen))
+                        if self._pending:
+                            self._drain()
+                    if virtual:
                         continue            # virtual clock took over
                     due = [w for p in self._plans
                            for w in [p.next_wakeup()] if w is not None]
@@ -317,6 +349,7 @@ class SiddhiAppRuntime:
                     if due and min(due) <= now:
                         self._fire_timers(now)
                         self._clock_ms = None    # stay in wall-clock mode
+                self._drain_async_outbox()      # outside the lock
                 self._flush_sink_outbox()
 
         self._sched_thread = threading.Thread(
@@ -337,7 +370,9 @@ class SiddhiAppRuntime:
                 if len(self._store_cache) >= 64:   # bounded like the
                     # reference's LRU (SiddhiAppRuntime.java:286)
                     self._store_cache.pop(next(iter(self._store_cache)))
-                exec_ = StoreQueryExec(self, parse_store_query(text))
+                from ..interp.expr import udf_scope
+                with udf_scope(getattr(self, "udfs", None)):
+                    exec_ = StoreQueryExec(self, parse_store_query(text))
                 self._store_cache[text] = exec_
             else:
                 self._store_cache[text] = self._store_cache.pop(text)  # LRU touch
@@ -478,6 +513,8 @@ class SiddhiAppRuntime:
             self._seq += 1
             return self._seq
 
+        if self.max_batch_latency_s is not None and not len(b):
+            self._builder_t0[stream_id] = time.perf_counter()
         if isinstance(data, Event):
             b.append(advance(data.timestamp if timestamp is None else timestamp),
                      data.data, nseq())
@@ -493,7 +530,10 @@ class SiddhiAppRuntime:
             if timestamp is not None:
                 advance(ts)
             b.append(ts, tuple(data), nseq())
-        if b.full:
+        due = (self.max_batch_latency_s is not None and len(b)
+               and time.perf_counter() - self._builder_t0.get(stream_id, 0.0)
+               >= self.max_batch_latency_s)
+        if b.full or due:
             if self._async and self._ingest_q is not None:
                 # stage; the public entry enqueues AFTER releasing the lock
                 # (a blocking put under the lock would deadlock against the
@@ -513,13 +553,36 @@ class SiddhiAppRuntime:
         callers use _async_barrier() before locking."""
         if self._async and self._ingest_q is not None:
             self._async_barrier()
+            with self._lock:
+                self._flush_plan_pipelines()
+            self._flush_sink_outbox()
             return
         with self._lock:
             for sid, b in self._builders.items():
                 if len(b):
                     self._pending.append((sid, b.freeze_and_clear()))
             self._drain()
+            self._flush_plan_pipelines()
         self._flush_sink_outbox()
+
+    def _flush_plan_pipelines(self) -> None:
+        """Materialize device results still in flight in pipelined plans
+        (@app:devicePipeline defers output delivery by up to D batches);
+        flush() is the barrier where every produced event is delivered."""
+        guard = 0
+        while True:
+            guard += 1
+            if guard > 100_000:     # same bound as _drain: an insert-into
+                raise RuntimeError(  # cycle through a pipelined plan
+                    "runaway stream recursion (insert-into cycle?)")
+            progressed = False
+            for plan in self._plans:
+                for ob in plan.flush_pending():
+                    self._emit(plan, ob)
+                    progressed = True
+            if not progressed and not self._pending:
+                return
+            self._drain()
 
     def _async_barrier(self) -> None:
         import queue as _queue
@@ -711,6 +774,10 @@ class SiddhiAppRuntime:
             "plans": {p.name: p.state_dict() for p in self._plans},
             "tables": {k: t.state_dict() for k, t in self.tables.items()},
             "clock": self._clock_ms,
+            # the global arrival counter must survive: plans order and
+            # dedup by seq (chunked replay compares against the last
+            # emitted completion seq — a restarted counter re-suppresses)
+            "seq": self._seq,
         }
 
     def restore(self, snap: dict) -> None:
@@ -726,6 +793,8 @@ class SiddhiAppRuntime:
             if k in self.tables:
                 self.tables[k].load_state_dict(st)
         self._clock_ms = snap.get("clock")
+        if snap.get("seq") is not None:
+            self._seq = max(self._seq, int(snap["seq"]))
 
     def persist(self, incremental: bool = False,
                 asynchronous: bool = False) -> str:

@@ -167,6 +167,31 @@ def _seg_running_minmax(seg, v, is_max, n):
     return run[jnp.searchsorted(ks, key)]
 
 
+# monotone-segment variants: when segment ids are nondecreasing in arrival
+# order (no group-by, or bucket-only keys) the sort is a no-op — skip it
+
+def _mono_running_sum(seg, v):
+    n = seg.shape[0]
+    cs = jnp.cumsum(v)
+    is_start = jnp.concatenate([jnp.array([True]), seg[1:] != seg[:-1]])
+    start_idx = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(is_start, jnp.arange(n), 0))
+    base = jnp.where(start_idx > 0, cs[jnp.maximum(start_idx - 1, 0)], 0.0)
+    return cs - base
+
+
+def _mono_running_minmax(seg, v, is_max):
+    is_start = jnp.concatenate([jnp.array([True]), seg[1:] != seg[:-1]])
+    op = jnp.maximum if is_max else jnp.minimum
+
+    def comb(a, b):
+        af, av = a
+        bf, bv = b
+        return (af | bf, jnp.where(bf, bv, op(av, bv)))
+    _f, run = jax.lax.associative_scan(comb, (is_start, v))
+    return run
+
+
 # ---------------------------------------------------------------------------
 # plan
 # ---------------------------------------------------------------------------
@@ -351,6 +376,10 @@ class DeviceWindowAggPlan(QueryPlan):
             raise DeviceWindowUnsupported(f"unresolved columns {unknown}")
         self.cols = sorted(k for k in reads if k in schema.types)
 
+        pl = ast.find_annotation(rt.app.annotations, "app:devicePipeline")
+        self.pipeline_depth = int(pl.element()) if pl is not None else 0
+        self._inflight: list = []
+
         self.state = self._init_state()
         jax.eval_shape(self._step_fn(8, self.C), self.state, self._dummy(8))
 
@@ -489,8 +518,17 @@ class DeviceWindowAggPlan(QueryPlan):
                 left = jnp.searchsorted(vcnt, want, side="right")
             else:
                 left = jnp.searchsorted(all_ts, all_ts - D, side="right")
-            seg = group_seg(env_all, all_valid, N)
+            seg = group_seg(env_all, all_valid, N) if group_keys else None
             vals = site_vals(env_all, N)
+
+            def wsum(v):
+                """Windowed sum over [left, gpos] — per-group via the
+                segmented machinery, else one prefix-difference (no sort)."""
+                if group_keys:
+                    return _seg_window_sum(seg, v, left, gpos, N)
+                c = jnp.cumsum(v)
+                before = jnp.where(left > 0, c[jnp.maximum(left - 1, 0)], 0.0)
+                return c - before
 
             aggs_full = []
             for i, (nm, _arg, _ot) in enumerate(sites):
@@ -503,11 +541,9 @@ class DeviceWindowAggPlan(QueryPlan):
                     continue
                 v = (all_valid.astype(FDT) if nm == "count"
                      else jnp.where(all_valid, vals[i], 0.0))
-                s = _seg_window_sum(seg, v, left, gpos, N)
+                s = wsum(v)
                 if nm == "avg":
-                    c1 = _seg_window_sum(seg, all_valid.astype(FDT), left,
-                                         gpos, N)
-                    s = s / jnp.maximum(c1, 1.0)
+                    s = s / jnp.maximum(wsum(all_valid.astype(FDT)), 1.0)
                 aggs_full.append(s)
 
             # rows align with the compacted batch part (raw timestamps:
@@ -547,23 +583,36 @@ class DeviceWindowAggPlan(QueryPlan):
             vrank = jnp.cumsum(all_valid.astype(jnp.int64)) - 1
             gidx = base + vrank
             brel = jnp.where(all_valid, (gidx - base) // L, -1)
-            seg = group_seg(env_all, all_valid, N)
-            segb = jnp.where(all_valid, brel * (N + 1) + seg,
-                             jnp.int64((N + 2) * (N + 1)))
+            if group_keys:
+                seg = group_seg(env_all, all_valid, N)
+                segb = jnp.where(all_valid, brel * (N + 1) + seg,
+                                 jnp.int64((N + 2) * (N + 1)))
+            else:
+                segb = None
             vals = site_vals(env_all, N)
+            # no group-by: bucket ids are nondecreasing over [carry | batch]
+            # (the carry holds only the lowest incomplete bucket), so the
+            # sort inside the segmented scans is a no-op — skip it
+            rsum = ((lambda s_, v_: _mono_running_sum(s_, v_))
+                    if not group_keys else
+                    (lambda s_, v_: _seg_running_sum(s_, v_, N)))
+            rmm = ((lambda s_, v_, mx: _mono_running_minmax(s_, v_, mx))
+                   if not group_keys else
+                   (lambda s_, v_, mx: _seg_running_minmax(s_, v_, mx, N)))
+            segk = brel if not group_keys else segb
             aggs = []
             for i, (nm, _arg, _ot) in enumerate(sites):
                 if nm in ("min", "max"):
                     neutral = NEG if nm == "max" else POS
                     vv = jnp.where(all_valid, vals[i], neutral)
-                    aggs.append(_seg_running_minmax(segb, vv, nm == "max", N))
+                    aggs.append(rmm(segk, vv, nm == "max"))
                 else:
                     v = (all_valid.astype(FDT) if nm == "count"
                          else jnp.where(all_valid, vals[i], 0.0))
-                    s = _seg_running_sum(segb, v, N)
+                    s = rsum(segk, v)
                     if nm == "avg":
-                        c1 = _seg_running_sum(segb, all_valid.astype(FDT), N)
-                        s = s / jnp.maximum(c1, 1.0)
+                        s = s / jnp.maximum(rsum(segk, all_valid.astype(FDT)),
+                                            1.0)
                     aggs.append(s)
             total = base + jnp.sum(all_valid)
             completed = (total // L) * L
@@ -599,21 +648,45 @@ class DeviceWindowAggPlan(QueryPlan):
                     res = step_lengthbatch(state, bts, bvalid, bcols, k)
                 else:
                     res = step_sliding(state, bts, bvalid, bcols, k)
-                return pack(res)
+                return pack(res, mask, k)
 
-        def pack(res):
-            """ONE i32 output matrix (+ separate f64 pack only in f64
-            mode): ~100ms fixed latency per device->host pull through the
-            tunnel, so outputs travel together.  Row 0 = [overflow, ...],
-            row 1 = ok, rows 2-3 = ts hi/lo, then the out columns (f32
-            bitcast, i64 as hi/lo pairs, i32/bool as-is)."""
+        def bits32(m):
+            """(T,) bool -> (ceil(T/32),) i32 word stream, little-bit order."""
+            n_ = m.shape[0]
+            padded = -(-n_ // 32) * 32
+            if padded != n_:
+                m = jnp.concatenate([m, jnp.zeros(padded - n_, bool)])
+            r = m.reshape(-1, 32).astype(jnp.uint32)
+            w = (r << jnp.arange(32, dtype=jnp.uint32)[None, :]) \
+                .sum(axis=1).astype(jnp.uint32)   # sum may promote to u64
+            return jax.lax.bitcast_convert_type(w, jnp.int32)
+
+        slim = kind != "lengthbatch"
+        has_filter = filt is not None
+
+        def pack(res, mask, k):
+            """Outputs travel in as few bytes as possible — every
+            device->host pull through the tunnel pays ~100 ms fixed plus
+            per-byte cost.  Sliding kinds are `slim`: row timestamps equal
+            the (filter-compacted) input timestamps, which the host already
+            holds, so only a small `b` vector ([overflow, k] + bit-packed
+            masks when needed) plus the out columns travel.  lengthBatch
+            rows can emit carried (previous-batch) events, so it keeps the
+            full layout: [overflow]+ok+ts hi/lo rows ahead of the columns."""
             nst, outs, row_ok, row_ts, overflow = res
             n = row_ok.shape[0]
-            meta = jnp.zeros((n,), jnp.int32).at[0].set(overflow)
-            row_ts = row_ts.astype(jnp.int64)
-            irows = [meta, row_ok.astype(jnp.int32),
-                     _w_hi32(row_ts), _w_lo32(row_ts)]
-            frows = []
+            irows, frows = [], []
+            if slim:
+                bparts = [jnp.stack([overflow, k]).astype(jnp.int32)]
+                if has_filter:
+                    bparts.append(bits32(mask))
+                if having is not None:
+                    bparts.append(bits32(row_ok))
+            else:
+                meta = jnp.zeros((n,), jnp.int32).at[0].set(overflow)
+                row_ts = row_ts.astype(jnp.int64)
+                irows += [meta, row_ok.astype(jnp.int32),
+                          _w_hi32(row_ts), _w_lo32(row_ts)]
             # encode by DECLARED type so the host unpack (which switches on
             # the out schema) always reads the matching rows — the raw
             # device dtype may be widened (e.g. INT aggregates ride i64)
@@ -630,7 +703,11 @@ class DeviceWindowAggPlan(QueryPlan):
                     irows.append(_w_lo32(colv))
                 else:
                     irows.append(colv.astype(jnp.int32))
-            out = {"i": jnp.stack(irows, axis=0), "nst": nst}
+            out = {"nst": nst}
+            if irows:       # slim + f64 can route EVERY column to frows
+                out["i"] = jnp.stack(irows, axis=0)
+            if slim:
+                out["b"] = jnp.concatenate(bparts)
             if frows:
                 out["f"] = jnp.stack(frows, axis=0)
             return out
@@ -651,27 +728,92 @@ class DeviceWindowAggPlan(QueryPlan):
             if not self.f64 and col.dtype == np.float64:
                 col = col.astype(np.float32)     # device DOUBLE policy
             env[c] = _pad(col, T, 0)
-        while True:
-            fn = self._step_fn(T, self.C)
-            res = fn(self.state, env)
-            try:        # start the D2H pull while the device computes
-                res["i"].copy_to_host_async()
-            except Exception:
-                pass
-            ipack = np.asarray(res["i"])         # ONE pull (+f in f64 mode)
-            fpack = np.asarray(res["f"]) if "f" in res else None
-            if int(ipack[0, 0]):
-                self._grow(2 * self.C)
-                continue
-            break
+        self._inflight.append(self._dispatch(env, batch, T))
+        outs: list = []
+        # depth-D pipeline (opt-in @app:devicePipeline): batch i's pull
+        # overlaps batch i+1..i+D's upload+compute, hiding the tunnel's
+        # fixed D2H latency; outputs then deliver up to D batches late
+        # (the runtime flush barrier drains the tail)
+        while len(self._inflight) > self.pipeline_depth:
+            outs.extend(self._materialize(self._inflight.pop(0)))
+        return outs
+
+    def flush_pending(self) -> list:
+        outs: list = []
+        while self._inflight:
+            outs.extend(self._materialize(self._inflight.pop(0)))
+        return outs
+
+    def _dispatch(self, env: dict, batch: EventBatch, T: int) -> dict:
+        pre = self.state
+        fn = self._step_fn(T, self.C)
+        res = fn(self.state, env)
+        for key in ("b", "i", "f"):
+            if key in res:
+                try:    # start the D2H pull while the device computes
+                    res[key].copy_to_host_async()
+                except Exception:
+                    pass
         self.state = res["nst"]
-        ok = ipack[1] != 0
-        if not ok.any():
-            return []
+        return {"pre": pre, "env": env, "batch": batch, "T": T, "res": res}
+
+    def _materialize(self, entry: dict) -> list:
+        slim = self.kind != "lengthbatch"
+        bpack = None
+        while True:
+            res = entry["res"]
+            if slim:
+                bpack = np.asarray(res["b"])
+                overflow = int(bpack[0])
+            else:
+                overflow = int(np.asarray(res["i"])[0, 0])
+            if not overflow:
+                break
+            # carry overflow: grow C and replay this entry plus everything
+            # dispatched after it (their pre-states are now invalid)
+            chain = [entry] + self._inflight
+            self._inflight = []
+            self.state = entry["pre"]
+            self._grow(2 * self.C)
+            redone = [self._dispatch(e["env"], e["batch"], e["T"])
+                      for e in chain]
+            entry = redone[0]
+            self._inflight = redone[1:]
+        ipack = np.asarray(res["i"]) if "i" in res else None
+        fpack = np.asarray(res["f"]) if "f" in res else None
+        batch = entry["batch"]
+        T = entry["T"]
         from .nfa_device import join64_np
-        ts_out = join64_np(ipack[2], ipack[3])[ok].astype(TIMESTAMP_DTYPE)
+        if slim:
+            # sliding rows align with the (filter-compacted) input events:
+            # timestamps reconstruct host-side, only masks travel as bits
+            k = int(bpack[1])
+            off = 2
+            if self._filter is not None:
+                nw = -(-T // 32)
+                maskb = _unbits32(bpack[off:off + nw], T)[:batch.n]
+                off += nw
+                ts_rows = batch.timestamps[maskb]
+            else:
+                ts_rows = batch.timestamps
+            if self.having is not None:
+                nw = -(-T // 32)
+                valid = _unbits32(bpack[off:off + nw], T)[:k]
+            else:
+                valid = np.ones(k, dtype=bool)
+            if k == 0 or not valid.any():
+                return []
+            ts_out = ts_rows[:k][valid].astype(TIMESTAMP_DTYPE)
+            ii, fi = 0, 0
+            take = lambda col: col[:k][valid]
+        else:
+            ok = ipack[1] != 0
+            if not ok.any():
+                return []
+            ts_out = join64_np(ipack[2], ipack[3])[ok].astype(TIMESTAMP_DTYPE)
+            ii, fi = 4, 0
+            take = lambda col: col[ok]
         cols = {}
-        ii, fi = 4, 0
         for a in self.out_schema.attributes:
             dt = np.dtype(jnp_dtype(a.type)) if a.type != AttrType.DOUBLE \
                 else np.dtype(np.float64 if self.f64 else np.float32)
@@ -683,11 +825,11 @@ class DeviceWindowAggPlan(QueryPlan):
                 col = join64_np(ipack[ii], ipack[ii + 1]); ii += 2
             else:
                 col = ipack[ii]; ii += 1
-            v = col[ok]
+            v = take(col)
             if a.type == AttrType.BOOL:
                 v = v != 0
             cols[a.name] = v.astype(dtype_of(a.type))
-        out = EventBatch(self.out_schema, ts_out, cols, int(ok.sum()))
+        out = EventBatch(self.out_schema, ts_out, cols, len(ts_out))
         return [OutputBatch(self.output_target, out)]
 
     # -- snapshot -------------------------------------------------------------
@@ -700,7 +842,15 @@ class DeviceWindowAggPlan(QueryPlan):
         c = int(d.get("C", self.C))
         if c != self.C:
             self.C = c
+        self._inflight = []
         self.state = {k: jnp.asarray(v) for k, v in d["state"].items()}
+
+
+def _unbits32(words: np.ndarray, n: int) -> np.ndarray:
+    """Inverse of the device bits32 pack: i32 words -> (n,) bool."""
+    b = ((words.view(np.uint32)[:, None]
+          >> np.arange(32, dtype=np.uint32)) & 1).astype(bool)
+    return b.reshape(-1)[:n]
 
 
 from .nfa_device import _hi32 as _w_hi32, _lo32 as _w_lo32  # noqa: E402

@@ -1,0 +1,169 @@
+"""Chunked-halo device NFA: differential equality against the host
+matcher for within-bounded every-head patterns (the P=1 lane-starvation
+fix — pattern_plan._run_chunked_flat).
+
+The mode splits each flush into K own-chunks scanned by K parallel
+lanes; halo reads + `__can_start__` head masking keep every match found
+exactly once, and the replayed tail + completion-seq dedup keep
+cross-flush continuity.  These tests drive MANY small flushes so the
+replay path is exercised hard (reference semantics oracle:
+interp/nfa.py; scenario shapes after
+modules/siddhi-core/src/test/java/org/wso2/siddhi/core/query/pattern/)."""
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+HEAD = "define stream S (sym string, price double);\n@info(name='q') "
+
+QUERIES = {
+    "two_state": (
+        "from every e1=S[price > 100] -> e2=S[price > e1.price] within 1 sec "
+        "select e1.price as p1, e2.price as p2 insert into Out;"),
+    "three_state": (
+        "from every e1=S[price > 100] -> e2=S[price > e1.price] "
+        "-> e3=S[price > e2.price] within 2 sec "
+        "select e1.price as p1, e2.price as p2, e3.price as p3 "
+        "insert into Out;"),
+    "count": (
+        "from every e1=S[price > 110]<1:3> -> e2=S[price < 95] within 1 sec "
+        "select e1[0].price as a, e1[last].price as b, e2.price as c "
+        "insert into Out;"),
+    "logical_and": (
+        "from every e1=S[price > 120] -> e2=S[price < 100] and "
+        "e3=S[price > 125] within 1 sec "
+        "select e1.price as a, e2.price as b, e3.price as c insert into Out;"),
+    "logical_or": (
+        "from every e1=S[price > 120] -> e2=S[price < 92] or "
+        "e3=S[price > 127] within 1 sec "
+        "select e1.price as a, e2.price as b, e3.price as c insert into Out;"),
+    "sequence": (
+        "from every e1=S[price > 115], e2=S[price > e1.price] within 1 sec "
+        "select e1.price as a, e2.price as b insert into Out;"),
+    "head_count": (
+        "from every e1=S[price > 118]<2:4> within 1 sec "
+        "select e1[0].price as a, e1[1].price as b insert into Out;"),
+}
+
+
+def _run(head, q, n=1800, batches=6, seed=11, dt=9):
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(head + HEAD + q)
+    rows = []
+    rt.add_callback("Out", lambda evs: rows.extend(
+        (e.timestamp,
+         tuple(None if x is None else round(float(x), 3)
+               if isinstance(x, float) else x for x in e.data))
+        for e in evs))
+    rt.start()
+    plan = rt._plans[0]
+    chunked = getattr(plan, "_chunk_cfg", None) is not None
+    rng = np.random.default_rng(seed)
+    ih = rt.input_handler("S")
+    ts0 = 1_700_000_000_000
+    for b in range(batches):
+        for j in range(n // batches):
+            i = b * (n // batches) + j
+            ih.send((f"K{rng.integers(0, 4)}",
+                     float(np.round(rng.uniform(90, 130) * 4) / 4)),
+                    timestamp=ts0 + i * dt)
+        rt.flush()
+    mgr.shutdown()
+    return chunked, rows
+
+
+@pytest.mark.parametrize("name", list(QUERIES))
+def test_chunked_differential(name):
+    q = QUERIES[name]
+    chunked, dev = _run("@app:devicePatterns('always')\n", q)
+    _h, host = _run("@app:devicePatterns('never')\n", q)
+    assert chunked, f"{name}: chunked mode did not engage"
+    assert dev == host, (name, len(dev), len(host),
+                         list(set(dev) - set(host))[:3],
+                         list(set(host) - set(dev))[:3])
+
+
+def test_chunked_many_small_flushes():
+    """Replay-tail dedup across dozens of tiny flushes (every flush
+    overlaps the previous one's within-window)."""
+    q = QUERIES["two_state"]
+    chunked, dev = _run("@app:devicePatterns('always')\n", q,
+                        n=900, batches=30, dt=25, seed=5)
+    _h, host = _run("@app:devicePatterns('never')\n", q,
+                    n=900, batches=30, dt=25, seed=5)
+    assert chunked
+    assert dev == host
+
+
+def test_chunked_sparse_data_reduces_lanes():
+    """Halo-dominated data (few events per within-window) still matches:
+    the geometry collapses to fewer lanes rather than mis-matching."""
+    q = QUERIES["two_state"]
+    chunked, dev = _run("@app:devicePatterns('always')\n", q,
+                        n=300, batches=3, dt=400, seed=7)
+    _h, host = _run("@app:devicePatterns('never')\n", q,
+                    n=300, batches=3, dt=400, seed=7)
+    assert chunked
+    assert dev == host
+
+
+def test_chunked_lane_annotation_disable():
+    """@app:deviceChunkLanes(0) turns the mode off (threaded state path)."""
+    q = QUERIES["two_state"]
+    chunked, dev = _run(
+        "@app:devicePatterns('always')\n@app:deviceChunkLanes(0)\n", q,
+        n=600, batches=3)
+    _h, host = _run("@app:devicePatterns('never')\n", q, n=600, batches=3)
+    assert not chunked
+    assert dev == host
+
+
+def test_chunked_snapshot_restore():
+    """Snapshot carries the replay tail + dedup seq: restoring mid-stream
+    neither loses nor duplicates matches."""
+    app = ("@app:devicePatterns('always')\n" + HEAD + QUERIES["two_state"])
+    rng = np.random.default_rng(3)
+    tape = [(f"K{rng.integers(0, 3)}",
+             float(np.round(rng.uniform(90, 130) * 4) / 4))
+            for _ in range(600)]
+    ts0 = 1_700_000_000_000
+
+    def feed(rt, lo, hi):
+        ih = rt.input_handler("S")
+        for i in range(lo, hi):
+            ih.send(tape[i], timestamp=ts0 + i * 9)
+        rt.flush()
+
+    # continuous run
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(app)
+    ref = []
+    rt.add_callback("Out", lambda evs: ref.extend(
+        (e.timestamp, tuple(e.data)) for e in evs))
+    rt.start()
+    feed(rt, 0, 300)
+    feed(rt, 300, 600)
+    mgr.shutdown()
+
+    # snapshot at 300, restore into a fresh runtime, continue
+    mgr1 = SiddhiManager()
+    rt1 = mgr1.create_app_runtime(app)
+    got = []
+    rt1.add_callback("Out", lambda evs: got.extend(
+        (e.timestamp, tuple(e.data)) for e in evs))
+    rt1.start()
+    assert rt1._plans[0]._chunk_cfg is not None
+    feed(rt1, 0, 300)
+    snap = rt1.snapshot()
+    mgr1.shutdown()
+
+    mgr2 = SiddhiManager()
+    rt2 = mgr2.create_app_runtime(app)
+    rt2.add_callback("Out", lambda evs: got.extend(
+        (e.timestamp, tuple(e.data)) for e in evs))
+    rt2.start()
+    rt2.restore(snap)
+    feed(rt2, 300, 600)
+    mgr2.shutdown()
+
+    assert got == ref

@@ -1,0 +1,116 @@
+"""`define function f[python] ...` script UDFs (reference:
+core:function/Script.java:27, EvalScriptTestCase scenario shapes).
+Round-3 VERDICT: definitions were parsed then silently dropped."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.planner import PlanError
+
+HEAD = "define stream S (sym string, price double, vol int);\n"
+
+
+def _run(app, rows):
+    m = SiddhiManager()
+    rt = m.create_app_runtime(app)
+    out = []
+    rt.add_callback("Out", lambda evs: out.extend(tuple(e.data) for e in evs))
+    rt.start()
+    h = rt.input_handler("S")
+    for r in rows:
+        h.send(r, timestamp=1000)
+    rt.flush()
+    m.shutdown()
+    return out
+
+
+def test_udf_expression_body_in_selector():
+    app = HEAD + (
+        "define function spread[python] return double { data[0] - data[1] };\n"
+        "@info(name='q') from S select sym, spread(price, vol) as sp "
+        "insert into Out;\n")
+    out = _run(app, [("A", 10.5, 3), ("B", 2.0, 5)])
+    assert out == [("A", 7.5), ("B", -3.0)]
+
+
+def test_udf_statement_body_and_filter():
+    app = HEAD + (
+        "define function tier[python] return string {\n"
+        "  if data[0] > 100.0:\n"
+        "    return 'high'\n"
+        "  return 'low'\n"
+        "};\n"
+        "@info(name='q') from S[tier(price) == 'high'] "
+        "select sym, tier(price) as t insert into Out;\n")
+    out = _run(app, [("A", 150.0, 1), ("B", 50.0, 1), ("C", 101.0, 1)])
+    assert out == [("A", "high"), ("C", "high")]
+
+
+def test_udf_return_type_coercion():
+    app = HEAD + (
+        "define function half[python] return int { data[0] / 2 };\n"
+        "@info(name='q') from S select half(vol) as h insert into Out;\n")
+    out = _run(app, [("A", 1.0, 9)])
+    assert out == [(4,)]        # coerced to declared int
+
+
+def test_udf_in_pattern_filter_falls_back_to_host():
+    app = ("@app:devicePatterns('prefer')\n" + HEAD +
+           "define function big[python] return bool { data[0] > 100.0 };\n"
+           "@info(name='q') from every e1=S[big(price)] -> "
+           "e2=S[price > e1.price] within 1 sec "
+           "select e1.price as a, e2.price as b insert into Out;\n")
+    m = SiddhiManager()
+    rt = m.create_app_runtime(app)
+    out = []
+    rt.add_callback("Out", lambda evs: out.extend(tuple(e.data) for e in evs))
+    rt.start()
+    h = rt.input_handler("S")
+    ts = 1_700_000_000_000
+    for i, r in enumerate([("A", 150.0, 1), ("A", 160.0, 1), ("A", 50.0, 1)]):
+        h.send(r, timestamp=ts + i)
+    rt.flush()
+    m.shutdown()
+    assert out == [(150.0, 160.0)]
+
+
+def test_unsupported_language_raises_at_build():
+    app = HEAD + (
+        "define function f[javascript] return int { return 1; };\n"
+        "@info(name='q') from S select f(vol) as x insert into Out;\n")
+    with pytest.raises(PlanError, match="javascript"):
+        SiddhiManager().create_app_runtime(app)
+
+
+def test_bad_python_body_raises_at_build():
+    app = HEAD + (
+        "define function f[python] return int { def broken( };\n"
+        "@info(name='q') from S select f(vol) as x insert into Out;\n")
+    with pytest.raises(PlanError, match="does not compile"):
+        SiddhiManager().create_app_runtime(app)
+
+
+def test_udf_in_store_query():
+    app = HEAD + (
+        "define function dbl[python] return double { data[0] * 2 };\n"
+        "define table T (sym string, price double);\n"
+        "@info(name='ins') from S select sym, price insert into T;\n")
+    m = SiddhiManager()
+    rt = m.create_app_runtime(app)
+    rt.start()
+    rt.input_handler("S").send(("A", 21.0, 1), timestamp=1000)
+    rt.flush()
+    rows = rt.query("from T select sym, dbl(price) as d;")
+    m.shutdown()
+    assert [r for _ts, r in rows] == [("A", 42.0)]
+
+
+def test_udf_in_partition_clone():
+    """Partition clones compile lazily (first event per key) — UDFs must
+    still resolve there (r4 review finding)."""
+    app = (HEAD +
+           "define function boost[python] return double { data[0] + 1.0 };\n"
+           "partition with (sym of S) begin\n"
+           "@info(name='q') from S select sym, boost(price) as b "
+           "insert into Out;\nend;\n")
+    out = _run(app, [("A", 1.0, 1), ("B", 2.0, 1)])
+    assert sorted(out) == [("A", 2.0), ("B", 3.0)]

@@ -152,3 +152,22 @@ def test_carry_overflow_grows():
     assert plan.C > 8
     assert out[-1] == (50,)
     m.shutdown()
+
+
+def test_f64_all_double_outputs():
+    """Slim pack with every output column DOUBLE in f64 mode: the i-pack
+    is empty and must be omitted, not stacked (r4 review finding)."""
+    rows = gen_rows(60, seed=42)
+    head = ("@app:devicePrecision('f64')\n@app:playback "
+            "define stream S (sym string, p double, v long);\n")
+    q = "from S#window.length(5) select avg(p) as m, sum(p) as s insert into O;"
+    import random as _r
+    dev = run_app("@app:deviceWindows('always')\n" + head + q, rows,
+                  rng=_r.Random(1))
+    host = run_app("@app:deviceWindows('never')\n" + head + q, rows,
+                   rng=_r.Random(1))
+    assert len(dev) == len(host)
+    for d, h in zip(dev, host):
+        assert d[0] == h[0]
+        for a, b in zip(d[1], h[1]):
+            assert b == pytest.approx(a, rel=1e-9)
