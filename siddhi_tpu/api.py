@@ -1,0 +1,307 @@
+"""Fluent programmatic query API — build apps without QL text.
+
+Reference: siddhi-query-api's builder surface
+(`SiddhiApp.siddhiApp().defineStream(StreamDefinition.id("S")
+.attribute("price", DOUBLE)).addQuery(Query.query().from_(...)
+.select(...).insertInto("Out"))` — SiddhiApp.java:72-198,
+execution/query/Query.java:52-104, StreamDefinition/Selector builders).
+Here the builders emit the SAME frozen AST dataclasses the QL parser
+produces, so everything downstream (planner, device compilers, docgen)
+is identical for both front ends.
+
+Expressions use python operators on `col(...)`/`val(...)` handles:
+
+    from siddhi_tpu.api import SiddhiAppBuilder, Query, col, val
+
+    app = (SiddhiAppBuilder("demo")
+           .stream("S", symbol=str, price=float, volume=int)
+           .query(Query("q1").from_stream("S")
+                  .where(col("price") > 100)
+                  .window("length", 10)
+                  .select(symbol=col("symbol"), total=col("price").sum())
+                  .group_by("symbol")
+                  .insert_into("Out"))
+           .build())
+    rt = SiddhiManager().create_app_runtime(app)
+"""
+from __future__ import annotations
+
+from typing import Optional, Union
+
+from .query import ast
+from .query.ast import AttrType
+
+_PY_TYPES = {str: AttrType.STRING, int: AttrType.INT, float: AttrType.DOUBLE,
+             bool: AttrType.BOOL, object: AttrType.OBJECT,
+             "string": AttrType.STRING, "int": AttrType.INT,
+             "long": AttrType.LONG, "float": AttrType.FLOAT,
+             "double": AttrType.DOUBLE, "bool": AttrType.BOOL,
+             "object": AttrType.OBJECT}
+
+_AGGS = ("sum", "count", "avg", "min", "max", "stdDev", "distinctCount",
+         "minForever", "maxForever", "unionSet")
+
+
+def _expr(v) -> ast.Expression:
+    if isinstance(v, E):
+        return v.node
+    if isinstance(v, ast.Expression):
+        return v
+    if isinstance(v, bool):
+        return ast.Constant(v, AttrType.BOOL)
+    if isinstance(v, int):
+        return ast.Constant(v, AttrType.LONG if abs(v) > 2**31 else AttrType.INT)
+    if isinstance(v, float):
+        return ast.Constant(v, AttrType.DOUBLE)
+    if isinstance(v, str):
+        return ast.Constant(v, AttrType.STRING)
+    raise TypeError(f"cannot lift {v!r} into an expression")
+
+
+class E:
+    """Expression handle with python operator overloading."""
+
+    def __init__(self, node: ast.Expression):
+        self.node = node
+
+    # comparisons -> ast.Compare
+    def _cmp(self, other, op):
+        return E(ast.Compare(self.node, op, _expr(other)))
+
+    def __gt__(self, o):
+        return self._cmp(o, ast.CompareOp.GT)
+
+    def __ge__(self, o):
+        return self._cmp(o, ast.CompareOp.GE)
+
+    def __lt__(self, o):
+        return self._cmp(o, ast.CompareOp.LT)
+
+    def __le__(self, o):
+        return self._cmp(o, ast.CompareOp.LE)
+
+    def __eq__(self, o):                      # noqa: A003 — fluent DSL
+        return self._cmp(o, ast.CompareOp.EQ)
+
+    def __ne__(self, o):
+        return self._cmp(o, ast.CompareOp.NEQ)
+
+    __hash__ = None
+
+    # arithmetic -> ast.Math
+    def _math(self, other, op, rev=False):
+        a, b = (_expr(other), self.node) if rev else (self.node, _expr(other))
+        return E(ast.Math(a, op, b))
+
+    def __add__(self, o):
+        return self._math(o, ast.MathOp.ADD)
+
+    def __radd__(self, o):
+        return self._math(o, ast.MathOp.ADD, rev=True)
+
+    def __sub__(self, o):
+        return self._math(o, ast.MathOp.SUB)
+
+    def __rsub__(self, o):
+        return self._math(o, ast.MathOp.SUB, rev=True)
+
+    def __mul__(self, o):
+        return self._math(o, ast.MathOp.MUL)
+
+    def __rmul__(self, o):
+        return self._math(o, ast.MathOp.MUL, rev=True)
+
+    def __truediv__(self, o):
+        return self._math(o, ast.MathOp.DIV)
+
+    def __rtruediv__(self, o):
+        return self._math(o, ast.MathOp.DIV, rev=True)
+
+    def __mod__(self, o):
+        return self._math(o, ast.MathOp.MOD)
+
+    # boolean combinators (python `and`/`or` can't overload -> methods)
+    def and_(self, o):
+        return E(ast.And(self.node, _expr(o)))
+
+    def or_(self, o):
+        return E(ast.Or(self.node, _expr(o)))
+
+    def not_(self):
+        return E(ast.Not(self.node))
+
+    def is_null(self):
+        return E(ast.IsNull(expr=self.node))
+
+    # aggregator shorthands: col("price").sum() etc.
+    def _agg(self, name):
+        return E(ast.FunctionCall(name, (self.node,)))
+
+    def fn(self, name, *more, namespace=None):
+        return E(ast.FunctionCall(name, (self.node,
+                                         *(map(_expr, more))), namespace))
+
+
+for _a in _AGGS:
+    setattr(E, _a, (lambda _n: lambda self: self._agg(_n))(_a))
+
+
+def col(name: str, of: Optional[str] = None, index=None) -> E:
+    """An attribute reference: col("price"), col("price", of="e1")."""
+    return E(ast.Variable(name, stream_ref=of, index=index))
+
+
+def val(v) -> E:
+    """A literal constant."""
+    return E(_expr(v))
+
+
+def fn(name: str, *args, namespace: Optional[str] = None) -> E:
+    """A bare function call: fn("count"), fn("str:concat", ...)."""
+    return E(ast.FunctionCall(name, tuple(_expr(a) for a in args), namespace))
+
+
+def time_ms(millis: int) -> E:
+    return E(ast.TimeConstant(int(millis)))
+
+
+class Query:
+    """Fluent single-query builder (reference Query.query())."""
+
+    def __init__(self, name: Optional[str] = None):
+        self._name = name
+        self._stream: Optional[str] = None
+        self._alias: Optional[str] = None
+        self._handlers: list = []
+        self._select_all = True
+        self._attrs: list = []
+        self._group: list = []
+        self._having = None
+        self._order: list = []
+        self._limit = None
+        self._offset = None
+        self._output: Optional[ast.OutputStreamAction] = None
+        self._annotations: list = []
+
+    def from_stream(self, stream_id: str, as_: Optional[str] = None) -> "Query":
+        self._stream = stream_id
+        self._alias = as_
+        return self
+
+    def where(self, cond) -> "Query":
+        self._handlers.append(ast.Filter(_expr(cond)))
+        return self
+
+    def window(self, name: str, *args, namespace: Optional[str] = None) -> "Query":
+        self._handlers.append(ast.WindowHandler(
+            name, tuple(_expr(a) for a in args), namespace))
+        return self
+
+    def stream_function(self, name: str, *args,
+                        namespace: Optional[str] = None) -> "Query":
+        self._handlers.append(ast.StreamFunction(
+            name, tuple(_expr(a) for a in args), namespace))
+        return self
+
+    def select(self, *positional, **named) -> "Query":
+        """select(col("a"), total=col("x").sum()) — keywords rename."""
+        self._select_all = False
+        for p in positional:
+            self._attrs.append(ast.OutputAttribute(_expr(p)))
+        for name, e in named.items():
+            self._attrs.append(ast.OutputAttribute(_expr(e), rename=name))
+        return self
+
+    def select_all(self) -> "Query":
+        self._select_all = True
+        return self
+
+    def group_by(self, *names: str) -> "Query":
+        self._group.extend(ast.Variable(n) for n in names)
+        return self
+
+    def having(self, cond) -> "Query":
+        self._having = _expr(cond)
+        return self
+
+    def order_by(self, name: str, desc: bool = False) -> "Query":
+        self._order.append(ast.OrderByAttribute(
+            ast.Variable(name),
+            ast.OrderDir.DESC if desc else ast.OrderDir.ASC))
+        return self
+
+    def limit(self, n: int) -> "Query":
+        self._limit = n
+        return self
+
+    def offset(self, n: int) -> "Query":
+        self._offset = n
+        return self
+
+    def insert_into(self, target: str) -> "Query":
+        self._output = ast.InsertInto(target)
+        return self
+
+    def annotate(self, name: str, *indexed, **kv) -> "Query":
+        elements = tuple((None, str(v)) for v in indexed) + \
+            tuple((k, str(v)) for k, v in kv.items())
+        self._annotations.append(ast.Annotation(name.lower(), elements))
+        return self
+
+    def build(self) -> ast.Query:
+        if self._stream is None:
+            raise ValueError("query needs from_stream(...)")
+        if self._output is None:
+            raise ValueError("query needs insert_into(...)")
+        anns = list(self._annotations)
+        if self._name and not any(a.name == "info" for a in anns):
+            anns.insert(0, ast.Annotation("info", ((None, self._name),)))
+        inp = ast.SingleInputStream(self._stream, self._alias,
+                                    tuple(self._handlers))
+        sel = ast.Selector(self._select_all, tuple(self._attrs),
+                           tuple(self._group), self._having,
+                           tuple(self._order), self._limit, self._offset)
+        return ast.Query(inp, sel, self._output, None, tuple(anns))
+
+
+class SiddhiAppBuilder:
+    """Fluent app assembly (reference SiddhiApp.siddhiApp())."""
+
+    def __init__(self, name: Optional[str] = None):
+        self._name = name
+        self._streams: dict = {}
+        self._elements: list = []
+        self._annotations: list = []
+
+    def annotate(self, name: str, *indexed, **kv) -> "SiddhiAppBuilder":
+        elements = tuple((None, str(v)) for v in indexed) + \
+            tuple((k, str(v)) for k, v in kv.items())
+        self._annotations.append(ast.Annotation(name.lower(), elements))
+        return self
+
+    def stream(self, stream_id: str, **attrs) -> "SiddhiAppBuilder":
+        """stream("S", symbol=str, price=float, volume=int) — values are
+        python types or type-name strings ("long", "double", ...)."""
+        attributes = []
+        for n, t in attrs.items():
+            at = _PY_TYPES.get(t if not isinstance(t, str) else t.lower())
+            if at is None:
+                raise ValueError(f"stream {stream_id!r}: unknown type {t!r} "
+                                 f"for attribute {n!r}")
+            attributes.append(ast.Attribute(n, at))
+        self._streams[stream_id] = ast.StreamDefinition(
+            stream_id, tuple(attributes))
+        return self
+
+    def query(self, q: Union[Query, ast.Query]) -> "SiddhiAppBuilder":
+        self._elements.append(q.build() if isinstance(q, Query) else q)
+        return self
+
+    def build(self) -> ast.SiddhiApp:
+        anns = list(self._annotations)
+        if self._name and not any(a.name == "app:name" for a in anns):
+            anns.insert(0, ast.Annotation("app:name", ((None, self._name),)))
+        return ast.SiddhiApp(
+            annotations=tuple(anns),
+            stream_definitions=dict(self._streams),
+            execution_elements=tuple(self._elements))

@@ -205,6 +205,12 @@ class AggregationRuntime(QueryPlan):
                        and Duration.MONTHS not in self.durations
                        and Duration.YEARS not in self.durations)
         self._dev_cache: dict = {}      # padded n -> jitted kernel
+        # multi-chip: events shard over devices, each computes its
+        # shard's per-(bucket, group) partials, and the commutative base
+        # merge (sum/count/min/max) combines them host-side — the same
+        # merge that already combines batches into the store
+        from .planner import mesh_for
+        self._mesh = mesh_for(rt, "shard") if self.device else None
 
     # -- ingest (vectorized segmented reduction) -----------------------------
 
@@ -307,9 +313,11 @@ class AggregationRuntime(QueryPlan):
         import jax.numpy as jnp
 
         n = len(ts)
-        npad = 8
+        D = len(self._mesh.devices.ravel()) if self._mesh is not None else 1
+        npad = 8 * D
         while npad < n:
             npad *= 2
+        L = npad // D
         spans = [d.approx_millis for d in self.durations]
         nb = self.n_bases
         base_ops = [b for s in self.sites for b in _BASES[s.name]]
@@ -322,7 +330,7 @@ class AggregationRuntime(QueryPlan):
         if fn is None:
             def kernel(ts64, g64, v32):
                 outs_i, outs_f = [], []
-                pos = jnp.arange(npad, dtype=jnp.int64)
+                pos = jnp.arange(L, dtype=jnp.int64)
                 for w in spans:
                     bucket = (ts64 // w) * w
                     keys = [pos] + [g64[gi] for gi in
@@ -338,7 +346,7 @@ class AggregationRuntime(QueryPlan):
                     rows = []
                     for bi, b in enumerate(base_ops):
                         if b == "count":
-                            v = jnp.ones(npad, jnp.float32)
+                            v = jnp.ones(L, jnp.float32)
                         else:
                             v = v32[val_of_base[bi]][order]
                         if b in ("sum", "count"):
@@ -370,7 +378,16 @@ class AggregationRuntime(QueryPlan):
                     outs_f.append(jnp.stack(rows))
                 return {"i": jnp.concatenate(outs_i, axis=0),
                         "f": jnp.concatenate(outs_f, axis=0)}
-            fn = self._dev_cache[npad] = jax.jit(kernel)
+            if D == 1:
+                fn = jax.jit(kernel)
+            else:
+                # shard axis 0 over the mesh: every device reduces its
+                # own event shard in parallel; partials merge host-side
+                from jax.sharding import NamedSharding, PartitionSpec
+                sh = NamedSharding(self._mesh, PartitionSpec("shard"))
+                fn = jax.jit(jax.vmap(kernel),
+                             in_shardings=(sh, sh, sh), out_shardings=sh)
+            self._dev_cache[npad] = fn
 
         ts_p = np.full(npad, np.int64(2**62))
         ts_p[:n] = ts
@@ -380,7 +397,12 @@ class AggregationRuntime(QueryPlan):
         v_p = np.zeros((len(vals), npad), np.float32)
         for i, v in enumerate(vals):
             v_p[i, :n] = v
-        res = fn(ts_p, g_p, v_p)
+        if D == 1:
+            res = fn(ts_p, g_p, v_p)
+        else:
+            res = fn(ts_p.reshape(D, L),
+                     g_p.reshape(len(gints), D, L).swapaxes(0, 1),
+                     v_p.reshape(len(vals), D, L).swapaxes(0, 1))
         try:
             res["i"].copy_to_host_async()
         except Exception:
@@ -389,16 +411,27 @@ class AggregationRuntime(QueryPlan):
         fpack = np.asarray(res["f"])
         out = []
         for di, dur in enumerate(self.durations):
-            order = ipack[2 * di]
-            starts = ipack[2 * di + 1] != 0
-            runs = fpack[di * nb:(di + 1) * nb]
-            sidx = np.flatnonzero(starts)
-            sidx = sidx[sidx < n]               # drop padding segments
-            ends = np.concatenate([sidx[1:], [n]]) - 1
-            rows_any = order[sidx]
-            buckets_of = bucket_starts(ts[rows_any], dur)
-            reduced = [runs[bi][ends] for bi in range(nb)]
-            out.append((buckets_of, rows_any, reduced))
+            parts = ([], [], [[] for _ in range(nb)])
+            for s in range(D):
+                ip = ipack if D == 1 else ipack[s]
+                fp = fpack if D == 1 else fpack[s]
+                n_s = min(max(n - s * L, 0), L)
+                if n_s == 0:
+                    continue
+                order = ip[2 * di]
+                starts = ip[2 * di + 1] != 0
+                runs = fp[di * nb:(di + 1) * nb]
+                sidx = np.flatnonzero(starts)
+                sidx = sidx[sidx < n_s]         # drop padding segments
+                ends = np.concatenate([sidx[1:], [n_s]]) - 1
+                rows_any = order[sidx] + s * L
+                parts[0].append(bucket_starts(ts[rows_any], dur))
+                parts[1].append(rows_any)
+                for bi in range(nb):
+                    parts[2][bi].append(runs[bi][ends])
+            out.append((np.concatenate(parts[0]),
+                        np.concatenate(parts[1]),
+                        [np.concatenate(p) for p in parts[2]]))
         return out
 
     def _merge(self, a: list, b: list) -> list:

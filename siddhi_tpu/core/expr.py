@@ -184,7 +184,10 @@ def _cast(x: Array, t: AttrType) -> Array:
 SCALAR_FUNCTIONS: dict = {}
 
 
-def register_scalar_function(name: str, builder, namespace: Optional[str] = None):
+def register_scalar_function(name: str, builder, namespace: Optional[str] = None,
+                             meta=None):
+    from ..extension import register_meta
+    register_meta("function", meta)
     SCALAR_FUNCTIONS[(namespace, name.lower())] = builder
 
 

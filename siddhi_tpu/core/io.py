@@ -28,29 +28,67 @@ from .planner import PlanError
 # in-memory topic bus (reference: InMemoryBroker.java:121)
 # ---------------------------------------------------------------------------
 
-class InMemoryBroker:
-    _subs: dict = defaultdict(list)     # topic -> [subscriber fn]
+class Broker:
+    """An isolated in-memory topic bus instance.  The reference's
+    InMemoryBroker is a process-global static (two apps — even in two
+    SiddhiManagers — sharing a topic name cross-talk); construct a
+    SiddhiManager with `isolated_broker=True` to scope topics to that
+    manager instead."""
 
-    @classmethod
-    def publish(cls, topic: str, message) -> None:
-        for fn in list(cls._subs.get(topic, ())):
+    def __init__(self):
+        self._subs: dict = defaultdict(list)    # topic -> [subscriber fn]
+
+    def publish(self, topic: str, message) -> None:
+        for fn in list(self._subs.get(topic, ())):
             fn(message)
 
-    @classmethod
-    def subscribe(cls, topic: str, fn: Callable) -> Callable:
-        cls._subs[topic].append(fn)
+    def subscribe(self, topic: str, fn: Callable) -> Callable:
+        self._subs[topic].append(fn)
         return fn
 
-    @classmethod
-    def unsubscribe(cls, topic: str, fn: Callable) -> None:
+    def unsubscribe(self, topic: str, fn: Callable) -> None:
         try:
-            cls._subs[topic].remove(fn)
+            self._subs[topic].remove(fn)
         except ValueError:
             pass
 
+    def reset(self) -> None:
+        self._subs.clear()
+
+
+_DEFAULT_BROKER = Broker()
+
+
+def broker_for(rt) -> Broker:
+    """The bus a runtime's inMemory transports ride: the owning
+    manager's isolated broker when configured, else the process-global
+    default (reference semantics)."""
+    mgr = getattr(rt, "manager", None)
+    b = getattr(mgr, "broker", None)
+    return b if b is not None else _DEFAULT_BROKER
+
+
+class InMemoryBroker:
+    """Process-global facade (reference: InMemoryBroker.java:121's
+    static subscriber table).  Semantics are deliberately global: every
+    runtime in the process shares these topics unless its manager opted
+    into an isolated broker.  `reset()` clears all topics (tests)."""
+
+    @classmethod
+    def publish(cls, topic: str, message) -> None:
+        _DEFAULT_BROKER.publish(topic, message)
+
+    @classmethod
+    def subscribe(cls, topic: str, fn: Callable) -> Callable:
+        return _DEFAULT_BROKER.subscribe(topic, fn)
+
+    @classmethod
+    def unsubscribe(cls, topic: str, fn: Callable) -> None:
+        _DEFAULT_BROKER.unsubscribe(topic, fn)
+
     @classmethod
     def reset(cls) -> None:
-        cls._subs.clear()
+        _DEFAULT_BROKER.reset()
 
 
 # ---------------------------------------------------------------------------
@@ -105,12 +143,50 @@ class JsonSourceMapper(SourceMapper):
         return out
 
 
+class TemplateBuilder:
+    """`{{attr}}` payload templating (reference:
+    core:util/transport/TemplateBuilder.java — validates placeholders
+    against the schema at build time, fills per event at runtime)."""
+
+    import re as _re
+    _PH = _re.compile(r"\{\{\s*(\w+)\s*\}\}")
+
+    def __init__(self, schema, template: str):
+        self.template = template
+        self._parts: list = []      # literal str | attr index
+        pos = 0
+        for m in self._PH.finditer(template):
+            if m.start() > pos:
+                self._parts.append(template[pos:m.start()])
+            attr = m.group(1)
+            if attr not in schema.index_of:
+                raise PlanError(
+                    f"@payload template references unknown attribute "
+                    f"{attr!r}; stream has {list(schema.names)}")
+            self._parts.append(schema.index_of[attr])
+            pos = m.end()
+        if pos < len(template):
+            self._parts.append(template[pos:])
+        if not any(isinstance(p, int) for p in self._parts):
+            raise PlanError(
+                f"@payload template has no {{{{attribute}}}} placeholders: "
+                f"{template!r}")
+
+    def build(self, data: tuple) -> str:
+        return "".join(
+            p if isinstance(p, str)
+            else ("null" if data[p] is None else str(data[p]))
+            for p in self._parts)
+
+
 class SinkMapper:
     """events -> wire payloads (one per event)."""
 
     def __init__(self, schema, options: dict):
         self.schema = schema
         self.options = options
+        tpl = options.get("_payload")
+        self.payload = TemplateBuilder(schema, tpl) if tpl else None
 
     def map(self, events: list) -> list:
         raise NotImplementedError
@@ -118,32 +194,157 @@ class SinkMapper:
 
 class PassThroughSinkMapper(SinkMapper):
     def map(self, events: list) -> list:
+        if self.payload is not None:
+            return [self.payload.build(e.data) for e in events]
         return [e.data for e in events]
 
 
 class JsonSinkMapper(SinkMapper):
+    """Default `{"event": {...}}` envelope; a @payload template replaces
+    it wholesale (reference json sink mapper custom-payload mode)."""
+
     def map(self, events: list) -> list:
+        if self.payload is not None:
+            return [self.payload.build(e.data) for e in events]
         names = self.schema.names
         return [json.dumps({"event": dict(zip(names, e.data))}) for e in events]
 
 
+class TextSinkMapper(SinkMapper):
+    """`@map(type='text')` — `attr:"value"` lines per event, or a
+    @payload template (reference: siddhi-map-text TextSinkMapper
+    default/custom modes).  `delimiter` joins multi-event publishes."""
+
+    def map(self, events: list) -> list:
+        names = self.schema.names
+        out = []
+        for e in events:
+            if self.payload is not None:
+                out.append(self.payload.build(e.data))
+                continue
+            parts = []
+            for n, v in zip(names, e.data):
+                if isinstance(v, str):
+                    parts.append(f'{n}:"{v}"')
+                elif v is None:
+                    parts.append(f"{n}:null")
+                else:
+                    parts.append(f"{n}:{v}")
+            out.append(",\n".join(parts))
+        delim = self.options.get("delimiter")
+        if delim and out:
+            return [delim.join(out)]
+        return out
+
+
+class TextSourceMapper(SourceMapper):
+    """`@map(type='text')` inbound: parses `attr:value` lines (quotes
+    optional), coercing by schema type; a `delimiter` option splits one
+    message into several events (reference: siddhi-map-text
+    TextSourceMapper default mapping)."""
+
+    def map(self, message) -> list:
+        if isinstance(message, bytes):
+            message = message.decode()
+        text = str(message)
+        delim = self.options.get("delimiter")
+        chunks = text.split(delim) if delim else [text]
+        out = []
+        for chunk in chunks:
+            vals: dict = {}
+            for line in chunk.splitlines():
+                line = line.strip().rstrip(",")
+                if not line or ":" not in line:
+                    continue
+                k, v = line.split(":", 1)
+                vals[k.strip()] = v.strip()
+            row = []
+            for a in self.schema.attributes:
+                raw = vals.get(a.name)
+                row.append(self._coerce(raw, a.type))
+            out.append((None, tuple(row)))
+        return out
+
+    @staticmethod
+    def _coerce(raw, t):
+        from ..query.ast import AttrType
+        if raw is None or raw == "null":
+            return None
+        if raw.startswith('"') and raw.endswith('"'):
+            raw = raw[1:-1]
+        try:
+            if t in (AttrType.INT, AttrType.LONG):
+                return int(float(raw))
+            if t in (AttrType.FLOAT, AttrType.DOUBLE):
+                return float(raw)
+            if t == AttrType.BOOL:
+                return str(raw).lower() in ("true", "1")
+            return raw
+        except (TypeError, ValueError):
+            return None
+
+
 SOURCE_MAPPERS: dict = {"passthrough": PassThroughSourceMapper,
-                        "json": JsonSourceMapper}
+                        "json": JsonSourceMapper,
+                        "text": TextSourceMapper}
 SINK_MAPPERS: dict = {"passthrough": PassThroughSinkMapper,
-                      "json": JsonSinkMapper}
+                      "json": JsonSinkMapper,
+                      "text": TextSinkMapper}
 
 
-def register_source_mapper(name: str, cls) -> None:
+def register_source_mapper(name: str, cls, meta=None) -> None:
+    from ..extension import register_meta
+    register_meta("source-mapper", meta)
     SOURCE_MAPPERS[name.lower()] = cls
 
 
-def register_sink_mapper(name: str, cls) -> None:
+def register_sink_mapper(name: str, cls, meta=None) -> None:
+    from ..extension import register_meta
+    register_meta("sink-mapper", meta)
     SINK_MAPPERS[name.lower()] = cls
 
 
 # ---------------------------------------------------------------------------
 # sources
 # ---------------------------------------------------------------------------
+
+class SourceHandler:
+    """Interception point between mapper and runtime ingest (reference:
+    core:stream/input/source/SourceHandler.java — the HA SPI: an
+    active/passive deployment plugs a handler that forwards on the
+    active node and records-and-drops on the passive one).  Return the
+    (possibly transformed) rows; return None or [] to swallow."""
+
+    def init(self, source: "Source") -> None:
+        pass
+
+    def on_rows(self, rows: list) -> Optional[list]:
+        return rows
+
+    # snapshot hooks so HA state rides the app snapshot
+    def state_dict(self) -> dict:
+        return {}
+
+    def load_state_dict(self, d: dict) -> None:
+        pass
+
+
+class SinkHandler:
+    """Interception point between the runtime and the sink mapper
+    (reference: core:stream/output/sink/SinkHandler.java)."""
+
+    def init(self, sink: "Sink") -> None:
+        pass
+
+    def on_events(self, events: list) -> Optional[list]:
+        return events
+
+    def state_dict(self) -> dict:
+        return {}
+
+    def load_state_dict(self, d: dict) -> None:
+        pass
+
 
 class Source:
     """Transport lifecycle (reference: Source.java:42).  Subclasses
@@ -157,6 +358,7 @@ class Source:
         self.options = options
         self.mapper = mapper
         self.connected = False
+        self.handler: Optional[SourceHandler] = None
 
     # -- SPI -----------------------------------------------------------------
 
@@ -185,6 +387,10 @@ class Source:
                     f"message ({e}); add @OnError(action='stream') to route "
                     f"to a fault stream", RuntimeWarning)
             return
+        if self.handler is not None:
+            rows = self.handler.on_rows(rows)
+            if not rows:
+                return
         with self.rt._lock:
             for ts, row in rows:
                 self.rt._send_locked(self.stream_id, row, ts)
@@ -218,11 +424,12 @@ class InMemorySource(Source):
         topic = self.options.get("topic")
         if not topic:
             raise PlanError("inMemory source needs a topic")
-        self._fn = InMemoryBroker.subscribe(topic, self.deliver)
+        self._broker = broker_for(self.rt)
+        self._fn = self._broker.subscribe(topic, self.deliver)
 
     def disconnect(self) -> None:
         if self.connected:
-            InMemoryBroker.unsubscribe(self.options.get("topic"), self._fn)
+            self._broker.unsubscribe(self.options.get("topic"), self._fn)
 
 
 class CallbackSource(Source):
@@ -245,6 +452,7 @@ class Sink:
         self.options = options
         self.mapper = mapper
         self.connected = False
+        self.handler: Optional[SinkHandler] = None
 
     def connect(self) -> None:
         raise NotImplementedError
@@ -256,6 +464,10 @@ class Sink:
         raise NotImplementedError
 
     def on_events(self, events: list) -> None:
+        if self.handler is not None:
+            events = self.handler.on_events(events)
+            if not events:
+                return
         for payload in self.mapper.map(events):
             self.publish(payload)
 
@@ -322,9 +534,10 @@ class InMemorySink(Sink):
     def connect(self) -> None:
         if not self.options.get("topic"):
             raise PlanError("inMemory sink needs a topic")
+        self._broker = broker_for(self.rt)
 
     def publish(self, payload) -> None:
-        InMemoryBroker.publish(self.options["topic"], payload)
+        self._broker.publish(self.options["topic"], payload)
 
 
 class LogSink(Sink):
@@ -341,11 +554,15 @@ SOURCE_TYPES: dict = {"inmemory": InMemorySource, "callback": CallbackSource}
 SINK_TYPES: dict = {"inmemory": InMemorySink, "log": LogSink}
 
 
-def register_source_type(name: str, cls) -> None:
+def register_source_type(name: str, cls, meta=None) -> None:
+    from ..extension import register_meta
+    register_meta("source", meta)
     SOURCE_TYPES[name.lower()] = cls
 
 
-def register_sink_type(name: str, cls) -> None:
+def register_sink_type(name: str, cls, meta=None) -> None:
+    from ..extension import register_meta
+    register_meta("sink", meta)
     SINK_TYPES[name.lower()] = cls
 
 
@@ -376,6 +593,11 @@ def build_io(rt) -> None:
                                     PassThroughSourceMapper)
                 src = cls(rt, sid, opts, mapper)
                 src.config = rt.config_reader("source", typ)
+                fac = getattr(rt.manager, "source_handler_factory", None) \
+                    if rt.manager else None
+                if fac is not None:
+                    src.handler = fac()
+                    src.handler.init(src)
                 rt.sources.append(src)
             elif nm == "sink":
                 opts = _ann_options(a)
@@ -415,6 +637,11 @@ def build_io(rt) -> None:
                 else:
                     sink = cls(rt, sid, opts, mapper)
                 sink.config = rt.config_reader("sink", typ)
+                fac = getattr(rt.manager, "sink_handler_factory", None) \
+                    if rt.manager else None
+                if fac is not None:
+                    sink.handler = fac()
+                    sink.handler.init(sink)
                 rt.sinks.append(sink)
                 # stage into the runtime's outbox instead of publishing
                 # under the runtime lock (cross-runtime ABBA deadlock —
@@ -430,6 +657,11 @@ def _mapper_of(a: ast.Annotation, schema, registry: dict, default_cls):
     if m is None:
         return default_cls(schema, {})
     opts = _ann_options(m)
+    # @payload('... {{attr}} ...') nested under @map (reference:
+    # AnnotationHelper payload extraction feeding TemplateBuilder)
+    pl = find_annotation(m.annotations, "payload")
+    if pl is not None:
+        opts["_payload"] = pl.element()
     typ = opts.get("type", "passThrough").lower()
     cls = registry.get(typ)
     if cls is None:

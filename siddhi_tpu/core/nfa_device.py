@@ -65,9 +65,13 @@ TPU-economics of this kernel (what round-2 got wrong; measured on v5e):
     a retry is exact).
 
 Still host-only (DeviceNFAUnsupported -> sequential fallback):
-`every` below the head, absent states in the head position, min-count 0,
-adjacent count positions, sequences containing absent/logical states,
-non-Variable selector outputs over maybe-absent refs.
+absent states in the head position, `every` wrapping logical/count/
+absent states below the head, min-count 0 in the head position,
+sequences containing absent states, and non-Variable selector outputs
+over maybe-absent refs.  Everything else — `every` below the head
+(slot forking), optional states (min-count 0 epsilon cascade),
+adjacent/multiple count positions, sequences with logical states —
+runs on device.
 """
 from __future__ import annotations
 
@@ -253,22 +257,30 @@ def lower_chain(state_input, schemas_by_stream: dict, strings: StringTable,
     # ---- support matrix ---------------------------------------------------
     S = len(positions)
     for i, pos in enumerate(positions):
-        if pos.sticky and i != 0:
-            raise DeviceNFAUnsupported("`every` below the head")
-        if pos.min_count == 0:
-            raise DeviceNFAUnsupported("min-count 0 (optional state)")
+        if pos.sticky and i != 0 and (
+                pos.op is not None or pos.is_count
+                or pos.nodes[0].kind == "absent"):
+            # plain-stream `every` below the head forks slots on device;
+            # every-wrapped logical/count/absent states stay host-only
+            raise DeviceNFAUnsupported(
+                "`every` below the head on a logical/count/absent state")
+        if pos.min_count == 0 and (i == 0 or not pos.is_count):
+            raise DeviceNFAUnsupported("min-count 0 in the head position")
+        if pos.min_count == 0 and positions[i - 1].is_count \
+                and positions[i - 1].min_count >= 1:
+            # a counting position's min-crossing arm would need to
+            # epsilon-skip the optional count (host _commit_epsilons);
+            # the slot-station model can't express it — host fallback
+            raise DeviceNFAUnsupported(
+                "optional (min-0) count directly after a counting state")
         if pos.is_count and (pos.op is not None
                              or pos.nodes[0].kind == "absent"):
+            # the reference grammar only counts basic stream states
             raise DeviceNFAUnsupported("count on logical/absent state")
-        if pos.is_count and i + 1 < S and positions[i + 1].is_count:
-            raise DeviceNFAUnsupported("adjacent count positions")
         if i == 0 and any(n.kind == "absent" for n in pos.nodes):
             raise DeviceNFAUnsupported("absent state in the head position")
-        if is_sequence and (pos.op is not None
-                            or any(n.kind == "absent" for n in pos.nodes)):
-            raise DeviceNFAUnsupported("sequence with logical/absent states")
-    if sum(1 for p_ in positions if p_.is_count) > 1:
-        raise DeviceNFAUnsupported("multiple count positions")
+        if is_sequence and any(n.kind == "absent" for n in pos.nodes):
+            raise DeviceNFAUnsupported("sequence with absent states")
 
     schemas = {n.ref: schemas_by_stream[n.stream_id]
                for p in positions for n in p.nodes}
@@ -664,6 +676,9 @@ class NFAKernel:
 
         caps_env = self._caps_env(caps)
         age = ts[None, :] - first_ts
+        narm0 = narm      # successor arms as of step START: a min crossing
+        #                   and its consumption may not share one event
+        #                   (host stages registrations until post-event)
         transitioned = jnp.zeros((A, P), dtype=bool)
         complete = jnp.zeros((A, P), dtype=bool)
         kill = jnp.zeros((A, P), dtype=bool)
@@ -694,12 +709,15 @@ class NFAKernel:
                     "__comp_ts__": dl[r], "__comp_seq__": seq,
                     f"__present__.{n0.ref}": jnp.zeros((P,), _I32)}))
             else:
-                occ0 = jnp.where(due, pi + 2, occ0)
-                cnt, cnt_on, narm, fl, dl2 = self._enter_position(
-                    pi + 1, due, cnt, cnt_on, narm, fl, dl, dl[r])
-                dl = dl2
+                land, mids = self._landing_from(pi)
+                occ0 = jnp.where(due, land + 1, occ0)
+                for t in (*mids, land):
+                    cnt, cnt_on, narm, fl, dl2 = self._enter_position(
+                        t, due, cnt, cnt_on, narm, fl, dl, dl[r])
+                    dl = dl2
                 zero_e = self._present_zero(
-                    {n.ref for n in spec.positions[pi + 1].nodes})
+                    {n.ref for t in (*mids, land)
+                     for n in spec.positions[t].nodes})
                 if zero_e:  # immediate: same-step collection reads caps
                     caps = self._write_caps(caps, due, zero_e)
             dl = dl.at[r].set(jnp.where(due, NO_DEADLINE, dl[r]))
@@ -721,9 +739,17 @@ class NFAKernel:
             nonlocal occ, complete
             if pi_from == S - 1:
                 complete = complete | mask
-            else:
-                occ = jnp.where(mask, pi_from + 2, occ)
-                enters.append((pi_from + 1, mask))
+                return
+            # epsilon cascade: mid-chain optional counts (min 0) arm
+            # collection but the station lands on the first non-optional
+            # position (host: _commit_epsilons registers successors at
+            # entry; FINAL is never epsilon-reached, so an all-optional
+            # suffix stations on the last count without emitting)
+            t, mids = self._landing_from(pi_from)
+            for mid in mids:
+                enters.append((mid, mask))
+            occ = jnp.where(mask, t + 1, occ)
+            enters.append((t, mask))
 
         # --- count collection (station-independent: a partial match keeps
         #     absorbing occurrences while waiting further down the chain,
@@ -751,6 +777,34 @@ class NFAKernel:
                 # min emits (reference _emit_or_stage for count-final)
                 complete = complete | (collect
                                        & (newc >= jnp.int32(pos.min_count)))
+
+            # adjacent count positions: the previous count's armed
+            # successor IS this count — entry consumes the arm and counts
+            # the entering event as occurrence #1
+            prevp = spec.positions[pi - 1] if pi else None
+            if prevp is not None and prevp.is_count:
+                ent = at_pos[pi - 1] & narm0[prevp.cnt_row] & nm[(pi, 0)]
+                narm = narm.at[prevp.cnt_row].set(
+                    narm[prevp.cnt_row] & ~ent)
+                occ = jnp.where(ent, pi + 1, occ)
+                transitioned = transitioned | ent
+                one = jnp.where(ent, 1, cnt[c])
+                cnt = cnt.at[c].set(one)
+                cnt_on = cnt_on.at[c].set(
+                    jnp.where(ent, pos.max_count > 1, cnt_on[c]))
+                caps = self._write_caps(
+                    caps, ent, self._present_zero({pos.nodes[0].ref}))
+                evals = self._count_capture_values(
+                    x, pos.nodes[0], jnp.where(ent, 1, 0), caps)
+                if pi == S - 1:
+                    evals["__comp_ts__"] = ts
+                    evals["__comp_seq__"] = seq
+                    complete = complete | (ent
+                                           & (pos.min_count <= 1))
+                else:
+                    narm = narm.at[c].set(
+                        narm[c] | (ent & (pos.min_count <= 1)))
+                cap_writes.append((ent, evals))
 
         # --- per-position station logic -----------------------------------
         for pi, pos in enumerate(spec.positions):
@@ -783,11 +837,22 @@ class NFAKernel:
             elig = at
             prev = spec.positions[pi - 1]
             if prev.is_count:
-                elig = elig | (at_pos[pi - 1] & narm[prev.cnt_row])
+                elig = elig | (at_pos[pi - 1] & narm0[prev.cnt_row])
             m = elig & nm[(pi, 0)]
             if prev.is_count:
                 narm = narm.at[prev.cnt_row].set(narm[prev.cnt_row] & ~m)
             transitioned = transitioned | m
+            if pos.sticky:
+                # `every` below the head: the slot is a standing arm — a
+                # CLONE advances carrying this capture, the original stays
+                # armed (host oracle: PM.sticky_at clone in _transition;
+                # reference: EveryInnerStateRuntime re-registration)
+                (occ, first_ts, head_seq, cnt, cnt_on, narm, fl, dl, caps,
+                 m, lost) = self._fork_slots(
+                    m, occ, first_ts, head_seq, cnt, cnt_on, narm, fl, dl,
+                    caps)
+                of_slots = of_slots + lost
+                transitioned = transitioned | m
             vals = self._capture_values(x, n0)
             vals["__comp_ts__"] = ts
             vals["__comp_seq__"] = seq
@@ -879,6 +944,58 @@ class NFAKernel:
 
     # -- helpers for pieces of the step ----------------------------------
 
+    def _fork_slots(self, src, occ, first_ts, head_seq, cnt, cnt_on, narm,
+                    fl, dl, caps):
+        """Clone every `src` slot into a free slot (rank-matched); returns
+        updated state + the clone mask (the clones are the ones that then
+        advance).  Clones that find no free slot count into the overflow
+        counter — the host grows A and retries the block exactly."""
+        A = self.A
+        srci = src.astype(_I32)
+        nfork = jnp.cumsum(srci, axis=0)
+        src_rank = nfork - srci
+        total = nfork[-1]                               # (P,)
+        free = occ == 0
+        freei = free.astype(_I32)
+        dst_rank = jnp.cumsum(freei, axis=0) - freei
+        dst = free & (dst_rank < total[None, :])
+        lost = jnp.maximum(total - jnp.sum(freei, axis=0), 0).astype(_I32)
+        key = jnp.where(src, src_rank, A + 1)
+        by_rank = jnp.argsort(key, axis=0)              # (A, P)
+        src_of = jnp.take_along_axis(by_rank,
+                                     jnp.minimum(dst_rank, A - 1), axis=0)
+
+        def cp(row):
+            g = jnp.take_along_axis(row, src_of, axis=0)
+            return jnp.where(dst, g, row)
+
+        def cp3(t):
+            if t.shape[0] == 0:
+                return t
+            g = jnp.take_along_axis(
+                t, jnp.broadcast_to(src_of[None], t.shape), axis=1)
+            return jnp.where(dst[None], g, t)
+        occ = cp(occ)
+        first_ts = cp(first_ts)
+        head_seq = cp(head_seq)
+        cnt, cnt_on, narm, fl, dl = (cp3(cnt), cp3(cnt_on), cp3(narm),
+                                     cp3(fl), cp3(dl))
+        caps = {k: cp3(v) for k, v in caps.items()}
+        return (occ, first_ts, head_seq, cnt, cnt_on, narm, fl, dl, caps,
+                dst, lost)
+
+    def _landing_from(self, pi_from: int):
+        """Station landing after pi_from, skipping mid-chain optional
+        counts (min 0): returns (landing_pi, [skipped positions])."""
+        t = pi_from + 1
+        mids = []
+        S = self.spec.S
+        while (t < S - 1 and self.spec.positions[t].is_count
+               and self.spec.positions[t].min_count == 0):
+            mids.append(t)
+            t += 1
+        return t, mids
+
     def _present_zero(self, refs: Optional[set] = None) -> dict:
         """Zero-writes for presence rows (base + per-index) — applied when
         a slot is reused or advances into a position, so a previous life's
@@ -960,7 +1077,8 @@ class NFAKernel:
             base, cidx = _base_ref(rp)
             if base != n.ref:
                 continue
-            want = 2 if cidx == "last-1" else int(cidx) + 1
+            want = (1 if cidx == "last"
+                    else 2 if cidx == "last-1" else int(cidx) + 1)
             g, i = self._row_of[pkey]
             cur = caps[f"caps_{g}"][i]
             vals[pkey] = jnp.where(newc >= jnp.int32(want), jnp.int32(1), cur)
@@ -1067,10 +1185,13 @@ class NFAKernel:
             if head.op == "or":
                 # one side suffices: complete (S==1) or advance immediately
                 done = hot & (bits != 0)
-                occ = jnp.where(done, PARK if self.spec.S == 1 else 2, occ)
+                land, mids = self._landing_from(0)
+                occ = jnp.where(done,
+                                PARK if self.spec.S == 1 else land + 1, occ)
                 if self.spec.S > 1:
-                    cnt, cnt_on, narm, fl, dl = self._enter_position(
-                        1, done, cnt, cnt_on, narm, fl, dl, ts)
+                    for t in (*mids, land):
+                        cnt, cnt_on, narm, fl, dl = self._enter_position(
+                            t, done, cnt, cnt_on, narm, fl, dl, ts)
         elif head.is_count:
             c = head.cnt_row
             occ = jnp.where(hot, 1, occ)
@@ -1089,12 +1210,14 @@ class NFAKernel:
             if self.spec.S == 1 and head.min_count <= 1:
                 occ = jnp.where(hot, PARK, occ)   # immediate first emission
         else:
-            occ = jnp.where(hot, 2, occ)
+            land, mids = self._landing_from(0)
+            occ = jnp.where(hot, land + 1, occ)
             vals = self._capture_values(x, head.nodes[0])
             caps = self._write_caps(caps, hot, vals)
             if self.spec.S > 1:
-                cnt, cnt_on, narm, fl, dl = self._enter_position(
-                    1, hot, cnt, cnt_on, narm, fl, dl, ts)
+                for t in (*mids, land):
+                    cnt, cnt_on, narm, fl, dl = self._enter_position(
+                        t, hot, cnt, cnt_on, narm, fl, dl, ts)
         return occ, cnt, cnt_on, narm, fl, dl, caps
 
     def _emit_single(self, x, n: PNode, ts, seq, ok0):

@@ -181,6 +181,7 @@ class DevicePatternPlan(QueryPlan):
         self._chunk_cfg = None
         if (not broadcast_events and part_key_fns is None
                 and self.P == 1 and self.mesh is None
+                and getattr(rt, "_async_workers", 1) == 1
                 and self.spec.every_head and not self.kernel.has_absent
                 and all(p.within_ms is not None for p in self.spec.positions)):
             lanes_ann = ast.find_annotation(rt.app.annotations,

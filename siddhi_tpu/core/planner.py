@@ -32,6 +32,20 @@ AGGREGATOR_NAMES = {
 }
 
 
+def mesh_for(rt, axis: str):
+    """Opt-in execution mesh for the batch-sharded kernels (window-agg,
+    incremental agg): @app:deviceMesh('always') with a power-of-two
+    device count; returns None otherwise.  (Pattern plans have their own
+    auto policy keyed on partition count.)"""
+    if str(getattr(rt, "device_mesh", "auto")).lower() != "always":
+        return None
+    ndev = len(jax.devices())
+    if ndev <= 1 or ndev & (ndev - 1):
+        return None
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()), (axis,))
+
+
 class PlanError(Exception):
     pass
 

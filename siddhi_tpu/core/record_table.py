@@ -310,7 +310,9 @@ STORE_TYPES: dict = {"memory": InMemoryRecordStore,
                      "teststore": InMemoryRecordStore}
 
 
-def register_store_type(name: str, cls) -> None:
+def register_store_type(name: str, cls, meta=None) -> None:
+    from ..extension import register_meta
+    register_meta("store", meta)
     STORE_TYPES[name.lower()] = cls
 
 

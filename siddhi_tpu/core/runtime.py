@@ -107,10 +107,35 @@ class SiddhiAppRuntime:
         dm = qast.find_annotation(app.annotations, "app:deviceMesh")
         self.device_mesh = dm.element() if dm is not None else "auto"
         # @Async analog (reference StreamJunction Disruptor ring): ingest
-        # worker decouples send() from flush/compute so host batch assembly
-        # overlaps device execution
-        self._async = qast.find_annotation(app.annotations, "app:async") \
-            is not None
+        # worker(s) decouple send() from flush/compute so host batch
+        # assembly overlaps device execution.  Knobs mirror the reference
+        # @Async(workers=..., batch.size.max=..., buffer.size=...)
+        # (StreamJunction.java:299-307): workers>1 trades CROSS-BATCH
+        # ORDER for concurrency exactly as the reference junction does.
+        asy = qast.find_annotation(app.annotations, "app:async")
+        self._async = asy is not None
+        self._async_workers = 1
+        self._async_buffer = 8
+        if asy is not None:
+            def _el(key):
+                return next((v for k, v in asy.elements if k and
+                             k.lower() == key), None)
+            w = _el("workers")
+            if w is not None:
+                self._async_workers = max(1, int(w))
+            bs = _el("batch.size.max")
+            if bs is not None:
+                self.batch_capacity = max(1, int(bs))
+            bf = _el("buffer.size")
+            if bf is not None:
+                self._async_buffer = max(1, int(bf))
+            if self._async_workers > 1:
+                import warnings
+                warnings.warn(
+                    f"@app:async(workers={self._async_workers}): cross-batch "
+                    f"ordering is not preserved with multiple workers (same "
+                    f"trade as the reference multi-worker StreamJunction)",
+                    RuntimeWarning, stacklevel=2)
         # auto-batching to a latency target: builders flush when their
         # oldest buffered event has waited this long, so micro-batch size
         # adapts to the event rate instead of always filling batchCapacity
@@ -283,7 +308,8 @@ class SiddhiAppRuntime:
         Disruptor + StreamHandler drain, StreamJunction.java:280-316)."""
         import queue as _queue
         import threading
-        self._ingest_q = _queue.Queue(maxsize=8)   # bounded: backpressure
+        # bounded: backpressure (reference buffer.size ring capacity)
+        self._ingest_q = _queue.Queue(maxsize=self._async_buffer)
 
         def worker():
             while True:
@@ -306,6 +332,12 @@ class SiddhiAppRuntime:
         self._ingest_thread = threading.Thread(
             target=worker, name="siddhi-ingest", daemon=True)
         self._ingest_thread.start()
+        self._extra_workers = []
+        for i in range(self._async_workers - 1):
+            t = threading.Thread(target=worker,
+                                 name=f"siddhi-ingest-{i + 1}", daemon=True)
+            t.start()
+            self._extra_workers.append(t)
 
     def _start_scheduler(self) -> None:
         """Wall-clock timer pump: fires due timers (time windows, rate
@@ -415,9 +447,14 @@ class SiddhiAppRuntime:
             try:
                 self._async_barrier()    # deliver everything still queued
             finally:
-                self._ingest_q.put(None)
+                extras = getattr(self, "_extra_workers", [])
+                for _ in range(1 + len(extras)):
+                    self._ingest_q.put(None)     # one sentinel per worker
                 self._ingest_thread.join(timeout=5)
+                for t in extras:
+                    t.join(timeout=5)
                 self._ingest_thread = None
+                self._extra_workers = []
                 self._ingest_q = None    # flush() falls back to sync path
         if self._sched_stop is not None:
             self._sched_stop.set()
@@ -913,12 +950,32 @@ class InMemoryPersistenceStore:
 
 
 class SiddhiManager:
-    """reference: core:SiddhiManager.java:45"""
+    """reference: core:SiddhiManager.java:45
 
-    def __init__(self):
+    `isolated_broker=True` scopes inMemory source/sink topics to this
+    manager (its `.broker`); the default matches the reference's
+    process-global InMemoryBroker (same-named topics cross-deliver
+    between managers — use isolation when embedding several apps)."""
+
+    def __init__(self, isolated_broker: bool = False):
         self.persistence_store = None
         self.config_manager = None      # ConfigManager SPI (core/config.py)
         self._runtimes: dict = {}
+        self.broker = None
+        if isolated_broker:
+            from .io import Broker
+            self.broker = Broker()
+        # HA interception SPI (reference: SourceHandlerManager /
+        # SinkHandlerManager registered on SiddhiManager): factories
+        # producing a handler per source/sink at build time
+        self.source_handler_factory = None
+        self.sink_handler_factory = None
+
+    def set_source_handler_factory(self, factory) -> None:
+        self.source_handler_factory = factory
+
+    def set_sink_handler_factory(self, factory) -> None:
+        self.sink_handler_factory = factory
 
     def create_app_runtime(self, app: Union[str, qast.SiddhiApp]) -> SiddhiAppRuntime:
         if isinstance(app, str):

@@ -39,9 +39,11 @@ class Tracker:
 REPORTERS: dict = {}
 
 
-def register_stats_reporter(name: str, fn) -> None:
+def register_stats_reporter(name: str, fn, meta=None) -> None:
     """fn(app_name, report_dict) — the reporter SPI (reference:
     SiddhiStatisticsManager.java:35-85 console/JMX reporters)."""
+    from ..extension import register_meta
+    register_meta("stats-reporter", meta)
     REPORTERS[name.lower()] = fn
 
 

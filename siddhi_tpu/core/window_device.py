@@ -380,6 +380,14 @@ class DeviceWindowAggPlan(QueryPlan):
         self.pipeline_depth = int(pl.element()) if pl is not None else 0
         self._inflight: list = []
 
+        # multi-chip: @app:deviceMesh('always') shards the batch axis T
+        # over the mesh — XLA partitions the prefix/segmented scans and
+        # inserts the cross-shard collectives (the jax way: annotate
+        # shardings, let the partitioner place psum/permute chains).
+        # Carry state replicates (it is O(window), not O(batch)).
+        from .planner import mesh_for
+        self.mesh = mesh_for(rt, "t")
+
         self.state = self._init_state()
         jax.eval_shape(self._step_fn(8, self.C), self.state, self._dummy(8))
 
@@ -713,7 +721,15 @@ class DeviceWindowAggPlan(QueryPlan):
             return out
 
         mode = self._mode
-        return jax.jit(step)
+        if self.mesh is None:
+            return jax.jit(step)
+        from jax.sharding import NamedSharding, PartitionSpec
+        shard_t = NamedSharding(self.mesh, PartitionSpec("t"))
+        repl = NamedSharding(self.mesh, PartitionSpec())
+        state_sh = {k: repl for k in self.state}
+        env_sh = {"__timestamp__": shard_t, "__valid__": shard_t}
+        env_sh.update({c: shard_t for c in cols})
+        return jax.jit(step, in_shardings=(state_sh, env_sh))
 
     # -- QueryPlan interface --------------------------------------------------
 
@@ -721,6 +737,9 @@ class DeviceWindowAggPlan(QueryPlan):
         if batch.n == 0:
             return []
         T = pow2_at_least(batch.n)
+        if self.mesh is not None:
+            # the sharded 't' axis must divide the device count
+            T = max(T, self.mesh.devices.size)
         env = {"__timestamp__": _pad(batch.timestamps, T, 0),
                "__valid__": _pad(np.ones(batch.n, bool), T, False)}
         for c in self.cols:

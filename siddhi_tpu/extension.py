@@ -1,0 +1,338 @@
+"""Extension metadata tier — the `@Extension` annotation analog.
+
+Reference: modules/siddhi-annotations/src/main/java/org/wso2/siddhi/
+annotation/Extension.java:52 (name/namespace/description/parameters/
+examples carried on every extension class) and
+SiddhiAnnotationProcessor.java:55-73 (compile-time validation: names
+must be declared and non-empty, descriptions mandatory, each @Parameter
+and @Example fully populated).  Here registration time IS compile time:
+`register_*(..., meta=ExtensionMeta(...))` validates eagerly and feeds
+the central registry that `docgen` renders.
+
+Built-in windows/aggregators are compiled directly (no registry
+objects), so their metadata lives in BUILTIN_META below — the docgen
+"every built-in has parameters + examples" guarantee comes from the
+test suite asserting this table covers the parser's built-in surface.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+class ExtensionError(Exception):
+    """Invalid extension metadata (registration-time validation)."""
+
+
+@dataclass(frozen=True)
+class Parameter:
+    name: str
+    type: tuple = ()            # accepted attribute types, e.g. ("INT",)
+    description: str = ""
+    optional: bool = False
+    default: object = None
+
+
+@dataclass(frozen=True)
+class Example:
+    syntax: str
+    description: str = ""
+
+
+@dataclass(frozen=True)
+class ExtensionMeta:
+    name: str
+    description: str
+    namespace: str = ""
+    parameters: tuple = ()
+    examples: tuple = ()
+    returns: str = ""
+
+
+def validate_meta(meta: ExtensionMeta, kind: str = "extension") -> None:
+    """Registration-time validation (SiddhiAnnotationProcessor analog):
+    fail LOUDLY at registration, not when a user first reads the docs."""
+    problems = []
+    if not meta.name or not str(meta.name).strip():
+        problems.append("name must be non-empty")
+    elif any(c.isspace() for c in meta.name):
+        problems.append(f"name {meta.name!r} must not contain whitespace")
+    if not meta.description or not str(meta.description).strip():
+        problems.append(f"{meta.name!r}: description is mandatory")
+    for p in meta.parameters:
+        if not isinstance(p, Parameter):
+            problems.append(f"{meta.name!r}: parameters must be Parameter "
+                            f"instances (got {type(p).__name__})")
+            continue
+        if not p.name:
+            problems.append(f"{meta.name!r}: parameter with empty name")
+        if not p.description:
+            problems.append(
+                f"{meta.name!r}: parameter {p.name!r} needs a description")
+        if not p.type:
+            problems.append(
+                f"{meta.name!r}: parameter {p.name!r} needs accepted types")
+        if p.optional and p.default is None and "none" not in \
+                [str(t).lower() for t in p.type]:
+            problems.append(
+                f"{meta.name!r}: optional parameter {p.name!r} needs a "
+                f"default value")
+    for e in meta.examples:
+        if not isinstance(e, Example):
+            problems.append(f"{meta.name!r}: examples must be Example "
+                            f"instances (got {type(e).__name__})")
+            continue
+        if not e.syntax:
+            problems.append(f"{meta.name!r}: example with empty syntax")
+        if not e.description:
+            problems.append(
+                f"{meta.name!r}: example {e.syntax[:30]!r} needs a "
+                f"description")
+    if problems:
+        raise ExtensionError(
+            f"invalid {kind} metadata: " + "; ".join(problems))
+
+
+# central metadata registry: (kind, namespace, lowercase name) -> meta
+_REGISTRY: dict = {}
+
+
+def register_meta(kind: str, meta) -> None:
+    """Validate + index extension metadata; None is a no-op so the
+    register_* SPI can forward its optional `meta` unconditionally."""
+    if meta is None:
+        return
+    validate_meta(meta, kind)
+    _REGISTRY[(kind, meta.namespace or "", meta.name.lower())] = meta
+
+
+def meta_for(kind: str, name: str, namespace: str = ""):
+    return _REGISTRY.get((kind, namespace or "", name.lower()))
+
+
+def all_meta(kind: str) -> list:
+    return sorted((m for (k, _ns, _n), m in _REGISTRY.items() if k == kind),
+                  key=lambda m: (m.namespace, m.name))
+
+
+# ---------------------------------------------------------------------------
+# built-in surface metadata (windows + aggregators compile directly; the
+# registries only hold user extensions, so the built-ins declare here)
+# ---------------------------------------------------------------------------
+
+def _w(name, desc, params, example, edesc, returns="current + expired "
+       "events per the window's retention policy"):
+    return ExtensionMeta(name=name, description=desc, parameters=params,
+                         examples=(Example(example, edesc),),
+                         returns=returns)
+
+
+_NUM = ("INT", "LONG", "FLOAT", "DOUBLE")
+_TIME = ("TIME (constant like `1 sec`)", "LONG (millis)")
+
+BUILTIN_WINDOWS = [
+    _w("length",
+       "Sliding window holding the most recent N events (reference "
+       "LengthWindowProcessor).",
+       (Parameter("window.length", ("INT",), "number of events retained"),),
+       "from S#window.length(10) select sum(x) as s insert into O;",
+       "running sum over the last 10 events"),
+    _w("lengthBatch",
+       "Tumbling window emitting every N-th event as one batch "
+       "(reference LengthBatchWindowProcessor).",
+       (Parameter("window.length", ("INT",), "batch size in events"),),
+       "from S#window.lengthBatch(4) select avg(x) as m insert into O;",
+       "average per completed 4-event batch"),
+    _w("time",
+       "Sliding window holding events younger than D (reference "
+       "TimeWindowProcessor).",
+       (Parameter("window.time", _TIME, "retention duration"),),
+       "from S#window.time(1 sec) select count() as c insert into O;",
+       "events seen in the last second"),
+    _w("timeBatch",
+       "Tumbling window emitting once per period D (reference "
+       "TimeBatchWindowProcessor).",
+       (Parameter("window.time", _TIME, "batch period"),
+        Parameter("start.time", ("INT", "LONG"),
+                  "anchor offset for the first batch", optional=True,
+                  default=0),),
+       "from S#window.timeBatch(5 sec) select sum(x) as s insert into O;",
+       "per-5-second tumbling sums"),
+    _w("timeLength",
+       "Sliding window bounded by BOTH a duration and a max event count "
+       "(reference TimeLengthWindowProcessor).",
+       (Parameter("window.time", _TIME, "retention duration"),
+        Parameter("window.length", ("INT",), "max events retained"),),
+       "from S#window.timeLength(2 sec, 10) select avg(x) as m "
+       "insert into O;",
+       "average over at most 10 events no older than 2s"),
+    _w("externalTime",
+       "Sliding duration window driven by an event attribute instead of "
+       "the wall clock (reference ExternalTimeWindowProcessor).",
+       (Parameter("timestamp", ("LONG",),
+                  "attribute carrying event time in millis"),
+        Parameter("window.time", _TIME, "retention duration"),),
+       "from S#window.externalTime(ts, 1 sec) select count() as c "
+       "insert into O;",
+       "event-time sliding count"),
+    _w("externalTimeBatch",
+       "Tumbling duration window driven by an event attribute (reference "
+       "ExternalTimeBatchWindowProcessor).",
+       (Parameter("timestamp", ("LONG",), "event-time attribute"),
+        Parameter("window.time", _TIME, "batch period"),
+        Parameter("start.time", ("INT", "LONG"), "first batch anchor",
+                  optional=True, default=0),
+        Parameter("timeout", _TIME, "flush an incomplete batch after "
+                  "this idle time", optional=True, default=0),),
+       "from S#window.externalTimeBatch(ts, 1 sec) select sum(x) as s "
+       "insert into O;",
+       "event-time tumbling sums"),
+    _w("batch",
+       "Re-emits each arriving micro-batch as one window generation "
+       "(reference BatchWindowProcessor).",
+       (Parameter("window.length", ("INT",), "optional size cap",
+                  optional=True, default=0),),
+       "from S#window.batch() select x insert into O;",
+       "pass each ingest batch through as a unit"),
+    _w("session",
+       "Groups events into sessions separated by a silence gap "
+       "(reference SessionWindowProcessor).",
+       (Parameter("session.gap", _TIME, "idle gap ending a session"),
+        Parameter("session.key", ("STRING",), "per-key sessions",
+                  optional=True, default="single shared session"),
+        Parameter("allowed.latency", _TIME, "late-arrival grace",
+                  optional=True, default=0),),
+       "from S#window.session(2 sec, user) select user, count() as c "
+       "insert into O;",
+       "events per user session"),
+    _w("sort",
+       "Keeps the top/bottom N events by a sort key (reference "
+       "SortWindowProcessor).",
+       (Parameter("window.length", ("INT",), "events retained"),
+        Parameter("attribute", ("any comparable attribute",),
+                  "sort key(s), each optionally followed by 'asc'/'desc'"),),
+       "from S#window.sort(5, price, 'desc') select price insert into O;",
+       "the 5 highest prices seen"),
+    _w("delay",
+       "Re-emits events after a fixed delay (reference "
+       "DelayWindowProcessor).",
+       (Parameter("window.delay", _TIME, "delay duration"),),
+       "from S#window.delay(1 sec) select x insert into O;",
+       "everything shifted one second later"),
+    _w("frequent",
+       "Retains the N most frequently recurring event groups "
+       "(reference FrequentWindowProcessor, Misra-Gries).",
+       (Parameter("event.count", ("INT",), "distinct groups retained"),
+        Parameter("attribute", ("any attribute",),
+                  "grouping attributes (defaults to all)", optional=True,
+                  default="all attributes"),),
+       "from S#window.frequent(3, sym) select sym insert into O;",
+       "events of the 3 most frequent symbols"),
+    _w("lossyFrequent",
+       "Frequency-threshold retention with bounded error (reference "
+       "LossyFrequentWindowProcessor, lossy counting).",
+       (Parameter("support.threshold", ("DOUBLE",),
+                  "minimum frequency fraction"),
+        Parameter("error.bound", ("DOUBLE",), "allowed undercount",
+                  optional=True, default="support/10"),
+        Parameter("attribute", ("any attribute",), "grouping attributes",
+                  optional=True, default="all attributes"),),
+       "from S#window.lossyFrequent(0.1, 0.01) select * insert into O;",
+       "events whose group exceeds 10% frequency"),
+    _w("cron",
+       "Tumbling window flushed on a cron schedule (reference "
+       "CronWindowProcessor).",
+       (Parameter("cron.expression", ("STRING",),
+                  "quartz-style cron schedule"),),
+       "from S#window.cron('0 * * * * ?') select count() as c "
+       "insert into O;",
+       "per-minute counts"),
+]
+
+_AGG_RET = "one aggregated value per group per output event"
+
+BUILTIN_AGGREGATORS = [
+    ExtensionMeta("sum", "Sum of the argument over the window/group "
+                  "(reference SumAttributeAggregator).",
+                  parameters=(Parameter("arg", _NUM, "value to sum"),),
+                  examples=(Example(
+                      "select sum(volume) as v", "total volume"),),
+                  returns="LONG for int/long args, DOUBLE otherwise"),
+    ExtensionMeta("count", "Event count (reference "
+                  "CountAttributeAggregator).",
+                  parameters=(Parameter("arg", ("none",),
+                                        "no argument: counts events",
+                                        optional=True, default="-"),),
+                  examples=(Example("select count() as c", "group size"),),
+                  returns="LONG"),
+    ExtensionMeta("avg", "Arithmetic mean (reference "
+                  "AvgAttributeAggregator).",
+                  parameters=(Parameter("arg", _NUM, "value to average"),),
+                  examples=(Example("select avg(price) as p", "mean "
+                                    "price"),),
+                  returns="DOUBLE"),
+    ExtensionMeta("min", "Minimum within the window/group (reference "
+                  "MinAttributeAggregator); expired events restore "
+                  "earlier minima.",
+                  parameters=(Parameter("arg", _NUM + ("STRING",),
+                                        "value to minimize"),),
+                  examples=(Example("select min(price) as lo",
+                                    "lowest retained price"),),
+                  returns=_AGG_RET),
+    ExtensionMeta("max", "Maximum within the window/group (reference "
+                  "MaxAttributeAggregator).",
+                  parameters=(Parameter("arg", _NUM + ("STRING",),
+                                        "value to maximize"),),
+                  examples=(Example("select max(price) as hi",
+                                    "highest retained price"),),
+                  returns=_AGG_RET),
+    ExtensionMeta("minForever", "All-time minimum — never expires "
+                  "(reference MinForeverAttributeAggregator).",
+                  parameters=(Parameter("arg", _NUM, "value"),),
+                  examples=(Example("select minForever(price) as lo",
+                                    "lowest price ever seen"),),
+                  returns=_AGG_RET),
+    ExtensionMeta("maxForever", "All-time maximum (reference "
+                  "MaxForeverAttributeAggregator).",
+                  parameters=(Parameter("arg", _NUM, "value"),),
+                  examples=(Example("select maxForever(price) as hi",
+                                    "highest price ever seen"),),
+                  returns=_AGG_RET),
+    ExtensionMeta("stdDev", "Population standard deviation (reference "
+                  "StdDevAttributeAggregator).",
+                  parameters=(Parameter("arg", _NUM, "value"),),
+                  examples=(Example("select stdDev(price) as sd",
+                                    "price volatility"),),
+                  returns="DOUBLE"),
+    ExtensionMeta("distinctCount", "Count of distinct argument values "
+                  "(reference DistinctCountAttributeAggregator).",
+                  parameters=(Parameter("arg", ("any attribute",),
+                                        "value whose distincts count"),),
+                  examples=(Example("select distinctCount(sym) as n",
+                                    "distinct symbols in window"),),
+                  returns="LONG"),
+    ExtensionMeta("and", "Boolean AND over the group (reference "
+                  "AndAttributeAggregator).",
+                  parameters=(Parameter("arg", ("BOOL",), "conditions"),),
+                  examples=(Example("select and(ok) as allOk",
+                                    "true when every event is ok"),),
+                  returns="BOOL"),
+    ExtensionMeta("or", "Boolean OR over the group (reference "
+                  "OrAttributeAggregator).",
+                  parameters=(Parameter("arg", ("BOOL",), "conditions"),),
+                  examples=(Example("select or(alarm) as anyAlarm",
+                                    "true when any event alarms"),),
+                  returns="BOOL"),
+    ExtensionMeta("unionSet", "Accumulates values into a set (reference "
+                  "UnionSetAttributeAggregator).",
+                  parameters=(Parameter("arg", ("OBJECT (set)",
+                                                "any attribute"),
+                                        "sets/values to union"),),
+                  examples=(Example("select unionSet(createSet(sym)) as "
+                                    "syms", "set of symbols seen"),),
+                  returns="OBJECT (set)"),
+]
+
+for _m in BUILTIN_WINDOWS:
+    register_meta("window", _m)
+for _m in BUILTIN_AGGREGATORS:
+    register_meta("aggregator", _m)

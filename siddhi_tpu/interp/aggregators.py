@@ -312,10 +312,12 @@ def aggregator_out_type(name: str, in_type: Optional[AttrType]) -> AttrType:
     return make_aggregator(name, in_type).type
 
 
-def register_aggregator(name: str, cls) -> None:
+def register_aggregator(name: str, cls, meta=None) -> None:
     """Extension point: a custom attribute aggregator class (ctor takes
     in_type; implements add/remove/reset/value/state/restore — the
     reference's @Extension AttributeAggregator protocol)."""
     from ..core.planner import AGGREGATOR_NAMES
+    from ..extension import register_meta
+    register_meta("aggregator", meta)
     AGGREGATOR_CLASSES[name.lower()] = cls
     AGGREGATOR_NAMES.add(name.lower())

@@ -495,8 +495,11 @@ def make_window(h: ast.WindowHandler, ctx: PyExprContext,
 WINDOW_TYPES: dict = {}
 
 
-def register_window_type(name: str, builder, namespace: str = None) -> None:
+def register_window_type(name: str, builder, namespace: str = None,
+                         meta=None) -> None:
     """builder(args: tuple[ast expr], ctx: PyExprContext, schema) -> Window"""
+    from ..extension import register_meta
+    register_meta("window", meta)
     WINDOW_TYPES[(namespace.lower() if namespace else None,
                   name.lower())] = builder
 
@@ -510,9 +513,12 @@ def register_window_type(name: str, builder, namespace: str = None) -> None:
 STREAM_FUNCTIONS: dict = {}
 
 
-def register_stream_function(name: str, builder, namespace: str = None) -> None:
+def register_stream_function(name: str, builder, namespace: str = None,
+                             meta=None) -> None:
     """builder(args, ctx, in_schema, query_name) ->
     (out_schema, fn(Event) -> list[row_tuple])"""
+    from ..extension import register_meta
+    register_meta("stream-function", meta)
     STREAM_FUNCTIONS[(namespace.lower() if namespace else None,
                       name.lower())] = builder
 

@@ -74,8 +74,11 @@ class PyExprContext:
 PY_FUNCTIONS: dict = {}
 
 
-def register_py_function(name: str, builder, namespace: Optional[str] = None):
+def register_py_function(name: str, builder, namespace: Optional[str] = None,
+                         meta=None):
     """builder(args: list[(PyFn, AttrType)]) -> (PyFn, AttrType)"""
+    from ..extension import register_meta
+    register_meta("function", meta)
     PY_FUNCTIONS[(namespace, name.lower())] = builder
 
 

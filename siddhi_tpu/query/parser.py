@@ -200,8 +200,13 @@ class Parser:
                         nested.append(self.parse_annotation())
                     else:
                         t = self.peek()
-                        if t.type == TokenType.IDENT and self.at_op("=", ahead=1):
+                        if t.type == TokenType.IDENT and (
+                                self.at_op("=", ahead=1)
+                                or self.at_op(".", ahead=1)):
+                            # dotted keys: @app:async(batch.size.max='4')
                             key = self.ident()
+                            while self.try_op("."):
+                                key += "." + self.ident()
                             self.eat_op("=")
                             elements.append((key, self._annotation_value()))
                         else:

@@ -1,0 +1,89 @@
+"""Extension metadata tier (reference: siddhi-annotations @Extension +
+SiddhiAnnotationProcessor.java:55-73 compile-time validation) and the
+doc generator built on it (reference: siddhi-doc-gen)."""
+import pytest
+
+from siddhi_tpu.extension import (Example, ExtensionError, ExtensionMeta,
+                                  Parameter, all_meta, meta_for,
+                                  validate_meta)
+from siddhi_tpu import docgen
+
+# the parser's built-in window dispatch (interp/engine.py make_window)
+BUILTIN_WINDOW_NAMES = [
+    "length", "lengthbatch", "time", "timebatch", "externaltime",
+    "externaltimebatch", "timelength", "batch", "session", "sort",
+    "delay", "frequent", "lossyfrequent", "cron"]
+
+
+def test_every_builtin_window_has_full_metadata():
+    have = {m.name.lower(): m for m in all_meta("window")}
+    for name in BUILTIN_WINDOW_NAMES:
+        m = have.get(name)
+        assert m is not None, f"built-in window {name} missing metadata"
+        assert m.description and m.parameters and m.examples, name
+        for p in m.parameters:
+            assert p.name and p.description and p.type, (name, p)
+        for e in m.examples:
+            assert e.syntax and e.description, (name, e)
+
+
+def test_every_builtin_aggregator_has_full_metadata():
+    from siddhi_tpu.interp.aggregators import AGGREGATOR_CLASSES
+    have = {m.name.lower(): m for m in all_meta("aggregator")}
+    for name in AGGREGATOR_CLASSES:
+        m = have.get(name)
+        assert m is not None, f"aggregator {name} missing metadata"
+        assert m.description and m.parameters and m.examples, name
+        assert m.returns, name
+
+
+def test_docgen_renders_params_and_examples():
+    md = docgen.generate_markdown()
+    for name in BUILTIN_WINDOW_NAMES:
+        # section header present (case preserved in metadata table)
+        assert f"`{name}`" in md.lower(), name
+    assert "| parameter | types | description |" in md
+    assert "```siddhi" in md
+    assert "**Returns**:" in md
+    # a known example renders
+    assert "from S#window.length(10)" in md
+
+
+def test_validation_rejects_incomplete_meta():
+    with pytest.raises(ExtensionError, match="description is mandatory"):
+        validate_meta(ExtensionMeta("x", ""))
+    with pytest.raises(ExtensionError, match="needs a description"):
+        validate_meta(ExtensionMeta(
+            "x", "ok", parameters=(Parameter("p", ("INT",), ""),)))
+    with pytest.raises(ExtensionError, match="needs accepted types"):
+        validate_meta(ExtensionMeta(
+            "x", "ok", parameters=(Parameter("p", (), "d"),)))
+    with pytest.raises(ExtensionError, match="example with empty syntax"):
+        validate_meta(ExtensionMeta("x", "ok", examples=(Example(""),)))
+    with pytest.raises(ExtensionError, match="whitespace"):
+        validate_meta(ExtensionMeta("bad name", "ok"))
+
+
+def test_register_with_meta_flows_to_docs():
+    from siddhi_tpu.interp.engine import WINDOW_TYPES, register_window_type
+    meta = ExtensionMeta(
+        "testwin", "A test window retaining everything.",
+        parameters=(Parameter("n", ("INT",), "retention count"),),
+        examples=(Example("from S#window.testwin(5) select * insert into O;",
+                          "keeps 5"),))
+    register_window_type("testwin", lambda a, c, s: None, meta=meta)
+    try:
+        assert meta_for("window", "testwin") is meta
+        md = docgen.generate_markdown()
+        assert "A test window retaining everything." in md
+        assert "retention count" in md
+    finally:
+        WINDOW_TYPES.pop((None, "testwin"), None)
+
+
+def test_register_with_bad_meta_raises_at_registration():
+    from siddhi_tpu.interp.engine import register_window_type
+    with pytest.raises(ExtensionError):
+        register_window_type(
+            "badwin", lambda a, c, s: None,
+            meta=ExtensionMeta("badwin", ""))

@@ -1,0 +1,226 @@
+"""Round-4 parity tail: @payload templating + text mappers (reference:
+core:util/transport/TemplateBuilder.java, siddhi-map-text), broker
+isolation, HA Source/SinkHandler SPI, @app:async knobs, and the fluent
+programmatic query API (reference: SiddhiApp.java:72-198)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.io import InMemoryBroker
+from siddhi_tpu.core.planner import PlanError
+
+
+def _collect(topic, broker=InMemoryBroker):
+    got = []
+    broker.subscribe(topic, got.append)
+    return got
+
+
+def test_payload_template_sink():
+    app = """
+    @sink(type='inMemory', topic='t1',
+          @map(type='text', @payload('{{symbol}} went to {{price}}')))
+    define stream S (symbol string, price double);
+    """
+    got = _collect("t1")
+    m = SiddhiManager()
+    rt = m.create_app_runtime(app)
+    rt.start()
+    rt.input_handler("S").send(("WSO2", 55.5))
+    rt.flush()
+    m.shutdown()
+    assert got == ["WSO2 went to 55.5"]
+
+
+def test_payload_template_unknown_attr_raises():
+    app = """
+    @sink(type='inMemory', topic='t2',
+          @map(type='text', @payload('{{nope}}')))
+    define stream S (symbol string);
+    """
+    with pytest.raises(PlanError, match="unknown attribute 'nope'"):
+        SiddhiManager().create_app_runtime(app)
+
+
+def test_text_sink_default_format():
+    app = """
+    @sink(type='inMemory', topic='t3', @map(type='text'))
+    define stream S (symbol string, price double, volume int);
+    """
+    got = _collect("t3")
+    m = SiddhiManager()
+    rt = m.create_app_runtime(app)
+    rt.start()
+    rt.input_handler("S").send(("WSO2", 55.5, 10))
+    rt.flush()
+    m.shutdown()
+    assert got == ['symbol:"WSO2",\nprice:55.5,\nvolume:10']
+
+
+def test_text_source_parses_default_format():
+    app = """
+    @source(type='inMemory', topic='t4', @map(type='text'))
+    define stream S (symbol string, price double, volume int);
+    @info(name='q') from S select * insert into Out;
+    """
+    m = SiddhiManager()
+    rt = m.create_app_runtime(app)
+    rows = []
+    rt.add_callback("Out", lambda evs: rows.extend(tuple(e.data) for e in evs))
+    rt.start()
+    InMemoryBroker.publish("t4", 'symbol:"IBM",\nprice:75.25,\nvolume:42')
+    m.shutdown()
+    assert rows == [("IBM", 75.25, 42)]
+
+
+def test_text_roundtrip_sink_to_source():
+    """Parity loop: text sink output feeds a text source unchanged."""
+    app = """
+    @sink(type='inMemory', topic='loop', @map(type='text'))
+    define stream A (symbol string, price double, volume int);
+    @source(type='inMemory', topic='loop', @map(type='text'))
+    define stream B (symbol string, price double, volume int);
+    @info(name='q') from B select * insert into Out;
+    """
+    m = SiddhiManager()
+    rt = m.create_app_runtime(app)
+    rows = []
+    rt.add_callback("Out", lambda evs: rows.extend(tuple(e.data) for e in evs))
+    rt.start()
+    rt.input_handler("A").send(("X", 1.5, 3))
+    rt.flush()
+    m.shutdown()
+    assert rows == [("X", 1.5, 3)]
+
+
+def test_isolated_brokers_do_not_cross_deliver():
+    app_sink = ("@sink(type='inMemory', topic='shared') "
+                "define stream S (x int);")
+    app_src = ("@source(type='inMemory', topic='shared') "
+               "define stream R (x int);\n"
+               "@info(name='q') from R select x insert into Out;")
+    m1 = SiddhiManager(isolated_broker=True)
+    m2 = SiddhiManager(isolated_broker=True)
+    rt1 = m1.create_app_runtime(app_sink)
+    rt2 = m2.create_app_runtime(app_src)
+    rows = []
+    rt2.add_callback("Out", lambda evs: rows.extend(e.data for e in evs))
+    rt1.start()
+    rt2.start()
+    rt1.input_handler("S").send((1,))
+    rt1.flush()
+    assert rows == []           # different managers: no cross-talk
+    # same manager's broker delivers
+    m1.broker.subscribe("shared", lambda msg: rows.append(("raw", msg)))
+    rt1.input_handler("S").send((2,))
+    rt1.flush()
+    assert rows == [("raw", (2,))]
+    m1.shutdown()
+    m2.shutdown()
+
+
+def test_source_sink_handlers_intercept():
+    from siddhi_tpu.core.io import SinkHandler, SourceHandler
+
+    class DropOdd(SourceHandler):
+        def on_rows(self, rows):
+            return [(ts, r) for ts, r in rows if r[0] % 2 == 0]
+
+    class Tag(SinkHandler):
+        def on_events(self, events):
+            return events       # passive observer
+    seen = []
+
+    class Spy(Tag):
+        def on_events(self, events):
+            seen.extend(e.data for e in events)
+            return events
+
+    m = SiddhiManager()
+    m.set_source_handler_factory(DropOdd)
+    m.set_sink_handler_factory(Spy)
+    app = """
+    @source(type='callback')
+    @sink(type='inMemory', topic='h1')
+    define stream S (x int);
+    """
+    got = _collect("h1")
+    rt = m.create_app_runtime(app)
+    rt.start()
+    src = rt.sources_for("S")[0]
+    assert src.handler is not None
+    src.deliver([(1,), (2,), (3,), (4,)])
+    m.shutdown()
+    assert got == [(2,), (4,)]          # odd rows swallowed by the handler
+    assert seen == [(2,), (4,)]         # sink handler observed deliveries
+
+
+def test_async_knobs_parse_and_run():
+    app = """
+    @app:async(workers='2', batch.size.max='4', buffer.size='16')
+    define stream S (x int);
+    @info(name='q') from S select x insert into Out;
+    """
+    with pytest.warns(RuntimeWarning, match="cross-batch ordering"):
+        m = SiddhiManager()
+        rt = m.create_app_runtime(app)
+    assert rt._async_workers == 2
+    assert rt.batch_capacity == 4
+    assert rt._async_buffer == 16
+    rows = []
+    rt.add_callback("Out", lambda evs: rows.extend(e.data[0] for e in evs))
+    rt.start()
+    h = rt.input_handler("S")
+    for i in range(40):
+        h.send((i,))
+    rt.flush()
+    m.shutdown()
+    assert sorted(rows) == list(range(40))
+
+
+def test_fluent_api_builds_running_app():
+    from siddhi_tpu.api import Query, SiddhiAppBuilder, col
+
+    app = (SiddhiAppBuilder("fluent-demo")
+           .stream("S", symbol=str, price=float, volume=int)
+           .query(Query("q1").from_stream("S")
+                  .where(col("price") > 100)
+                  .select(symbol=col("symbol"),
+                          doubled=col("price") * 2)
+                  .insert_into("Out"))
+           .build())
+    m = SiddhiManager()
+    rt = m.create_app_runtime(app)
+    rows = []
+    rt.add_callback("Out", lambda evs: rows.extend(tuple(e.data) for e in evs))
+    rt.start()
+    h = rt.input_handler("S")
+    h.send(("A", 150.0, 1))
+    h.send(("B", 50.0, 1))
+    rt.flush()
+    m.shutdown()
+    assert rows == [("A", 300.0)]
+    assert app.name == "fluent-demo"
+
+
+def test_fluent_api_window_aggregation():
+    from siddhi_tpu.api import Query, SiddhiAppBuilder, col
+
+    app = (SiddhiAppBuilder("fluent-agg")
+           .stream("S", sym=str, p=float)
+           .query(Query("q").from_stream("S")
+                  .window("length", 3)
+                  .select(sym=col("sym"), total=col("p").sum())
+                  .group_by("sym")
+                  .insert_into("Out"))
+           .build())
+    m = SiddhiManager()
+    rt = m.create_app_runtime(app)
+    rows = []
+    rt.add_callback("Out", lambda evs: rows.extend(tuple(e.data) for e in evs))
+    rt.start()
+    h = rt.input_handler("S")
+    for r in [("A", 1.0), ("A", 2.0), ("B", 5.0), ("A", 4.0)]:
+        h.send(r)
+    rt.flush()
+    m.shutdown()
+    assert rows[-1] == ("A", 6.0)       # window holds A:2, B:5, A:4

@@ -298,3 +298,59 @@ def test_derived_null_selector_falls_back(mgr):
     dev, _ = run_app(mgr, "@app:devicePatterns('auto')\n" + body, sends)
     host, _ = run_app(mgr, SEQ + body, sends)
     assert dev == host
+
+
+# ---------------------------------------------------------------------------
+# round-4 algebra extensions: every-below-head (slot forking), optional
+# states (min-count 0 epsilon cascade), adjacent/multiple counts,
+# sequences with logical states (reference: StateInputStreamParser.java:
+# 77-143 composes these freely; VERDICT r3 missing #1)
+# ---------------------------------------------------------------------------
+
+R4_QUERIES = {
+    "every_below": (
+        "from e1=S[p > 120] -> every e2=S[p > e1.p] within 1 sec "
+        "select e1.p as a, e2.p as b insert into O;"),
+    "every_below_3state": (
+        "from e1=S[p > 124] -> every e2=S[p > e1.p] -> e3=S[p < 95] "
+        "within 1 sec select e1.p as a, e2.p as b, e3.p as c insert into O;"),
+    "every_head_and_below": (
+        "from every e1=S[p > 124] -> every e2=S[p > e1.p] "
+        "within 500 milliseconds select e1.p as a, e2.p as b insert into O;"),
+    "min0_mid": (
+        "from every e1=S[p > 120] -> e2=S[p > 125]<0:2> -> e3=S[p < 95] "
+        "within 1 sec select e1.p as a, e3.p as c insert into O;"),
+    "min0_final": (
+        "from every e1=S[p > 124] -> e2=S[p > e1.p]<0:3> within 1 sec "
+        "select e1.p as a, e2[last].p as b insert into O;"),
+    "adjacent_counts": (
+        "from every e1=S[p > 122]<1:2> -> e2=S[p < 96]<1:2> -> "
+        "e3=S[p > 128] within 1 sec select e1[0].p as a, e2[0].p as b, "
+        "e3.p as c insert into O;"),
+    "two_counts_separated": (
+        "from every e1=S[p > 124]<1:2> -> e2=S[p < 100] -> "
+        "e3=S[p > 126]<1:2> within 1 sec select e1[0].p as a, e2.p as b, "
+        "e3[0].p as c insert into O;"),
+    "sequence_logical_or": (
+        "from every e1=S[p > 118], e2=S[p < 100] or e3=S[p > 127] "
+        "within 1 sec select e1.p as a, e2.p as b, e3.p as c insert into O;"),
+    "sequence_logical_and": (
+        "from every e1=S[p > 126], e2=S[p > 90] and e3=S[p > 95] "
+        "within 1 sec select e1.p as a insert into O;"),
+}
+
+
+@pytest.mark.parametrize("name", list(R4_QUERIES))
+def test_differential_r4_algebra(mgr, name):
+    body = ("define stream S (p double);\n@info(name='q') "
+            + R4_QUERIES[name])
+    rng = np.random.default_rng(hash(name) % 2**31)
+    for trial in range(2):
+        n = 220
+        ps = np.round(rng.uniform(88, 132, size=n) * 4) / 4
+        ts = 1_000_000 + np.cumsum(rng.integers(1, 25, size=n))
+        sends = [("S", (float(p),), int(t)) for p, t in zip(ps, ts)]
+        dev, host = both(mgr, body, sends)
+        assert dev == host, (name, trial, len(dev), len(host),
+                             sorted(set(dev) - set(host))[:3],
+                             sorted(set(host) - set(dev))[:3])
