@@ -266,6 +266,77 @@ def _mark(label, t0):
           file=sys.stderr, flush=True)
 
 
+# ---------------------------------------------------------------------------
+# native single-core calibration (no JVM in the image: an -O2 C++ run of
+# the same matcher algorithms upper-bounds single-JVM single-thread
+# throughput on this hardware — see native/bench_native.cpp)
+# ---------------------------------------------------------------------------
+
+def native_baseline():
+    """Build + run the native harness on tapes matching each config's
+    (n + warm, batch, keys) so the event streams are the ones the
+    engines consumed; returns {config: {"eps": .., "matches": ..}} or
+    {} when unavailable."""
+    import os
+    import shutil
+    import subprocess
+    import tempfile
+    root = os.path.dirname(os.path.abspath(__file__))
+    src = os.path.join(root, "native", "bench_native.cpp")
+    exe = os.path.join(root, "native", "bench_native")
+    runnable = os.path.exists(exe) and os.access(exe, os.X_OK)
+    stale = (runnable and os.path.exists(src)
+             and os.path.getmtime(exe) < os.path.getmtime(src))
+    if (not runnable or stale) and os.path.exists(src) \
+            and shutil.which("g++") is not None:
+        r = subprocess.run(["g++", "-O2", "-std=c++17", "-o", exe, src],
+                           capture_output=True)
+        runnable = r.returncode == 0
+    if not runnable:
+        return {}
+
+    def tape_bin(n, batch, keys, path):
+        tape = make_tape(n, batch, keys=keys, dt_ms=1)
+        rec = np.dtype([("ts", "<i8"), ("price", "<f4"), ("key", "<i4")])
+        rows = np.empty(sum(t["n"] for t in tape), dtype=rec)
+        o = 0
+        for t in tape:
+            sl = slice(o, o + t["n"])
+            rows["ts"][sl] = t["ts"]
+            rows["price"][sl] = t["price"]
+            rows["key"][sl] = t["sym_idx"]
+            o += t["n"]
+        rows.tofile(path)
+
+    def run_exe(args):
+        try:
+            r = subprocess.run([exe, *args], capture_output=True,
+                               text=True, timeout=120)
+            return r.stdout if r.returncode == 0 else ""
+        except (OSError, subprocess.SubprocessError):
+            return ""
+
+    out = {}
+    with tempfile.TemporaryDirectory() as td:
+        # config 1's tape (n + 1 warm batch)
+        p1 = os.path.join(td, "t1.bin")
+        tape_bin((1 << 19) + (1 << 18), 1 << 18, 8, p1)
+        text = run_exe([p1, "filter"])
+        # configs 2+3 share (n, batch) = (1<<18, 1<<17)
+        p2 = os.path.join(td, "t2.bin")
+        tape_bin((1 << 18) + (1 << 17), 1 << 17, 8, p2)
+        text += run_exe([p2, "window", "sequence"])
+        p3 = os.path.join(td, "t3.bin")
+        tape_bin((2 << 18) + (1 << 18), 1 << 18, 1000, p3)
+        text += run_exe([p3, "partitioned:1000"])
+    for line in text.splitlines():
+        parts = line.split()
+        if len(parts) >= 3:
+            out[parts[0]] = {"eps": int(float(parts[1])),
+                             "matches": int(parts[2])}
+    return out
+
+
 def main():
     t0 = time.perf_counter()
     configs = {}
@@ -311,6 +382,20 @@ def main():
 
     _mark("configs 4+5 done", t0)
 
+    # non-Python calibration column (VERDICT r3 #9): no JVM exists in
+    # this image, so an -O2 C++ run of the same matcher algorithms on
+    # the same tape distribution stands in as a conservative UPPER bound
+    # for single-JVM single-thread throughput on this hardware
+    nat = native_baseline()
+    nat_of = {"1_filter": "filter", "2_window_agg": "window",
+              "3_sequence": "sequence", "4_partitioned_1k": "partitioned"}
+    for cfg, key in nat_of.items():
+        if key in nat:
+            configs[cfg]["native_cpp_eps"] = nat[key]["eps"]
+            configs[cfg]["vs_native_cpp"] = round(
+                configs[cfg]["device_eps"] / nat[key]["eps"], 2)
+    _mark("native baseline done", t0)
+
     h = configs["4_partitioned_1k"]
     print(json.dumps({
         "metric": "partitioned_pattern_throughput_1k_keys",
@@ -319,6 +404,22 @@ def main():
         "vs_baseline": h["speedup"],
         "vs_production_claim": round(h["device_eps"] / PROD_CLAIM_EPS, 2),
         "p99_detect_ms": h.get("p99_detect_ms"),
+        "calibration": {
+            "host_eps": "single-threaded python interpreter (measured, "
+                        "same tapes) — the matched-conditions baseline",
+            "vs_production_claim": "device headline over the reference "
+                                   "README's ~300k eps production anchor "
+                                   "(engine-level comparison)",
+            "native_cpp_eps": "-O2 C++ of the same matcher algorithm, no "
+                              "engine around it (no event model, dispatch, "
+                              "or output materialization) — an upper bound "
+                              "for any single-thread CPU engine incl. a "
+                              "JVM; the reference engine's own production "
+                              "anchor sits ~1000x below this roofline",
+            "transport": "device numbers ride a tunneled TPU (~100 ms "
+                         "fixed pull latency, ~10-25 MB/s): transfers, "
+                         "not compute, bound most configs here",
+        },
         "configs": configs,
     }))
 

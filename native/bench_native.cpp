@@ -1,0 +1,164 @@
+// Native single-core baseline harness for the BASELINE.json configs.
+//
+// The environment has no JVM, so the reference engine cannot be run
+// directly (BASELINE.md); this C++ harness is the calibration anchor
+// instead: it executes the SAME matcher algorithms as the sequential
+// host interpreter — branchy filter loop, pending-instance CEP
+// matcher (reference StreamPreStateProcessor pending lists), per-key
+// partitioned matchers — at optimized native single-core speed.  A
+// single-threaded JVM engine on this hardware is bounded above by
+// these numbers (JITted Java runs at or below -O2 C++ on this kind of
+// pointer-light numeric code), so `device_eps / native_cpp_eps` is a
+// conservative stand-in for "vs single-JVM CPU".
+//
+// Input: a binary tape [n x {int64 ts_ms, float price, int32 key}]
+// written by bench.py (same random tape the python engines consume).
+// Output: one line per config: "<name> <events_per_sec> <matches>".
+//
+// Build: g++ -O2 -std=c++17 -o bench_native bench_native.cpp
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+struct Ev { int64_t ts; float price; int32_t key; };
+
+static std::vector<Ev> load(const char* path) {
+    FILE* f = fopen(path, "rb");
+    if (!f) { perror("tape"); exit(1); }
+    fseek(f, 0, SEEK_END);
+    long bytes = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    size_t n = bytes / sizeof(Ev);
+    std::vector<Ev> evs(n);
+    if (fread(evs.data(), sizeof(Ev), n, f) != n) { perror("read"); exit(1); }
+    fclose(f);
+    return evs;
+}
+
+using clk = std::chrono::steady_clock;
+
+static double secs(clk::time_point a, clk::time_point b) {
+    return std::chrono::duration<double>(b - a).count();
+}
+
+// config 1: stateless filter `price > 100`, payload passthrough
+static void run_filter(const std::vector<Ev>& evs) {
+    auto t0 = clk::now();
+    int64_t matches = 0;
+    double sink = 0.0;                    // defeat dead-code elimination
+    for (const Ev& e : evs) {
+        if (e.price > 100.0f) { matches++; sink += e.price; }
+    }
+    auto t1 = clk::now();
+    printf("filter %.0f %lld %.1f\n", evs.size() / secs(t0, t1),
+           (long long)matches, sink);
+}
+
+// config 2: sliding length(1000) avg(price) per event
+static void run_window(const std::vector<Ev>& evs) {
+    auto t0 = clk::now();
+    const size_t L = 1000;
+    std::vector<float> ring(L, 0.0f);
+    double sum = 0.0, sink = 0.0;
+    size_t filled = 0, pos = 0;
+    for (const Ev& e : evs) {
+        if (filled == L) sum -= ring[pos];
+        ring[pos] = e.price;
+        sum += e.price;
+        pos = (pos + 1) % L;
+        if (filled < L) filled++;
+        sink += sum / (double)filled;     // the per-event avg output
+    }
+    auto t1 = clk::now();
+    printf("window %.0f %lld %.1f\n", evs.size() / secs(t0, t1),
+           (long long)evs.size(), sink);
+}
+
+// pending-instance sequence matcher: every e1[p>100] -> e2[p>e1.p]
+// within 1 sec (the host oracle's algorithm, native speed)
+static void run_sequence(const std::vector<Ev>& evs) {
+    auto t0 = clk::now();
+    struct Pend { int64_t ts; float p; };
+    std::vector<Pend> pend;
+    pend.reserve(4096);
+    int64_t matches = 0;
+    double sink = 0.0;
+    for (const Ev& e : evs) {
+        size_t w = 0;
+        for (size_t i = 0; i < pend.size(); i++) {
+            if (e.ts - pend[i].ts > 1000) continue;       // within expiry
+            if (e.price > pend[i].p) {                    // e2 fires
+                matches++;
+                sink += pend[i].p + e.price;
+                continue;                                 // instance done
+            }
+            pend[w++] = pend[i];
+        }
+        pend.resize(w);
+        if (e.price > 100.0f) pend.push_back({e.ts, e.price});  // every e1
+    }
+    auto t1 = clk::now();
+    printf("sequence %.0f %lld %.1f\n", evs.size() / secs(t0, t1),
+           (long long)matches, sink);
+}
+
+// partitioned 3-state chain per key: every e1[p>100] -> e2[p>e1.p]
+// -> e3[p>e2.p] within 10 sec, partition by key
+static void run_partitioned(const std::vector<Ev>& evs, int n_keys) {
+    auto t0 = clk::now();
+    struct Pend { int64_t ts; float p1, p2; uint8_t stage; };
+    std::vector<std::vector<Pend>> pend(n_keys);
+    int64_t matches = 0;
+    double sink = 0.0;
+    for (const Ev& e : evs) {
+        auto& ps = pend[e.key];
+        size_t w = 0;
+        for (size_t i = 0; i < ps.size(); i++) {
+            Pend& pd = ps[i];
+            if (e.ts - pd.ts > 10000) continue;
+            if (pd.stage == 1) {
+                if (e.price > pd.p1) { pd.stage = 2; pd.p2 = e.price; }
+                ps[w++] = pd;
+            } else {
+                if (e.price > pd.p2) {
+                    matches++;
+                    sink += pd.p1 + pd.p2 + e.price;
+                    continue;
+                }
+                ps[w++] = pd;
+            }
+        }
+        ps.resize(w);
+        if (e.price > 100.0f) ps.push_back({e.ts, e.price, 0.0f, 1});
+    }
+    auto t1 = clk::now();
+    printf("partitioned %.0f %lld %.1f\n", evs.size() / secs(t0, t1),
+           (long long)matches, sink);
+}
+
+int main(int argc, char** argv) {
+    if (argc < 3) {
+        fprintf(stderr, "usage: bench_native <tape.bin> <config...>\n");
+        return 2;
+    }
+    auto evs = load(argv[1]);
+    for (int i = 2; i < argc; i++) {
+        std::string c = argv[i];
+        if (c == "filter") run_filter(evs);
+        else if (c == "window") run_window(evs);
+        else if (c == "sequence") run_sequence(evs);
+        else if (c.rfind("partitioned", 0) == 0) {
+            int keys = 1000;
+            auto pos = c.find(':');
+            if (pos != std::string::npos) keys = atoi(c.c_str() + pos + 1);
+            run_partitioned(evs, keys);
+        } else {
+            fprintf(stderr, "unknown config %s\n", c.c_str());
+            return 2;
+        }
+    }
+    return 0;
+}

@@ -488,6 +488,12 @@ class SiddhiAppRuntime:
                 if isinstance(p, TriggerRuntime) and \
                         (self._clock_ms is None or not p.anchored):
                     p.anchor(self._clock_ms if self._clock_ms is not None else ms)
+            # enter virtual time BEFORE firing: a pattern matcher lazily
+            # anchors its absent wait-clocks at now_ms() on first
+            # next_wakeup(), and a wall-clock anchor would put every
+            # `not X for T` deadline ~50 years out on the event timeline
+            if self._clock_ms is None:
+                self._clock_ms = ms
             self._fire_timers(ms)
             self._clock_ms = ms
             self._drain()

@@ -825,7 +825,12 @@ class InterpPatternQueryPlan(QueryPlan):
     def finalize(self) -> list:
         if not self._buffer:
             return []
-        self.matcher.start(self.rt.now_ms())
+        now = self.rt.now_ms()
+        if self.rt._playback and self.rt._clock_ms is None:
+            # playback, virtual clock not yet entered: anchor absent
+            # wait-clocks on the event timeline, not the wall clock
+            now = min(ev.timestamp for _seq, _sid, ev in self._buffer)
+        self.matcher.start(now)
         buf = sorted(self._buffer, key=lambda t: t[0])
         self._buffer = []
         out_rows: list = []

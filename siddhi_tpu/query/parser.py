@@ -554,7 +554,15 @@ class Parser:
                     "and", ast.StreamStateElement(right))
             self.eat_kw("for")
             wait = ast.TimeConstant(self.parse_time_value())
-            return ast.AbsentStreamStateElement(stream, waiting_time=wait)
+            absent = ast.AbsentStreamStateElement(stream, waiting_time=wait)
+            # `not X for T and|or Y` — a timed absent as a logical side
+            # (reference grammar: every_absent_logical_source)
+            for op in ("and", "or"):
+                if self.try_kw(op):
+                    right = self.parse_basic_state_stream()
+                    return ast.LogicalStateElement(
+                        absent, op, ast.StreamStateElement(right))
+            return absent
         stream = self.parse_basic_state_stream()
         # count: e1=S[...]<2:5>
         if self.at_op("<"):
@@ -580,24 +588,21 @@ class Parser:
         if self.at_op("?"):
             self.eat_op("?")
             return ast.CountStateElement(ast.StreamStateElement(stream), 0, 1)
-        if self.try_kw("and"):
-            if self.try_kw("not"):
+        for op in ("and", "or"):
+            if self.try_kw(op):
+                if self.try_kw("not"):
+                    right = self.parse_basic_state_stream()
+                    wait = None
+                    if self.try_kw("for"):      # `Y and|or not X for T`
+                        wait = ast.TimeConstant(self.parse_time_value())
+                    return ast.LogicalStateElement(
+                        ast.StreamStateElement(stream), op,
+                        ast.AbsentStreamStateElement(right,
+                                                     waiting_time=wait))
                 right = self.parse_basic_state_stream()
                 return ast.LogicalStateElement(
-                    ast.StreamStateElement(stream), "and",
-                    ast.AbsentStreamStateElement(right))
-            right = self.parse_basic_state_stream()
-            return ast.LogicalStateElement(ast.StreamStateElement(stream), "and",
-                                           ast.StreamStateElement(right))
-        if self.try_kw("or"):
-            if self.try_kw("not"):
-                right = self.parse_basic_state_stream()
-                return ast.LogicalStateElement(
-                    ast.StreamStateElement(stream), "or",
-                    ast.AbsentStreamStateElement(right))
-            right = self.parse_basic_state_stream()
-            return ast.LogicalStateElement(ast.StreamStateElement(stream), "or",
-                                           ast.StreamStateElement(right))
+                    ast.StreamStateElement(stream), op,
+                    ast.StreamStateElement(right))
         return ast.StreamStateElement(stream)
 
     def _parse_collect(self) -> tuple[Optional[int], Optional[int]]:

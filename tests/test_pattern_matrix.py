@@ -1,0 +1,342 @@
+"""Scenario matrices ported (shapes, not code) from the reference's
+pattern/sequence suites: siddhi-core/src/test/java/.../query/pattern/
+absent/{AbsentPatternTestCase,LogicalAbsentPatternTestCase,
+EveryAbsentPatternTestCase}.java and .../query/sequence/
+SequenceTestCase.java (VERDICT r3 #8).
+
+Every case runs BOTH engines — device ('prefer': device where the
+kernel supports the shape, host fallback otherwise) and the host
+matcher — and asserts identical outputs, plus an explicit expectation
+where the reference scenario pins one (n matches / no match)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+DEVP = "@app:devicePatterns('prefer')\n"
+HOST = "@app:devicePatterns('never')\n"
+
+HEAD4 = """
+@app:playback
+define stream S1 (sym string, price double);
+define stream S2 (sym string, price double);
+define stream S3 (sym string, price double);
+define stream S4 (sym string, price double);
+"""
+
+T0 = 1_000_000
+
+
+def _run(app, sends, set_times=()):
+    m = SiddhiManager()
+    rt = m.create_app_runtime(app)
+    out = []
+    rt.add_callback("O", lambda evs: out.extend(
+        tuple(None if v is None else v for v in e.data) for e in evs))
+    rt.start()
+    rt.set_time(T0 - 1)     # anchor: absent wait-clocks start at app start
+    handlers = {}
+    events = sorted(sends, key=lambda s: s[2])
+    marks = sorted(set_times)
+    mi = 0
+    for sid, row, ts in events:
+        while mi < len(marks) and marks[mi] <= ts:
+            rt.set_time(marks[mi]); mi += 1
+        h = handlers.get(sid) or handlers.setdefault(
+            sid, rt.input_handler(sid))
+        h.send(row, timestamp=ts)
+        rt.flush()
+    for t in marks[mi:]:
+        rt.set_time(t)
+    rt.flush()
+    m.shutdown()
+    return out
+
+
+def both(body, sends, set_times=()):
+    dev = _run(DEVP + HEAD4 + body, sends, set_times)
+    host = _run(HOST + HEAD4 + body, sends, set_times)
+    assert dev == host, (len(dev), len(host), dev[:4], host[:4])
+    return dev
+
+
+# ---------------------------------------------------------------------------
+# AbsentPatternTestCase shapes: A -> not B for 1 sec (and permutations)
+# ---------------------------------------------------------------------------
+
+AB = ("from e1=S1[price>20] -> not S2[price>e1.price] for 1 sec "
+      "select e1.sym as s1 insert into O;")
+NOT_HEAD = ("from not S1[price>20] for 1 sec -> e2=S2[price>30] "
+            "select e2.sym as s2 insert into O;")
+CHAIN_NOT_TAIL = ("from e1=S1[price>10] -> e2=S2[price>20] -> "
+                  "not S3[price>30] for 1 sec "
+                  "select e1.sym as a, e2.sym as b insert into O;")
+NOT_MID = ("from e1=S1[price>10] -> not S2[price>20] for 1 sec -> "
+           "e3=S3[price>30] select e1.sym as a, e3.sym as b insert into O;")
+NOT_HEAD_CHAIN = ("from not S1[price>10] for 1 sec -> e2=S2[price>20] -> "
+                  "e3=S3[price>30] "
+                  "select e2.sym as a, e3.sym as b insert into O;")
+FOUR_NOT_TAIL = ("from e1=S1[price>10] -> e2=S2[price>20] -> "
+                 "e3=S3[price>30] -> not S4[price>40] for 1 sec "
+                 "select e1.sym as a, e3.sym as c insert into O;")
+NOT_MID4 = ("from e1=S1[price>10] -> e2=S2[price>20] -> "
+            "not S3[price>30] for 1 sec -> e4=S4[price>40] "
+            "select e1.sym as a, e4.sym as d insert into O;")
+
+ABSENT_CASES = {
+    # e1 -> not e2: no e2 arrives -> match at deadline
+    "tail_quiet": (AB, [("S1", ("A", 25.0), T0)], [T0 + 1100], 1),
+    # e2 arrives after the deadline: still a match
+    "tail_late_e2": (AB, [("S1", ("A", 25.0), T0),
+                          ("S2", ("B", 30.0), T0 + 1200)], [T0 + 1100], 1),
+    # e2 inside the window kills
+    "tail_e2_inside": (AB, [("S1", ("A", 25.0), T0),
+                            ("S2", ("B", 30.0), T0 + 500)], [T0 + 1100], 0),
+    # e2 inside but filter unsatisfied (price <= e1.price): match
+    "tail_e2_nofilter": (AB, [("S1", ("A", 25.0), T0),
+                              ("S2", ("B", 20.0), T0 + 500)],
+                         [T0 + 1100], 1),
+    # not-head: quiet first second then e2 -> match
+    "head_quiet_then_e2": (NOT_HEAD, [("S2", ("B", 35.0), T0 + 1200)],
+                           [T0 + 1100], 1),
+    # not-head: e1 arrives inside the window -> kill, no match
+    "head_e1_inside": (NOT_HEAD, [("S1", ("A", 25.0), T0 + 300),
+                                  ("S2", ("B", 35.0), T0 + 1200)],
+                       [T0 + 1100], 0),
+    # not-head: e2 arrives BEFORE the wait elapses -> no match for it
+    "head_e2_early": (NOT_HEAD, [("S2", ("B", 35.0), T0 + 300)],
+                      [T0 + 1100], 0),
+    # chain with absent tail: e3 never arrives -> match
+    "chain_tail_quiet": (CHAIN_NOT_TAIL,
+                         [("S1", ("A", 15.0), T0),
+                          ("S2", ("B", 25.0), T0 + 100)], [T0 + 1300], 1),
+    # chain with absent tail: e3 arrives in window -> killed
+    "chain_tail_e3": (CHAIN_NOT_TAIL,
+                      [("S1", ("A", 15.0), T0),
+                       ("S2", ("B", 25.0), T0 + 100),
+                       ("S3", ("C", 35.0), T0 + 600)], [T0 + 1300], 0),
+    # chain with absent tail: e3 fails its filter -> match
+    "chain_tail_e3_nofilter": (CHAIN_NOT_TAIL,
+                               [("S1", ("A", 15.0), T0),
+                                ("S2", ("B", 25.0), T0 + 100),
+                                ("S3", ("C", 29.0), T0 + 600)],
+                               [T0 + 1300], 1),
+    # absent mid-chain: quiet window then e3 -> match
+    "mid_quiet": (NOT_MID, [("S1", ("A", 15.0), T0),
+                            ("S3", ("C", 35.0), T0 + 1200)],
+                  [T0 + 1100], 1),
+    # absent mid-chain: e2 inside window -> killed
+    "mid_e2": (NOT_MID, [("S1", ("A", 15.0), T0),
+                         ("S2", ("B", 25.0), T0 + 400),
+                         ("S3", ("C", 35.0), T0 + 1200)], [T0 + 1100], 0),
+    # absent mid-chain: e2 fails filter -> match survives
+    "mid_e2_nofilter": (NOT_MID, [("S1", ("A", 15.0), T0),
+                                  ("S2", ("B", 19.0), T0 + 400),
+                                  ("S3", ("C", 35.0), T0 + 1200)],
+                        [T0 + 1100], 1),
+    # not-head then 2-chain
+    "head_chain": (NOT_HEAD_CHAIN, [("S2", ("B", 25.0), T0 + 1200),
+                                    ("S3", ("C", 35.0), T0 + 1300)],
+                   [T0 + 1100], 1),
+    "head_chain_killed": (NOT_HEAD_CHAIN,
+                          [("S1", ("A", 15.0), T0 + 200),
+                           ("S2", ("B", 25.0), T0 + 1200),
+                           ("S3", ("C", 35.0), T0 + 1300)], [T0 + 1100], 0),
+    # 4-chain with absent tail
+    "four_tail_quiet": (FOUR_NOT_TAIL,
+                        [("S1", ("A", 15.0), T0),
+                         ("S2", ("B", 25.0), T0 + 100),
+                         ("S3", ("C", 35.0), T0 + 200)], [T0 + 1400], 1),
+    "four_tail_e4": (FOUR_NOT_TAIL,
+                     [("S1", ("A", 15.0), T0),
+                      ("S2", ("B", 25.0), T0 + 100),
+                      ("S3", ("C", 35.0), T0 + 200),
+                      ("S4", ("D", 45.0), T0 + 700)], [T0 + 1400], 0),
+    # absent mid in a 4-chain
+    "mid4_quiet": (NOT_MID4,
+                   [("S1", ("A", 15.0), T0),
+                    ("S2", ("B", 25.0), T0 + 100),
+                    ("S4", ("D", 45.0), T0 + 1300)], [T0 + 1200], 1),
+    "mid4_e3": (NOT_MID4,
+                [("S1", ("A", 15.0), T0),
+                 ("S2", ("B", 25.0), T0 + 100),
+                 ("S3", ("C", 35.0), T0 + 500),
+                 ("S4", ("D", 45.0), T0 + 1300)], [T0 + 1200], 0),
+}
+
+
+@pytest.mark.parametrize("name", list(ABSENT_CASES))
+def test_absent_matrix(name):
+    body, sends, ticks, expected = ABSENT_CASES[name]
+    out = both(body, sends, ticks)
+    assert len(out) == expected, (name, out)
+
+
+# ---------------------------------------------------------------------------
+# LogicalAbsentPatternTestCase shapes: not-X and/or Y combinations
+# ---------------------------------------------------------------------------
+
+NOT_AND = ("from e1=S1[price>10] -> not S2[price>20] and e3=S3[price>30] "
+           "select e1.sym as a, e3.sym as c insert into O;")
+NOT_AND_HEAD = ("from not S1[price>10] and e2=S2[price>20] -> "
+                "e3=S3[price>30] select e2.sym as b, e3.sym as c "
+                "insert into O;")
+NOT_FOR_AND = ("from e1=S1[price>10] -> not S2[price>20] for 1 sec and "
+               "e3=S3[price>30] select e1.sym as a insert into O;")
+NOT_FOR_OR = ("from e1=S1[price>10] -> not S2[price>20] for 1 sec or "
+              "e3=S3[price>30] select e1.sym as a, e3.sym as c "
+              "insert into O;")
+
+LOGICAL_ABSENT_CASES = {
+    # e1 then e3 (no e2): and-with-absent completes on e3
+    "and_quiet": (NOT_AND, [("S1", ("A", 15.0), T0),
+                            ("S3", ("C", 35.0), T0 + 300)], [], 1),
+    # e2 arrives first: pair killed
+    "and_e2": (NOT_AND, [("S1", ("A", 15.0), T0),
+                         ("S2", ("B", 25.0), T0 + 100),
+                         ("S3", ("C", 35.0), T0 + 300)], [], 0),
+    # not-head and: e2 then e3 (no e1)
+    "and_head_quiet": (NOT_AND_HEAD, [("S2", ("B", 25.0), T0),
+                                      ("S3", ("C", 35.0), T0 + 300)],
+                       [], 1),
+    "and_head_e1": (NOT_AND_HEAD, [("S1", ("A", 15.0), T0 - 10),
+                                   ("S2", ("B", 25.0), T0),
+                                   ("S3", ("C", 35.0), T0 + 300)], [], 0),
+    # not..for AND e3: e3 within window + quiet e2 -> match at deadline
+    "for_and_quiet": (NOT_FOR_AND, [("S1", ("A", 15.0), T0),
+                                    ("S3", ("C", 35.0), T0 + 400)],
+                      [T0 + 1100], 1),
+    # e2 inside window kills even though e3 matched
+    "for_and_e2": (NOT_FOR_AND, [("S1", ("A", 15.0), T0),
+                                 ("S2", ("B", 25.0), T0 + 200),
+                                 ("S3", ("C", 35.0), T0 + 400)],
+                   [T0 + 1100], 0),
+    # not..for OR e3: e3 arrives -> immediate match (or-side)
+    "for_or_e3": (NOT_FOR_OR, [("S1", ("A", 15.0), T0),
+                               ("S3", ("C", 35.0), T0 + 400)],
+                  [T0 + 1100], 1),
+    # only the quiet second passes -> absent side fires (e3 NULL)
+    "for_or_quiet": (NOT_FOR_OR, [("S1", ("A", 15.0), T0)],
+                     [T0 + 1100], 1),
+    # e2 arrives: absent side disarmed; no e3 -> nothing
+    "for_or_e2_only": (NOT_FOR_OR, [("S1", ("A", 15.0), T0),
+                                    ("S2", ("B", 25.0), T0 + 200)],
+                       [T0 + 1100], 0),
+    # e2 arrives but e3 later still completes the or
+    "for_or_e2_then_e3": (NOT_FOR_OR, [("S1", ("A", 15.0), T0),
+                                       ("S2", ("B", 25.0), T0 + 200),
+                                       ("S3", ("C", 35.0), T0 + 500)],
+                          [T0 + 1100], 1),
+}
+
+
+@pytest.mark.parametrize("name", list(LOGICAL_ABSENT_CASES))
+def test_logical_absent_matrix(name):
+    body, sends, ticks, expected = LOGICAL_ABSENT_CASES[name]
+    out = both(body, sends, ticks)
+    assert len(out) == expected, (name, out)
+
+
+def test_for_or_quiet_emits_null_e3():
+    out = both(NOT_FOR_OR, [("S1", ("A", 15.0), T0)], [T0 + 1100])
+    assert out == [("A", None)]
+
+
+# ---------------------------------------------------------------------------
+# EveryAbsentPatternTestCase shapes: every + not combinations
+# ---------------------------------------------------------------------------
+
+EVERY_TAIL = ("from every e1=S1[price>20] -> not S2[price>e1.price] "
+              "for 1 sec select e1.sym as a insert into O;")
+EVERY_NOT_HEAD = ("from every not S1[price>10] for 1 sec -> "
+                  "e2=S2[price>20] select e2.sym as b insert into O;")
+
+EVERY_ABSENT_CASES = {
+    # two e1 arms, both quiet -> two matches
+    "every_two_arms": (EVERY_TAIL, [("S1", ("A", 25.0), T0),
+                                    ("S1", ("B", 26.0), T0 + 200)],
+                       [T0 + 1400], 2),
+    # second arm killed by matching e2
+    "every_one_killed": (EVERY_TAIL, [("S1", ("A", 25.0), T0),
+                                      ("S1", ("B", 26.0), T0 + 200),
+                                      ("S2", ("X", 26.5), T0 + 400)],
+                         [T0 + 1400], 0),
+    # e2 kills only arms whose filter it satisfies
+    "every_filter_selective": (EVERY_TAIL,
+                               [("S1", ("A", 30.0), T0),
+                                ("S1", ("B", 26.0), T0 + 200),
+                                ("S2", ("X", 27.0), T0 + 400)],
+                               [T0 + 1400], 1),
+    # every not-head: re-arms after each fire (2 quiet seconds, e2 then)
+    "every_not_head": (EVERY_NOT_HEAD, [("S2", ("B", 25.0), T0 + 1200)],
+                       [T0 + 1100], 1),
+}
+
+
+@pytest.mark.parametrize("name", list(EVERY_ABSENT_CASES))
+def test_every_absent_matrix(name):
+    body, sends, ticks, expected = EVERY_ABSENT_CASES[name]
+    out = both(body, sends, ticks)
+    assert len(out) == expected, (name, out)
+
+
+# ---------------------------------------------------------------------------
+# SequenceTestCase shapes (strict contiguity over the query's streams)
+# ---------------------------------------------------------------------------
+
+SEQ2 = ("from every e1=S1[price>20], e2=S1[price>e1.price] "
+        "select e1.price as a, e2.price as b insert into O;")
+SEQ3 = ("from every e1=S1[price>20], e2=S1[price>e1.price], "
+        "e3=S1[price>e2.price] select e1.price as a, e3.price as c "
+        "insert into O;")
+SEQ_COUNT = ("from every e1=S1[price>20], e2=S1[price>20]<1:2>, "
+             "e3=S1[price<10] select e1.price as a, e2[0].price as b, "
+             "e3.price as c insert into O;")
+SEQ_OR = ("from every e1=S1[price>20], e2=S1[price<5] or "
+          "e3=S1[price>e1.price] select e1.price as a, e2.price as b, "
+          "e3.price as c insert into O;")
+
+SEQUENCE_CASES = {
+    # contiguous pair matches
+    "pair": (SEQ2, [("S1", ("A", 25.0), T0), ("S1", ("A", 26.0), T0 + 1)],
+             1),
+    # an intervening non-advancing event breaks strictness
+    "pair_broken": (SEQ2, [("S1", ("A", 25.0), T0),
+                           ("S1", ("A", 10.0), T0 + 1),
+                           ("S1", ("A", 26.0), T0 + 2)], 0),
+    # 3-chain contiguous
+    "triple": (SEQ3, [("S1", ("A", 25.0), T0), ("S1", ("A", 26.0), T0 + 1),
+                      ("S1", ("A", 27.0), T0 + 2)], 1),
+    "triple_broken_late": (SEQ3, [("S1", ("A", 25.0), T0),
+                                  ("S1", ("A", 26.0), T0 + 1),
+                                  ("S1", ("A", 9.0), T0 + 2),
+                                  ("S1", ("A", 27.0), T0 + 3)], 0),
+    # count inside a sequence: one or two mids then the closer
+    "count_one_mid": (SEQ_COUNT, [("S1", ("A", 25.0), T0),
+                                  ("S1", ("A", 26.0), T0 + 1),
+                                  ("S1", ("A", 5.0), T0 + 2)], 1),
+    # `every` restarts at 26 too: (25,[26,27],5) and (26,[27],5)
+    "count_two_mid": (SEQ_COUNT, [("S1", ("A", 25.0), T0),
+                                  ("S1", ("A", 26.0), T0 + 1),
+                                  ("S1", ("A", 27.0), T0 + 2),
+                                  ("S1", ("A", 5.0), T0 + 3)], 2),
+    # or-side in a sequence
+    "or_right": (SEQ_OR, [("S1", ("A", 25.0), T0),
+                          ("S1", ("A", 26.0), T0 + 1)], 1),
+    "or_left": (SEQ_OR, [("S1", ("A", 25.0), T0),
+                         ("S1", ("A", 2.0), T0 + 1)], 1),
+}
+
+
+@pytest.mark.parametrize("name", list(SEQUENCE_CASES))
+def test_sequence_matrix(name):
+    body, sends, expected = SEQUENCE_CASES[name]
+    out = both(body, sends)
+    assert len(out) == expected, (name, out)
+
+
+def test_sequence_every_restarts():
+    # every sequence: overlapping contiguous pairs each match
+    sends = [("S1", ("A", 25.0), T0), ("S1", ("A", 26.0), T0 + 1),
+             ("S1", ("A", 27.0), T0 + 2)]
+    out = both(SEQ2, sends)
+    assert out == [(25.0, 26.0), (26.0, 27.0)]
