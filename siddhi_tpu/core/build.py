@@ -21,6 +21,13 @@ def build_app(rt) -> None:
     # SiddhiAppParser defines scripts before queries, Script.java:27).
     # Unsupported languages fail HERE, loudly — not at first use.
     rt.udfs = {}
+    mgr = getattr(rt, "manager", None)
+    if (rt.app.function_definitions
+            and mgr is not None and not getattr(mgr, "allow_scripts", True)):
+        raise PlanError(
+            "script functions are disabled on this SiddhiManager "
+            "(allow_scripts=False): app text is untrusted input here and "
+            "[python] script bodies execute with full interpreter privileges")
     for fid, fd in rt.app.function_definitions.items():
         try:
             rt.udfs[fid.lower()] = (compile_script_function(fd),

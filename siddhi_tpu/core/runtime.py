@@ -961,9 +961,17 @@ class SiddhiManager:
     `isolated_broker=True` scopes inMemory source/sink topics to this
     manager (its `.broker`); the default matches the reference's
     process-global InMemoryBroker (same-named topics cross-deliver
-    between managers — use isolation when embedding several apps)."""
+    between managers — use isolation when embedding several apps).
 
-    def __init__(self, isolated_broker: bool = False):
+    `allow_scripts=False` rejects apps containing `define function f[python]`
+    at build time.  Script UDFs execute with full interpreter privileges
+    (same trust model as the reference's Script.java engines running inside
+    the JVM): app text is TRUSTED input.  Disable scripts when deploying
+    apps from untrusted authors (e.g. via the REST service)."""
+
+    def __init__(self, isolated_broker: bool = False,
+                 allow_scripts: bool = True):
+        self.allow_scripts = allow_scripts
         self.persistence_store = None
         self.config_manager = None      # ConfigManager SPI (core/config.py)
         self._runtimes: dict = {}

@@ -15,6 +15,7 @@ follows Siddhi semantics: comparisons/arithmetic with null yield None
 """
 from __future__ import annotations
 
+import contextvars
 import math
 import time
 import uuid
@@ -259,8 +260,9 @@ def _compile_fn(expr: ast.FunctionCall, ctx) -> tuple[PyFn, AttrType]:
             f, ft = compile_py(expr.args[0], ctx)
             d, _ = compile_py(expr.args[1], ctx)
             return (lambda env: f(env) if f(env) is not None else d(env)), ft
-    if ns is None and name in _ACTIVE_UDFS:
-        fn, rtype = _ACTIVE_UDFS[name]
+    udfs = _ACTIVE_UDFS.get() if ns is None else None
+    if udfs and name in udfs:
+        fn, rtype = udfs[name]
         args = [compile_py(a, ctx) for a in expr.args]
         caster = {AttrType.STRING: _to_str, AttrType.INT: _to_int,
                   AttrType.LONG: _to_int, AttrType.FLOAT: _to_float,
@@ -286,26 +288,26 @@ def _compile_fn(expr: ast.FunctionCall, ctx) -> tuple[PyFn, AttrType]:
 # bare expression or statements with `return`); other languages raise at
 # build time — a silently dropped definition was VERDICT r3 weak spot #5.
 
-_ACTIVE_UDFS: dict = {}     # name -> (fn, AttrType); build-scoped
+_ACTIVE_UDFS: "contextvars.ContextVar[dict]" = contextvars.ContextVar(
+    "siddhi_active_udfs", default={})   # name -> (fn, AttrType); build-scoped
 
 
 class udf_scope:
     """Installs a runtime's script functions for the duration of plan /
     store-query compilation (closures capture the fns, so the scope only
-    needs to span compile time)."""
+    needs to span compile time).  ContextVar-backed so lazy partition-clone
+    compiles on async ingest workers can't clobber a concurrent build in
+    another thread (advisor r4)."""
 
     def __init__(self, udfs: Optional[dict]):
         self.udfs = udfs or {}
 
     def __enter__(self):
-        global _ACTIVE_UDFS
-        self._saved = _ACTIVE_UDFS
-        _ACTIVE_UDFS = self.udfs
+        self._token = _ACTIVE_UDFS.set(self.udfs)
         return self
 
     def __exit__(self, *exc):
-        global _ACTIVE_UDFS
-        _ACTIVE_UDFS = self._saved
+        _ACTIVE_UDFS.reset(self._token)
         return False
 
 

@@ -114,3 +114,21 @@ def test_udf_in_partition_clone():
            "insert into Out;\nend;\n")
     out = _run(app, [("A", 1.0, 1), ("B", 2.0, 1)])
     assert sorted(out) == [("A", 2.0), ("B", 3.0)]
+
+
+def test_scripts_disabled_manager_rejects_app():
+    """allow_scripts=False rejects [python] UDF apps at build time (advisor
+    r4: script bodies execute with full interpreter privileges — the flag
+    is the opt-out for untrusted app text)."""
+    import pytest
+    from siddhi_tpu.core.build import PlanError
+    m = SiddhiManager(allow_scripts=False)
+    app = (HEAD +
+           "define function dbl[python] return double { data[0] * 2 };\n"
+           "from S select dbl(price) as d insert into Out;\n")
+    with pytest.raises(PlanError, match="allow_scripts"):
+        m.create_app_runtime(app)
+    # script-free apps still build fine on the same manager
+    rt = m.create_app_runtime(HEAD + "from S select price insert into Out;\n")
+    assert rt is not None
+    m.shutdown()
