@@ -53,6 +53,16 @@ class InputHandler:
         """Accepts one row tuple, a list of row tuples, or an Event."""
         self._rt.send(self.stream_id, data, timestamp)
 
+    def send_batch(self, columns: dict, timestamps=None) -> None:
+        """Columnar ingest: one micro-batch straight from numpy arrays —
+        the struct-of-arrays analog of `send(list_of_rows)` without the
+        per-row Python loop.  `columns` maps attribute name -> (n,) array
+        (string attributes: array/list of str, or pre-encoded int32 dict
+        codes); `timestamps` is an (n,) int64 ms array (default: now).
+        Dispatches through the same junction path as `send` — batches are
+        NOT split or coalesced, so one call = one device micro-batch."""
+        self._rt.send_columnar(self.stream_id, columns, timestamps)
+
 
 def _parse_interval_s(text: str) -> float:
     """'5 sec' / '500 ms' / bare seconds -> float seconds (unit table
@@ -522,6 +532,79 @@ class SiddhiAppRuntime:
     def send(self, stream_id: str, data, timestamp: Optional[int] = None) -> None:
         with self._lock:
             self._send_locked(stream_id, data, timestamp)
+        self._drain_async_outbox()
+        self._flush_sink_outbox()
+
+    def send_columnar(self, stream_id: str, columns: dict,
+                      timestamps=None) -> None:
+        """Columnar micro-batch ingest (see InputHandler.send_batch).
+        The whole array set becomes ONE EventBatch dispatched through the
+        same junction path as row-wise send; rows previously buffered via
+        `send` flush first so arrival order is preserved."""
+        from .schema import dtype_of as _dtype_of
+        schema = self.schemas.get(stream_id)
+        if schema is None:
+            raise PlanError(f"unknown stream {stream_id!r}")
+        attrs = schema.attributes
+        missing = [a.name for a in attrs if a.name not in columns]
+        if missing:
+            raise ValueError(
+                f"stream {stream_id!r}: send_batch missing columns {missing}")
+        cols: dict = {}
+        to_encode: list = []
+        n = None
+        for a in attrs:
+            v = columns[a.name]
+            if a.type == qast.AttrType.STRING:
+                arr = np.asarray(v)
+                if arr.dtype.kind in "iu":          # pre-encoded dict codes
+                    arr = arr.astype(np.int32, copy=False)
+                else:                               # str values: encode
+                    to_encode.append(a.name)        # ...under the lock (the
+                    arr = arr.tolist()              # StringTable is shared)
+            else:
+                arr = np.asarray(v, dtype=_dtype_of(a.type))
+            rows_in = len(arr) if isinstance(arr, list) else arr.shape[0]
+            if n is None:
+                n = rows_in
+            elif rows_in != n:
+                raise ValueError(
+                    f"stream {stream_id!r}: column {a.name!r} has "
+                    f"{rows_in} rows, expected {n}")
+            cols[a.name] = arr
+        if not n:
+            return
+        if timestamps is None:
+            ts = None
+        else:
+            ts = np.atleast_1d(np.asarray(timestamps, dtype=np.int64))
+            if ts.shape[0] == 1 and n > 1:
+                ts = np.full(n, int(ts[0]), dtype=np.int64)
+            if ts.shape[0] != n:
+                raise ValueError(
+                    f"stream {stream_id!r}: {ts.shape[0]} timestamps for "
+                    f"{n} rows")
+        with self._lock:
+            for name in to_encode:      # shared-table writes: locked
+                cols[name] = self.strings.encode_many(cols[name])
+            if ts is None:
+                ts = np.full(n, self.now_ms(), dtype=np.int64)
+            b = self._builders.get(stream_id)
+            if b is not None and len(b):    # order vs earlier row sends
+                self._pending.append((stream_id, b.freeze_and_clear()))
+            seqs = np.arange(self._seq + 1, self._seq + 1 + n,
+                              dtype=np.int64)
+            self._seq += n
+            if self._playback and timestamps is not None:
+                # advance the event-time clock (row-path advance());
+                # wall-stamped batches must NOT anchor playback time
+                self._clock_ms = int(ts[-1])
+            batch = EventBatch(schema, ts, cols, n, seqs)
+            if self._async and self._ingest_q is not None:
+                self._async_outbox.append((stream_id, batch))
+            else:
+                self._pending.append((stream_id, batch))
+                self._drain()
         self._drain_async_outbox()
         self._flush_sink_outbox()
 
