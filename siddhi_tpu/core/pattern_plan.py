@@ -180,7 +180,7 @@ class DevicePatternPlan(QueryPlan):
         # device state never persists, so there is nothing to rebase.
         self._chunk_cfg = None
         if (not broadcast_events and part_key_fns is None
-                and self.P == 1 and self.mesh is None
+                and partitions == 1
                 and getattr(rt, "_async_workers", 1) == 1
                 and self.spec.every_head and not self.kernel.has_absent
                 and all(p.within_ms is not None for p in self.spec.positions)):
@@ -607,6 +607,13 @@ class DevicePatternPlan(QueryPlan):
             K = pow2_at_least(max(1, N // max(H, 1)), lo=1)
             K = min(K, int(cfg["lanes"]))
             CS, H = _halo(K)
+        if self.mesh is not None:
+            # lane axis shards over the mesh: K must divide evenly over
+            # the device count (K = min(lanes, N) can be arbitrary)
+            nd = self.mesh.devices.size
+            if K % nd:
+                K = -(-K // nd) * nd
+                CS, H = _halo(K)
         T = pow2_at_least(CS + H)
 
         # fresh i32 bases every flush (no persistent device state)
@@ -673,7 +680,18 @@ class DevicePatternPlan(QueryPlan):
     def _dispatch_chunk(self, ev, K, T, M, ts_base, seq_base) -> dict:
         kern = self._chunk_kernel(K)
         fn = kern.block_fn(T, M)
-        _st, out = fn(kern.init_state(), ev)
+        st0 = kern.init_state()
+        if self.mesh is not None:
+            # lane-axis sharding: state (.., K) shards over the mesh, the
+            # flat event buffers replicate (each device gathers its own
+            # lanes' chunk+halo windows on device)
+            st0 = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, self._part_sharding(np.ndim(a))
+                                         if np.ndim(a) and np.shape(a)[-1] == K
+                                         else self._part_sharding(0)), st0)
+            ev = {k: jax.device_put(v, self._part_sharding(0))
+                  for k, v in ev.items()}
+        _st, out = fn(st0, ev)
         for key in ("i", "f"):
             if key in out:
                 try:    # start the D2H pull while the device computes

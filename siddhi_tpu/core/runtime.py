@@ -560,11 +560,22 @@ class SiddhiAppRuntime:
                 if arr.dtype.kind in "iu":          # pre-encoded dict codes
                     arr = arr.astype(np.int32, copy=False)
                 else:                               # str values: encode
+                    if arr.ndim != 1:
+                        raise ValueError(
+                            f"stream {stream_id!r}: column {a.name!r} must "
+                            f"be a 1-d array/list of str, got {v!r}")
                     to_encode.append(a.name)        # ...under the lock (the
                     arr = arr.tolist()              # StringTable is shared)
             else:
                 arr = np.asarray(v, dtype=_dtype_of(a.type))
-            rows_in = len(arr) if isinstance(arr, list) else arr.shape[0]
+            if isinstance(arr, list):
+                rows_in = len(arr)
+            elif arr.ndim != 1:
+                raise ValueError(
+                    f"stream {stream_id!r}: column {a.name!r} must be a "
+                    f"1-d array/list of values, got shape {arr.shape}")
+            else:
+                rows_in = arr.shape[0]
             if n is None:
                 n = rows_in
             elif rows_in != n:
@@ -591,7 +602,13 @@ class SiddhiAppRuntime:
                 ts = np.full(n, self.now_ms(), dtype=np.int64)
             b = self._builders.get(stream_id)
             if b is not None and len(b):    # order vs earlier row sends
-                self._pending.append((stream_id, b.freeze_and_clear()))
+                leftover = b.freeze_and_clear()
+                if self._async and self._ingest_q is not None:
+                    # async mode: older batches may still sit in the ingest
+                    # queue — stage through the same outbox so FIFO holds
+                    self._async_outbox.append((stream_id, leftover))
+                else:
+                    self._pending.append((stream_id, leftover))
             seqs = np.arange(self._seq + 1, self._seq + 1 + n,
                               dtype=np.int64)
             self._seq += n

@@ -32,3 +32,13 @@ def test_incremental_agg_sharded_matches_host():
 @need8
 def test_multi_query_lanes_sharded_match_host():
     ge._dryrun_multi_query(8)
+
+
+@need8
+def test_chunked_halo_lanes_sharded_match_host():
+    ge._dryrun_chunked_halo(8)
+
+
+@need8
+def test_multihost_2d_mesh_matches_1d():
+    ge.dryrun_multihost(2, 8)

@@ -127,3 +127,31 @@ def test_send_batch_unstamped_does_not_anchor_playback_clock():
     rt.flush()
     assert rt._clock_ms is None
     m.shutdown()
+
+
+def test_send_batch_async_fifo_with_queued_batches():
+    """Async mode: buffered builder rows staged via send_batch must not
+    jump ahead of older batches still in the ingest queue (review r5)."""
+    m = SiddhiManager()
+    rt = m.create_app_runtime(
+        "@app:async(batch.size.max='4')\ndefine stream S (x int);\n"
+        "from e1=S[x==1], e2=S[x==2] select e1.x as a, e2.x as b "
+        "insert into Out;")
+    rows = []
+    rt.add_callback("Out", lambda evs: rows.extend(e.data for e in evs))
+    rt.start()
+    h = rt.input_handler("S")
+    for _ in range(7):        # one full batch queued + 3 rows buffered
+        h.send((0,))
+    h.send((1,))              # buffered
+    h.send_batch({"x": [2]})  # must stay AFTER the buffered 1
+    rt.flush()
+    m.shutdown()
+    assert rows == [(1, 2)], rows
+
+
+def test_send_batch_scalar_column_rejected():
+    m, rt, _rows = _mk(HEAD + "from S select v insert into Out;")
+    with pytest.raises(ValueError, match="1-d"):
+        rt.input_handler("S").send_batch({"sym": "AB", "p": 1.0, "v": 1})
+    m.shutdown()
