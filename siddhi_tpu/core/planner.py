@@ -209,19 +209,43 @@ class FilterProjectPlan(QueryPlan):
         self._sel = compile_selector(selector, ctx, in_schema)
         self.out_schema = self._sel.out_schema(output_target or f"#{name}")
         self.limit, self.offset = limit, offset
+        # upload ONLY the columns the device program reads (the tunnel
+        # pays per byte both ways): filter reads + computed-output reads +
+        # having reads (incl. pass-through sources having renames)
+        need: set = set()
+        if self._filter is not None:
+            need |= set(self._filter.reads)
+        for fn, pt in zip(self._sel.fns, self._sel.passthrough):
+            if pt is None:
+                need |= set(fn.reads)
+        if self._sel.having is not None:
+            h_reads = set(self._sel.having.reads)
+            need |= h_reads - set(self._sel.names)
+            for nm, pt in zip(self._sel.names, self._sel.passthrough):
+                if pt is not None and nm in h_reads:
+                    need.add(pt)
+        if not need:
+            # constant filter / constant computed column: no data reads,
+            # but the step still needs one column for the batch length
+            need = {"__timestamp__"}
+        self._need = need
         self._step = jax.jit(self._make_step())
 
     def _make_step(self):
         filt, sel = self._filter, self._sel
 
         def step(env):
-            n = env["__timestamp__"].shape[0]
-            mask = filt.fn(env) if filt is not None else jnp.ones(n, dtype=bool)
+            n = next(iter(env.values())).shape[0]
+            mask = (jnp.broadcast_to(filt.fn(env), (n,))  # 0-d if constant
+                    if filt is not None else jnp.ones(n, dtype=bool))
             outs = [None if pt is not None else fn(env)
                     for fn, pt in zip(sel.fns, sel.passthrough)]
             if sel.having is not None:
                 henv = dict(env)
+                h_reads = set(sel.having.reads)
                 for nm, col, pt in zip(sel.names, outs, sel.passthrough):
+                    if nm not in h_reads:
+                        continue        # env is pruned: only map names read
                     henv[nm] = env[pt] if pt is not None else col
                 mask = mask & sel.having.fn(henv)
             # the mask travels bit-packed: the tunnel pays per byte, and
@@ -240,15 +264,28 @@ class FilterProjectPlan(QueryPlan):
         if batch.n == 0 or self.emits_nothing:
             return []
         host_env = {a.name: batch.columns[a.name] for a in self.in_schema.attributes}
-        env = {k: v for k, v in host_env.items() if v.dtype != np.dtype(object)}
-        env["__timestamp__"] = host_env["__timestamp__"] = batch.timestamps
+        host_env["__timestamp__"] = batch.timestamps
+        if self._filter is None and self._sel.having is None \
+                and all(pt is not None for pt in self._sel.passthrough):
+            # pure pass-through (no filter/having/computed column): nothing
+            # for the device to do — emit the batch directly (NOTE: keyed
+            # on plan shape, not on the read-set — constant filters and
+            # constant columns have empty reads but still must evaluate)
+            mask = np.ones(batch.n, dtype=bool)
+            self._inflight.append((None, [], host_env, batch, mask))
+            results: list = []
+            while len(self._inflight) > self.pipeline_depth:
+                results.extend(self._materialize(*self._inflight.pop(0)))
+            return results
+        env = {k: host_env[k] for k in sorted(self._need)
+               if k in host_env and host_env[k].dtype != np.dtype(object)}
         mask_w, outs = self._step(env)
         for a in [mask_w] + list(outs):
             try:        # start D2H pulls early; materialization may defer
                 a.copy_to_host_async()
             except Exception:
                 pass
-        self._inflight.append((mask_w, outs, host_env, batch))
+        self._inflight.append((mask_w, outs, host_env, batch, None))
         results: list = []
         while len(self._inflight) > self.pipeline_depth:
             results.extend(self._materialize(*self._inflight.pop(0)))
@@ -260,11 +297,12 @@ class FilterProjectPlan(QueryPlan):
             results.extend(self._materialize(*self._inflight.pop(0)))
         return results
 
-    def _materialize(self, mask_w, outs, host_env, batch) -> list:
-        words = np.asarray(mask_w)
-        mask = ((words.view(np.uint32)[:, None]
-                 >> np.arange(32, dtype=np.uint32)) & 1
-                ).astype(bool).reshape(-1)[:batch.n]
+    def _materialize(self, mask_w, outs, host_env, batch, mask) -> list:
+        if mask is None:
+            words = np.asarray(mask_w)
+            mask = ((words.view(np.uint32)[:, None]
+                     >> np.arange(32, dtype=np.uint32)) & 1
+                    ).astype(bool).reshape(-1)[:batch.n]
         if not mask.any():
             return []
         ts = batch.timestamps[mask]

@@ -369,6 +369,13 @@ class DeviceWindowAggPlan(QueryPlan):
             if arg is not None:
                 reads |= set(arg.reads)
         reads |= set(self.group_keys)
+        # the sliding length kind never consults time (position-bounded,
+        # and slim output rows reconstruct timestamps host-side): skip
+        # the ts upload unless some expression reads __timestamp__.
+        # lengthBatch still needs it — its non-slim output rows carry
+        # device-side timestamps for events carried from prior batches.
+        self._needs_ts = (self.kind != "length"
+                          or "__timestamp__" in reads)
         reads.discard("__timestamp__")
         unknown = [k for k in reads
                    if k not in schema.types and not k.startswith("__agg")]
@@ -415,8 +422,10 @@ class DeviceWindowAggPlan(QueryPlan):
         return st
 
     def _dummy(self, T: int) -> dict:
-        env = {"__timestamp__": jnp.zeros(T, jnp.int64),
-               "__valid__": jnp.zeros(T, bool)}
+        env = {"__nvalid__": jnp.int32(0)}
+        if self._needs_ts:
+            env["__ts_off__"] = jnp.zeros(T, jnp.int32)
+            env["__ts_base__"] = jnp.int64(0)
         for k in self.cols:
             env[k] = jnp.zeros(T, dtype=jnp_dtype(self.in_schema.types[k]))
         return env
@@ -440,7 +449,9 @@ class DeviceWindowAggPlan(QueryPlan):
 
     def _step_fn(self, T: int, C: int) -> Callable:
         """Per-instance cache (an lru_cache on the bound method would pin
-        the plan instance and its compiled fns forever — advisor r2)."""
+        the plan instance and its compiled fns forever — advisor r2).
+        Offset dtype (i32 vs rare i64 wide batches) needs no cache key:
+        jit re-specializes on the __ts_off__ dtype."""
         cache = getattr(self, "_step_cache", None)
         if cache is None:
             cache = self._step_cache = {}
@@ -642,15 +653,27 @@ class DeviceWindowAggPlan(QueryPlan):
 
         def step(state, env):
             with compute_dtypes(mode):
-                mask = env["__valid__"]
+                # timestamps travel as offsets from a per-batch i64 base
+                # and validity as a prefix count — 5 fewer upload bytes
+                # per event through the tunnel than i64 ts + bool valid;
+                # length kinds with no ts-reading expression skip ts
+                # upload altogether (position-bounded, not time-bounded)
+                if "__ts_off__" in env:
+                    ts64 = env["__ts_base__"] \
+                        + env["__ts_off__"].astype(jnp.int64)
+                else:
+                    ts64 = jnp.zeros(T, jnp.int64)
+                mask = jnp.arange(T, dtype=jnp.int32) < env["__nvalid__"]
                 if filt is not None:
-                    mask = mask & filt.fn(env)
+                    fenv = dict(env)
+                    fenv["__timestamp__"] = ts64
+                    mask = mask & filt.fn(fenv)
                 # compact filtered events to the front: one i32 cumsum + one
                 # scatter per column (a stable argsort here cost 244s of
                 # XLA compile at T=16K and dominated runtime)
                 k = jnp.sum(mask, dtype=jnp.int32)
                 bvalid = jnp.arange(T, dtype=jnp.int32) < k
-                bts = compact(mask, env["__timestamp__"], _TS_PAD)
+                bts = compact(mask, ts64, _TS_PAD)
                 bcols = {c: compact(mask, env[c], 0) for c in cols}
                 if kind == "lengthbatch":
                     res = step_lengthbatch(state, bts, bvalid, bcols, k)
@@ -727,7 +750,10 @@ class DeviceWindowAggPlan(QueryPlan):
         shard_t = NamedSharding(self.mesh, PartitionSpec("t"))
         repl = NamedSharding(self.mesh, PartitionSpec())
         state_sh = {k: repl for k in self.state}
-        env_sh = {"__timestamp__": shard_t, "__valid__": shard_t}
+        env_sh = {"__nvalid__": repl}
+        if self._needs_ts:
+            env_sh["__ts_off__"] = shard_t
+            env_sh["__ts_base__"] = repl
         env_sh.update({c: shard_t for c in cols})
         return jax.jit(step, in_shardings=(state_sh, env_sh))
 
@@ -740,8 +766,15 @@ class DeviceWindowAggPlan(QueryPlan):
         if self.mesh is not None:
             # the sharded 't' axis must divide the device count
             T = max(T, self.mesh.devices.size)
-        env = {"__timestamp__": _pad(batch.timestamps, T, 0),
-               "__valid__": _pad(np.ones(batch.n, bool), T, False)}
+        env = {"__nvalid__": np.int32(batch.n)}
+        if self._needs_ts:
+            base = int(batch.timestamps[0])
+            off = batch.timestamps - base
+            wide = bool(batch.n and (off.max() >= 2**31
+                                     or off.min() < -2**31))
+            env["__ts_off__"] = _pad(off.astype(
+                np.int64 if wide else np.int32), T, 0)
+            env["__ts_base__"] = np.int64(base)
         for c in self.cols:
             col = batch.columns[c]
             if not self.f64 and col.dtype == np.float64:

@@ -169,3 +169,29 @@ def test_validation_errors(mgr):
             define stream S (x int);
             from Unknown select x insert into O;
         """)
+
+
+def test_constant_filter_and_constant_column():
+    """Constant expressions have empty read-sets — the pruned-upload path
+    must still evaluate them on device (review r5)."""
+    from siddhi_tpu import SiddhiManager
+
+    def run(app, sends):
+        m = SiddhiManager()
+        rt = m.create_app_runtime(app)
+        rows = []
+        rt.add_callback("Out", lambda evs: rows.extend(e.data for e in evs))
+        rt.start()
+        for r in sends:
+            rt.input_handler("S").send(r)
+        rt.flush()
+        m.shutdown()
+        return rows
+
+    assert run("define stream S (x int);\n"
+               "from S[1 < 0] select * insert into Out;", [(1,), (2,)]) == []
+    assert run("define stream S (x int);\n"
+               "from S select 42 as c insert into Out;", [(1,)]) == [(42,)]
+    assert run("define stream S (a int, b int);\n"
+               "from S select a, b having a > 0 insert into Out;",
+               [(1, 5), (-1, 6)]) == [(1, 5)]
