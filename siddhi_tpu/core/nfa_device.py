@@ -92,6 +92,7 @@ from .schema import StreamSchema, StringTable
 # local-offset budget: rebase when offsets approach this (i32 headroom)
 LOCAL_SPAN = 1 << 30
 NO_DEADLINE = np.int32(2**31 - 1)
+NO_FIRST = np.int32(LOCAL_SPAN)   # first_ts sentinel: no capture yet
 
 
 class DeviceNFAUnsupported(Exception):
@@ -171,15 +172,29 @@ class ChainSpec:
 
     def maybe_absent_refs(self) -> set:
         """Refs that can be NULL in an emitted match (or-sides, absent
-        nodes, and-pair sides advanced by a partner deadline)."""
+        nodes, and-pair sides advanced by a partner deadline, min-0
+        counts that may emit with zero occurrences)."""
         out = set()
         for p in self.positions:
             if p.op is not None:
+                out.update(p.refs)
+            if p.is_count and p.min_count == 0:
                 out.update(p.refs)
             for n in p.nodes:
                 if n.kind == "absent":
                     out.add(n.ref)
         return out
+
+    @property
+    def needs_init_slot(self) -> bool:
+        """Chains whose START state pre-registers a partial match before
+        any event (host: PatternMatcher.start + _commit_epsilons): an
+        absent head (`not A for T -> ...`) or a min-0 count head
+        (`e1=A<0:2> -> ...`).  Each lane lazily arms one slot on its
+        first activity."""
+        head = self.positions[0]
+        return (any(n.kind == "absent" for n in head.nodes)
+                or (head.is_count and head.min_count == 0))
 
 
 def _conjuncts(e: ast.Expression) -> list:
@@ -255,32 +270,44 @@ def lower_chain(state_input, schemas_by_stream: dict, strings: StringTable,
         raise DeviceNFAUnsupported("non-linear state graph")
 
     # ---- support matrix ---------------------------------------------------
+    # (absent-in-head, sequences with absents, min-0 heads, and
+    # `every`-wrapped absents below the head all lower now — r5)
     S = len(positions)
     for i, pos in enumerate(positions):
-        if pos.sticky and i != 0 and (
-                pos.op is not None or pos.is_count
-                or pos.nodes[0].kind == "absent"):
-            # plain-stream `every` below the head forks slots on device;
-            # every-wrapped logical/count/absent states stay host-only
+        if pos.sticky and i != 0 and (pos.op is not None or pos.is_count):
+            # `every` wrapping a logical pair or count BELOW the head needs
+            # per-slot standing-arm forking at a shared station — host-only
+            # (head every-logical/count re-arm via armed0; head every-absent
+            # via the init-slot fork)
             raise DeviceNFAUnsupported(
-                "`every` below the head on a logical/count/absent state")
-        if pos.min_count == 0 and (i == 0 or not pos.is_count):
-            raise DeviceNFAUnsupported("min-count 0 in the head position")
-        if pos.min_count == 0 and positions[i - 1].is_count \
+                "`every`-wrapped logical/count state below the head")
+        if pos.sticky and i == 0 and (
+                (pos.op is not None
+                 and any(n.kind == "absent" for n in pos.nodes))
+                or (pos.is_count and pos.min_count == 0)):
+            # every-wrapped absent-logical / optional-count heads would
+            # need a forking standing INIT slot — host-only
+            raise DeviceNFAUnsupported(
+                "`every`-wrapped absent-logical or optional-count head")
+        if pos.min_count == 0 and i > 0 and positions[i - 1].is_count \
                 and positions[i - 1].min_count >= 1:
-            # a counting position's min-crossing arm would need to
-            # epsilon-skip the optional count (host _commit_epsilons);
-            # the slot-station model can't express it — host fallback
-            raise DeviceNFAUnsupported(
-                "optional (min-0) count directly after a counting state")
-        if pos.is_count and (pos.op is not None
-                             or pos.nodes[0].kind == "absent"):
-            # the reference grammar only counts basic stream states
-            raise DeviceNFAUnsupported("count on logical/absent state")
-        if i == 0 and any(n.kind == "absent" for n in pos.nodes):
-            raise DeviceNFAUnsupported("absent state in the head position")
-        if is_sequence and any(n.kind == "absent" for n in pos.nodes):
-            raise DeviceNFAUnsupported("sequence with absent states")
+            # an optional-count run after a counting state keeps the
+            # station at the counting state with a chained arm; the chain
+            # must land on a plain (1,1) stream position
+            k = i
+            while k < S and positions[k].is_count \
+                    and positions[k].min_count == 0:
+                k += 1
+            if (k >= S or positions[k].is_count
+                    or positions[k].op is not None
+                    or positions[k].nodes[0].kind == "absent"
+                    or positions[k].sticky):
+                raise DeviceNFAUnsupported(
+                    "optional count run after a counting state landing on "
+                    "a non-stream state")
+        # (count on logical/absent states and min-0 non-count states are
+        # structurally unbuildable from the AST: CountStateElement wraps a
+        # StreamStateElement only — no check needed)
 
     schemas = {n.ref: schemas_by_stream[n.stream_id]
                for p in positions for n in p.nodes}
@@ -372,13 +399,21 @@ class NFAKernel:
     def __init__(self, spec: ChainSpec, sel_fns: dict, having: Optional[CompiledExpr],
                  P: int, A: int, E: Optional[int] = None, f64: bool = False,
                  playback: bool = False, params: Optional[dict] = None,
-                 emit_qid: bool = False):
+                 emit_qid: bool = False, init_on_tick: bool = False):
         self.spec = spec
         self.sel_fns = sel_fns          # out name -> CompiledExpr (ref.attr env)
         self.having = having
         self.P, self.A = P, A
         self.f64 = f64
         self.playback = playback
+        # chains with a pre-registered START state (absent / min-0 count
+        # head): each lane lazily arms one slot on first activity.
+        # init_on_tick: unpartitioned plans also arm on a timer tick (the
+        # host matcher starts at plan start, not first event); partitioned
+        # lanes arm only on their first OWN event (host clones are created
+        # lazily per key).
+        self.needs_init = spec.needs_init_slot
+        self.init_on_tick = init_on_tick
         # multi-query lanes: per-lane (P,) parameter vectors for lifted
         # constants, baked into the trace; emit_qid adds a lane-id row so
         # the host can route each match to its query's output stream
@@ -508,8 +543,17 @@ class NFAKernel:
         for name, ce in sel_fns.items():
             reads = [k for k in ce.reads if "." in k and not k.startswith("__")]
             rparts = {k.split(".", 1)[0] for k in reads}
-            hit = ({_base_ref(rp)[0] for rp in rparts} & self._maybe_absent) \
-                | (rparts & self._maybe_unfilled)
+            # indexed reads (e2[last].p over a count) null-reconstruct via
+            # the per-index presence machinery; bare reads via the ref's
+            # presence bit — don't double-count one read as both
+            hit = set()
+            for rp in rparts:
+                base, cidx = _base_ref(rp)
+                if cidx is not None:
+                    if rp in self._maybe_unfilled:
+                        hit.add(rp)
+                elif base in self._maybe_absent:
+                    hit.add(base)
             if not hit:
                 continue
             if ce.is_var and len(hit) == 1:
@@ -560,9 +604,12 @@ class NFAKernel:
 
     def init_state(self) -> dict:
         P, A = self.P, self.A
-        return {
+        st = {} if not self.needs_init else \
+            {"init": jnp.zeros((P,), dtype=bool)}
+        first0 = NO_FIRST if self.needs_init else 0
+        st.update({
             "occ": jnp.zeros((A, P), dtype=_I32),
-            "first_ts": jnp.zeros((A, P), dtype=_I32),
+            "first_ts": jnp.full((A, P), int(first0), dtype=_I32),
             "head_seq": jnp.zeros((A, P), dtype=_I32),
             "cnt": jnp.zeros((self.Kc, A, P), dtype=_I32),
             "cnt_on": jnp.zeros((self.Kc, A, P), dtype=bool),
@@ -575,7 +622,8 @@ class NFAKernel:
             "armed0": jnp.ones((P,), dtype=bool),
             "of_slots": jnp.zeros((P,), dtype=_I32),
             "of_lanes": jnp.zeros((P,), dtype=_I32),
-        }
+        })
+        return st
 
     # -- env helpers -----------------------------------------------------
 
@@ -674,6 +722,41 @@ class NFAKernel:
         else:
             dl_fire = jnp.zeros((P,), dtype=bool)
 
+        init_flag = carry.get("init")
+        if self.needs_init:
+            # lazy initial slot (host: PatternMatcher.start registers the
+            # entry PM; partition clones start on their key's first event).
+            # Slot 0 of a virgin lane is free by construction.
+            trigger = (valid | tick) if (self.init_on_tick
+                                         and tick is not None) else valid
+            act = ~init_flag & trigger                      # (P,)
+            init_flag = init_flag | act
+            hot0 = (jnp.arange(A, dtype=_I32)[:, None] == 0) & act[None, :]
+            # deadline base: unpartitioned plans ship the START anchor
+            # (host matcher.start time); partitioned lanes use their
+            # first event's timestamp (host clones start per key)
+            anchor = x.get("__anchor__")
+            arm_ts = ts if anchor is None \
+                else jnp.broadcast_to(anchor, ts.shape)
+            head = spec.positions[0]
+            if head.nodes[0].kind == "absent" or head.op is not None:
+                # absent head (or logical head containing an absent):
+                # station at the head, arm its deadlines at activation time
+                occ0 = jnp.where(hot0, 1, occ0)
+                cnt, cnt_on, narm, fl, dl = self._enter_position(
+                    0, hot0, cnt, cnt_on, narm, fl, dl, arm_ts)
+            else:
+                # min-0 count head: collection arms on the head (and any
+                # following optional counts); the station lands on the
+                # first non-optional position (host: _commit_epsilons)
+                land, mids = self._landing_from(-1)
+                occ0 = jnp.where(hot0, land + 1, occ0)
+                for t in (*mids, land):
+                    cnt, cnt_on, narm, fl, dl = self._enter_position(
+                        t, hot0, cnt, cnt_on, narm, fl, dl, arm_ts)
+            head_seq = jnp.where(hot0, seq[None, :], head_seq)
+            occ = occ0
+
         caps_env = self._caps_env(caps)
         age = ts[None, :] - first_ts
         narm0 = narm      # successor arms as of step START: a min crossing
@@ -694,7 +777,9 @@ class NFAKernel:
         # absent-deadline pre-pass: deadlines at or before this event's
         # timestamp fire BEFORE the event is processed (the host's playback
         # pre-fire loop / scheduler ordering), so the freed slot can consume
-        # this very event at its next position
+        # this very event at its next position.  `every`-wrapped absents
+        # fork: the CLONE advances, the standing arm re-arms its deadline
+        # one waiting period later (host: on_timer sticky branch).
         for pi, pos in enumerate(spec.positions):
             if pos.op is not None or not pos.dl_rows:
                 continue
@@ -703,24 +788,45 @@ class NFAKernel:
                 continue
             r = pos.dl_rows[0]
             due = (occ0 == pi + 1) & (dl[r] <= ts[None, :]) & dl_fire[None, :]
+            if pos.sticky:
+                (occ0, first_ts, head_seq, cnt, cnt_on, narm, fl, dl,
+                 caps, adv, lost) = self._fork_slots(
+                    due, occ0, first_ts, head_seq, cnt, cnt_on, narm, fl,
+                    dl, caps)
+                of_slots = of_slots + lost
+                # clones inherited the fired deadline value; read it
+                # BEFORE re-arming the standing arms one period later
+                dl_at = dl[r]
+                rearm = jnp.int32(max(n0.waiting_ms or 1, 1))
+                dl = dl.at[r].set(jnp.where(due, dl[r] + rearm, dl[r]))
+            else:
+                adv = due
+                dl_at = dl[r]             # fired deadline (emission ts)
+            # host: work.first_ts = dl when still unset (timer advance)
+            first_ts = jnp.where(adv & (first_ts == NO_FIRST), dl_at,
+                                 first_ts)
             if pi == S - 1:
-                complete = complete | due
-                cap_writes.append((due, {
-                    "__comp_ts__": dl[r], "__comp_seq__": seq,
+                complete = complete | adv
+                cap_writes.append((adv, {
+                    "__comp_ts__": dl_at, "__comp_seq__": seq,
                     f"__present__.{n0.ref}": jnp.zeros((P,), _I32)}))
             else:
                 land, mids = self._landing_from(pi)
-                occ0 = jnp.where(due, land + 1, occ0)
+                occ0 = jnp.where(adv, land + 1, occ0)
                 for t in (*mids, land):
                     cnt, cnt_on, narm, fl, dl2 = self._enter_position(
-                        t, due, cnt, cnt_on, narm, fl, dl, dl[r])
+                        t, adv, cnt, cnt_on, narm, fl, dl, dl_at)
                     dl = dl2
                 zero_e = self._present_zero(
                     {n.ref for t in (*mids, land)
                      for n in spec.positions[t].nodes})
-                if zero_e:  # immediate: same-step collection reads caps
-                    caps = self._write_caps(caps, due, zero_e)
-            dl = dl.at[r].set(jnp.where(due, NO_DEADLINE, dl[r]))
+                zero_e[f"__present__.{n0.ref}"] = jnp.zeros((P,), _I32)
+                caps = self._write_caps(caps, adv, zero_e)
+            # disarm the fired row: the advancing slot (clone, for sticky)
+            # left this position — a live slot carrying the stale value
+            # would pin the reported min-deadline and wedge the scheduler
+            clear = adv if pos.sticky else due
+            dl = dl.at[r].set(jnp.where(clear, NO_DEADLINE, dl[r]))
         occ = occ0
 
         # within expiry per station (lazy, on event/tick time — reference
@@ -769,8 +875,14 @@ class NFAKernel:
             cnt_on = cnt_on.at[c].set(
                 cnt_on[c] & (newc < jnp.int32(pos.max_count)))
             if pi < S - 1:
-                narm = narm.at[c].set(
-                    narm[c] | (collect & (newc == jnp.int32(pos.min_count))))
+                cross = collect & (newc == jnp.int32(pos.min_count))
+                narm = narm.at[c].set(narm[c] | cross)
+                # epsilon cascade while the station STAYS here: optional
+                # counts after this one arm their collection (staged to
+                # post-event, like the host's deferred registrations)
+                _land, mids_x = self._landing_from(pi)
+                for midp in mids_x:
+                    enters.append((midp, cross))
             transitioned = transitioned | collect
             if pi == S - 1:
                 # count in the final position: every collection at or past
@@ -811,8 +923,11 @@ class NFAKernel:
             at = at_pos[pi]
             if pos.is_count:
                 continue              # handled above
-            if pi == 0 and pos.op is None:
-                continue              # plain head: alloc below
+            if pi == 0 and pos.op is None \
+                    and pos.nodes[0].kind != "absent":
+                continue              # plain stream head: alloc below
+                                      # (absent heads hold an init slot
+                                      # that forbidden arrivals must kill)
 
             if pos.op is not None:
                 fl, dl, k2, t2 = self._logical_step(
@@ -826,21 +941,39 @@ class NFAKernel:
             if n0.kind == "absent":
                 # forbidden arrival kills (deadline passage is handled by
                 # the pre-pass above, reference
-                # AbsentStreamPreStateProcessor.java:60-115)
+                # AbsentStreamPreStateProcessor.java:60-115); an `every`
+                # arm re-arms its wait after the offender instead (host:
+                # _absent_stream_arrived sticky branch)
                 arr = at & nm[(pi, 0)]
-                kill = kill | arr
+                if pos.sticky:
+                    r = pos.dl_rows.get(0)
+                    if r is not None:
+                        dl = dl.at[r].set(jnp.where(
+                            arr, ts[None, :] + jnp.int32(n0.waiting_ms or 0),
+                            dl[r]))
+                else:
+                    kill = kill | arr
                 continue
 
             # (1,1) stream position: eligible when stationed here, or via
-            # the previous count position's armed successor (set at the
-            # exact min crossing, consumed here)
+            # an armed predecessor count (set at its exact min crossing,
+            # consumed here) — walking back across a run of OPTIONAL
+            # counts, whose arms chain (host: _commit_epsilons keeps the
+            # pm pending at every node of the run)
             elig = at
-            prev = spec.positions[pi - 1]
-            if prev.is_count:
-                elig = elig | (at_pos[pi - 1] & narm0[prev.cnt_row])
+            chain = []               # armed predecessor count positions
+            j = pi - 1
+            while j >= 0 and spec.positions[j].is_count:
+                chain.append(j)
+                elig = elig | (at_pos[j]
+                               & narm0[spec.positions[j].cnt_row])
+                if spec.positions[j].min_count != 0:
+                    break
+                j -= 1
             m = elig & nm[(pi, 0)]
-            if prev.is_count:
-                narm = narm.at[prev.cnt_row].set(narm[prev.cnt_row] & ~m)
+            for j in chain:
+                cr = spec.positions[j].cnt_row
+                narm = narm.at[cr].set(narm[cr] & ~m)
             transitioned = transitioned | m
             if pos.sticky:
                 # `every` below the head: the slot is a standing arm — a
@@ -900,9 +1033,15 @@ class NFAKernel:
             caps = self._write_caps(
                 caps, mask, self._present_zero({n.ref for n in tpos.nodes}))
 
+        if self.needs_init:
+            # first capture stamps the within-anchor (host: first_ts set on
+            # first captures append; init slots start with NO_FIRST)
+            stamp = transitioned & (first_ts == NO_FIRST)
+            first_ts = jnp.where(stamp, ts[None, :], first_ts)
+
         # --- sequence strictness ------------------------------------------
         if spec.is_sequence:
-            started = (occ > 0) & (occ < PARK)
+            started = (occ > 0) & (occ < PARK) & (first_ts != NO_FIRST)
             kills = started & ~transitioned & valid[None, :]
             occ = jnp.where(kills, 0, occ)
             if self.Kc:
@@ -916,7 +1055,10 @@ class NFAKernel:
 
         # --- head: slot alloc (or direct single-position emission) --------
         head = spec.positions[0]
-        ok0 = armed0 & self._head_match(x, head, valid)
+        if self.needs_init:
+            ok0 = jnp.zeros((P,), dtype=bool)   # entry = the init slot
+        else:
+            ok0 = armed0 & self._head_match(x, head, valid)
         if not spec.every_head:
             armed0 = armed0 & ~ok0
         if not self._parked_emission:
@@ -940,6 +1082,8 @@ class NFAKernel:
                  "caps_f": caps["caps_f"], "caps_i": caps["caps_i"],
                  "caps_l": caps["caps_l"], "armed0": armed0,
                  "of_slots": of_slots, "of_lanes": of_lanes}
+        if init_flag is not None:
+            carry["init"] = init_flag
         return carry, y
 
     # -- helpers for pieces of the step ----------------------------------
@@ -1015,8 +1159,10 @@ class NFAKernel:
             cnt = cnt.at[tpos.cnt_row].set(jnp.where(mask, 0, cnt[tpos.cnt_row]))
             cnt_on = cnt_on.at[tpos.cnt_row].set(
                 jnp.where(mask, True, cnt_on[tpos.cnt_row]))
+            # min-0 counts arm their successor from entry (epsilon)
+            eps = tpos.min_count == 0 and tpi < len(self.spec.positions) - 1
             narm = narm.at[tpos.cnt_row].set(
-                jnp.where(mask, False, narm[tpos.cnt_row]))
+                jnp.where(mask, eps, narm[tpos.cnt_row]))
         if tpos.log_row is not None:
             fl = fl.at[tpos.log_row].set(jnp.where(mask, 0, fl[tpos.log_row]))
         for ni, r in (tpos.dl_rows or {}).items():
@@ -1393,13 +1539,16 @@ class NFAKernel:
             ev = self._expand_flat(ev, T_static)
         ev.update(self._pre_masks(ev))
         base_ts = ev["__base_ts__"]
+        anchor = ev.get("__anchor__")
         xs = {k: v for k, v in ev.items()
-              if k not in ("__base_ts__", "__base_seq__")}
+              if k not in ("__base_ts__", "__base_seq__", "__anchor__")}
         T = xs["__ts__"].shape[0]
 
         def step(carry, x):
             x = dict(x)
             x["__base_ts__"] = base_ts
+            if anchor is not None:
+                x["__anchor__"] = anchor
             return self._step(carry, x)
 
         carry, ys = lax.scan(step, dict(state), xs)

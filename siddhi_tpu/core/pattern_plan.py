@@ -153,11 +153,17 @@ class DevicePatternPlan(QueryPlan):
                                                       v.dtype)])
                           if len(v) < self.P else v)
                       for k, v in params.items()}
+        # unpartitioned chains also arm their pre-registered START slot
+        # on a timer tick (the host matcher starts at plan start);
+        # partitioned lanes arm on their key's first event only
+        self._init_on_tick = part_key_fns is None
         self.kernel = NFAKernel(self.spec, dict(zip(names, fns)), having,
                                 self.P, slots, f64=self.f64,
                                 playback=rt._playback, params=params,
-                                emit_qid=broadcast_events)
+                                emit_qid=broadcast_events,
+                                init_on_tick=self._init_on_tick)
         self.state = self._shard(self.kernel.init_state())
+        self._start_anchor: Optional[int] = None   # init-slot arm time
         self._ts_base: Optional[int] = None
         self._seq_base: Optional[int] = None
         self._m_hint = 16           # last match-buffer capacity that sufficed
@@ -183,6 +189,7 @@ class DevicePatternPlan(QueryPlan):
                 and partitions == 1
                 and getattr(rt, "_async_workers", 1) == 1
                 and self.spec.every_head and not self.kernel.has_absent
+                and not self.spec.needs_init_slot
                 and all(p.within_ms is not None for p in self.spec.positions)):
             lanes_ann = ast.find_annotation(rt.app.annotations,
                                             "app:deviceChunkLanes")
@@ -309,7 +316,8 @@ class DevicePatternPlan(QueryPlan):
         kern = NFAKernel(self.spec, self.kernel.sel_fns, self.kernel.having,
                          new_p, self.kernel.A, self.kernel.E, f64=self.f64,
                          playback=self.rt._playback, params=self.kernel.params,
-                         emit_qid=self.kernel.emit_qid)
+                         emit_qid=self.kernel.emit_qid,
+                         init_on_tick=self._init_on_tick)
         fresh = kern.init_state()
         self.state = self._shard(jax.tree_util.tree_map(
             lambda f, o: np.concatenate(
@@ -325,7 +333,8 @@ class DevicePatternPlan(QueryPlan):
         kern = NFAKernel(self.spec, self.kernel.sel_fns, self.kernel.having,
                          self.P, new_a, self.kernel.E, f64=self.f64,
                          playback=self.rt._playback, params=self.kernel.params,
-                         emit_qid=self.kernel.emit_qid)
+                         emit_qid=self.kernel.emit_qid,
+                         init_on_tick=self._init_on_tick)
         fresh = kern.init_state()
 
         def pad(f, o):
@@ -343,7 +352,8 @@ class DevicePatternPlan(QueryPlan):
                                 self.kernel.having, self.P, self.kernel.A,
                                 E, f64=self.f64, playback=self.rt._playback,
                                 params=self.kernel.params,
-                                emit_qid=self.kernel.emit_qid)
+                                emit_qid=self.kernel.emit_qid,
+                                init_on_tick=self._init_on_tick)
 
     def _rebase(self, min_ts: int, min_seq: int) -> None:
         """Shift the plan's ts/seq bases forward and adjust persistent slot
@@ -353,8 +363,9 @@ class DevicePatternPlan(QueryPlan):
         st = {k: np.asarray(v) for k, v in self.state.items()}
         if self._ts_base is not None and min_ts > self._ts_base:
             d = min_ts - self._ts_base
-            st["first_ts"] = np.maximum(
-                st["first_ts"].astype(np.int64) - d, -LOCAL_SPAN).astype(_I32)
+            no_first = st["first_ts"] == np.int32(LOCAL_SPAN)  # NO_FIRST
+            st["first_ts"] = np.where(no_first, st["first_ts"], np.maximum(
+                st["first_ts"].astype(np.int64) - d, -LOCAL_SPAN)).astype(_I32)
             if st["dl"].size:
                 no_dl = st["dl"] == np.int32(2**31 - 1)
                 st["dl"] = np.where(
@@ -432,7 +443,10 @@ class DevicePatternPlan(QueryPlan):
         # `within` expires them — never a silent wrap).
         budget = LOCAL_SPAN - (1 << 16)
         if self._ts_base is None:
-            self._ts_base = max(int(ts.min()), int(ts.max()) - budget)
+            lo = int(ts.min())
+            if self.spec.needs_init_slot and self._init_on_tick:
+                lo = min(lo, self._anchor_ms())
+            self._ts_base = max(lo, int(ts.max()) - budget)
             self._seq_base = max(int(seq.min()), int(seq.max()) - budget)
         if int(ts.max()) - self._ts_base >= budget \
                 or int(seq.max()) - self._seq_base >= budget:
@@ -475,6 +489,10 @@ class DevicePatternPlan(QueryPlan):
                 ev[k][t_local, pm] = v[m]
             ev["__base_ts__"] = np.int64(self._ts_base)
             ev["__base_seq__"] = np.int64(self._seq_base)
+            if self.spec.needs_init_slot and self._init_on_tick:
+                ev["__anchor__"] = np.int32(np.clip(
+                    self._anchor_ms() - self._ts_base,
+                    -LOCAL_SPAN, LOCAL_SPAN))
             chunk_evs.append((ev, T))
 
         return self._run_chunks(chunk_evs)
@@ -843,14 +861,47 @@ class DevicePatternPlan(QueryPlan):
 
     # -- timers (absent-state deadlines) ---------------------------------
 
+    def _anchor_ms(self) -> int:
+        """START-state arm time for init-slot chains (host parity:
+        matcher.start at first finalize/next_wakeup with rt.now_ms(), or
+        the earliest buffered event time in pre-clock playback)."""
+        if self._start_anchor is None:
+            now = self.rt.now_ms()
+            if self.rt._playback and self.rt._clock_ms is None \
+                    and self._buffered:
+                now = min(int(b.timestamps.min())
+                          for _s, b in self._buffered)
+            self._start_anchor = int(now)
+        return self._start_anchor
+
     def next_wakeup(self) -> Optional[int]:
+        if (self.spec.needs_init_slot and self._init_on_tick
+                and self._ts_base is None):
+            # pre-registered absent head, no block run yet: the first
+            # deadline is anchor + waiting (host: matcher.start then
+            # next_wakeup)
+            ws = [n.waiting_ms for n in self.spec.positions[0].nodes
+                  if n.kind == "absent" and n.waiting_ms is not None]
+            if ws:
+                return self._anchor_ms() + min(ws)
         return self._next_deadline
 
     def on_timer(self, now_ms: int) -> list:
         """Fire pending absent-state deadlines <= now via a 1-step tick
         block (valid=False cells with the timer's timestamp)."""
-        if not self.kernel.has_absent or self._ts_base is None \
-                or self._next_deadline is None or now_ms < self._next_deadline:
+        if not self.kernel.has_absent:
+            return []
+        if self._ts_base is None:
+            if not (self.spec.needs_init_slot and self._init_on_tick):
+                return []
+            w = self.next_wakeup()
+            if w is None or now_ms < w:
+                return []
+            # first activity is a timer: anchor the offset bases so the
+            # tick block can arm the init slots and fire their deadlines
+            self._ts_base = self._anchor_ms()
+            self._seq_base = 0
+        elif self._next_deadline is None or now_ms < self._next_deadline:
             return []
         import jax.numpy as jnp
         T = 1
@@ -863,6 +914,9 @@ class DevicePatternPlan(QueryPlan):
                                          -LOCAL_SPAN, LOCAL_SPAN), _I32),
               "__valid__": np.zeros((T, GW), bool),
               "__tick__": np.ones((T, GW), bool)}
+        if self.spec.needs_init_slot and self._init_on_tick:
+            ev["__anchor__"] = np.int32(np.clip(
+                self._anchor_ms() - self._ts_base, -LOCAL_SPAN, LOCAL_SPAN))
         if len(self.spec.stream_ids) > 1:
             ev["__scode__"] = np.full((T, GW), -1, _I32)
         for si, attr, t in self._grid_attrs:
@@ -882,7 +936,8 @@ class DevicePatternPlan(QueryPlan):
         d = {"state": st, "key_to_part": dict(self._key_to_part),
              "ts_base": self._ts_base, "seq_base": self._seq_base,
              "next_deadline": self._next_deadline,
-             "last_seq": self._last_seq}
+             "last_seq": self._last_seq,
+             "start_anchor": self._start_anchor}
         if self._chunk_cfg is not None:
             # chunked mode keeps no device state: continuity lives in the
             # replayed tail + the last-emitted completion seq
@@ -903,7 +958,8 @@ class DevicePatternPlan(QueryPlan):
                                  self.kernel.having, p_r, a, self.kernel.E,
                                  f64=self.f64, playback=self.rt._playback,
                                  params=self.kernel.params,
-                                 emit_qid=self.kernel.emit_qid)
+                                 emit_qid=self.kernel.emit_qid,
+                                 init_on_tick=self._init_on_tick)
                 fresh = jax.tree_util.tree_map(np.asarray, kern.init_state())
                 st = jax.tree_util.tree_map(
                     lambda o, f: np.concatenate(
@@ -915,12 +971,14 @@ class DevicePatternPlan(QueryPlan):
                                     self.kernel.having, p, a, self.kernel.E,
                                     f64=self.f64, playback=self.rt._playback,
                                     params=self.kernel.params,
-                                    emit_qid=self.kernel.emit_qid)
+                                    emit_qid=self.kernel.emit_qid,
+                                    init_on_tick=self._init_on_tick)
             self.P = p
         self.state = self._shard(st)
         self._key_to_part = dict(d["key_to_part"])
         self._ts_base = d.get("ts_base")
         self._seq_base = d.get("seq_base")
+        self._start_anchor = d.get("start_anchor")
         # legacy snapshots (no last_seq) fall back to the seq base — a
         # deadline fired before the next batch must not emit seq 0-based
         self._last_seq = int(d["last_seq"] if d.get("last_seq") is not None

@@ -1,0 +1,295 @@
+"""Device NFA algebra completion (VERDICT r4 #3): absent-in-head, min-0
+count heads, sequences containing absents, `every`-wrapped absents below
+the head.  Each shape must (a) LOWER to the device kernel (no silent host
+fallback) and (b) match the host oracle on scenario + fuzz tapes.
+
+Reference semantics: StateInputStreamParser.java:77-143 composes every
+state shape; AbsentStreamPreStateProcessor.java:60-115 arms waiting-time
+deadlines from state registration (START registration for head absents).
+"""
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.pattern_plan import DevicePatternPlan
+
+T0 = 1_000_000
+
+HEAD = """
+@app:playback
+define stream S1 (sym string, price double);
+define stream S2 (sym string, price double);
+define stream S3 (sym string, price double);
+"""
+
+
+def _run(app, sends, marks=(), want_device=None):
+    m = SiddhiManager()
+    rt = m.create_app_runtime(app)
+    if want_device is not None:
+        got = any(isinstance(p, DevicePatternPlan) for p in rt._plans)
+        assert got == want_device, \
+            f"device-engaged={got}, wanted {want_device}"
+    out = []
+    rt.add_callback("O", lambda evs: out.extend(
+        tuple(v for v in e.data) for e in evs))
+    rt.start()
+    rt.set_time(T0 - 1)
+    events = sorted(sends, key=lambda s: s[2])
+    marks = sorted(marks)
+    mi = 0
+    for sid, row, ts in events:
+        while mi < len(marks) and marks[mi] <= ts:
+            rt.set_time(marks[mi]); mi += 1
+        rt.input_handler(sid).send(row, timestamp=ts)
+        rt.flush()
+    for t in marks[mi:]:
+        rt.set_time(t)
+    rt.flush()
+    m.shutdown()
+    return out
+
+
+def both(body, sends, marks=(), device=True):
+    """Run device-engaged (asserted) and host; outputs must match."""
+    dev = _run("@app:devicePatterns('prefer')\n" + HEAD + body, sends,
+               marks, want_device=device)
+    host = _run("@app:devicePatterns('never')\n" + HEAD + body, sends,
+                marks, want_device=False)
+    assert dev == host, (len(dev), len(host), dev[:5], host[:5])
+    return dev
+
+
+# ---------------------------------------------------------------------------
+# device engagement: the r4 fallback shapes now lower
+# ---------------------------------------------------------------------------
+
+ENGAGED_SHAPES = {
+    "absent_head": "from not S1[price>20] for 1 sec -> e2=S2[price>30] "
+                   "select e2.sym as b insert into O;",
+    "every_absent_head": "from every not S1[price>10] for 1 sec -> "
+                         "e2=S2[price>20] select e2.sym as b insert into O;",
+    "seq_absent_tail": "from e1=S1[price>10], not S2[price>20] for 1 sec "
+                       "select e1.sym as a insert into O;",
+    "min0_head": "from e1=S1[price>10]<0:3> -> e2=S2[price>20] "
+                 "select e2.sym as b insert into O;",
+    "every_absent_mid": "from e1=S1[price>10] -> every not S2[price>20] "
+                        "for 1 sec -> e3=S3[price>30] "
+                        "select e1.sym as a, e3.sym as b insert into O;",
+}
+
+
+@pytest.mark.parametrize("name", list(ENGAGED_SHAPES))
+def test_shape_lowers_to_device(name):
+    m = SiddhiManager()
+    rt = m.create_app_runtime("@app:devicePatterns('always')\n" + HEAD
+                              + ENGAGED_SHAPES[name])
+    assert any(isinstance(p, DevicePatternPlan) for p in rt._plans)
+    m.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# scenario matrix
+# ---------------------------------------------------------------------------
+
+def test_min0_head_zero_occurrences():
+    """e2 alone matches; e1 emits null (zero collected occurrences)."""
+    body = ("from e1=S1[price>10]<0:3> -> e2=S2[price>20] "
+            "select e1.sym as a, e2.sym as b insert into O;")
+    out = both(body, [("S2", ("B", 25.0), T0 + 100)])
+    assert out == [(None, "B")]
+
+
+def test_min0_head_with_occurrences():
+    body = ("from e1=S1[price>10]<0:3> -> e2=S2[price>20] "
+            "select e1.sym as a, e2.sym as b insert into O;")
+    out = both(body, [("S1", ("A", 15.0), T0),
+                      ("S1", ("A2", 16.0), T0 + 50),
+                      ("S2", ("B", 25.0), T0 + 100)])
+    assert out and out[0][1] == "B" and out[0][0] in ("A", "A2")
+
+
+def test_seq_absent_mid_strictness():
+    """Sequence `e1, not X for T, e2`: any event during the wait breaks
+    contiguity (host strictness)."""
+    body = ("from e1=S1[price>10], not S2[price>20] for 1 sec, "
+            "e3=S3[price>30] select e1.sym as a, e3.sym as b insert into O;")
+    # quiet wait, then deadline passes, then IMMEDIATE e3 -> match
+    out = both(body, [("S1", ("A", 15.0), T0),
+                      ("S3", ("C", 35.0), T0 + 1100)], [T0 + 1050])
+    # an S3 arriving mid-wait breaks it
+    out2 = both(body, [("S1", ("A", 15.0), T0),
+                       ("S3", ("C", 35.0), T0 + 500),
+                       ("S3", ("C2", 36.0), T0 + 1100)], [T0 + 1050])
+    assert out == [("A", "C")] and out2 == []
+
+
+def test_every_absent_head_rearms():
+    """`every not A for 1s -> e2=B`: one arm per elapsed period."""
+    body = ("from every not S1[price>10] for 1 sec -> e2=S2[price>20] "
+            "select e2.sym as b insert into O;")
+    # two quiet periods -> two armed clones; both Bs after -> each B
+    # completes the clones pending at e2
+    out = both(body, [("S2", ("B1", 25.0), T0 + 1200),
+                      ("S2", ("B2", 26.0), T0 + 2400)], [T0 + 1100,
+                                                         T0 + 2300])
+    assert len(out) >= 2
+
+
+def test_absent_head_snapshot_restore():
+    """Init-slot state (armed deadline) survives snapshot/restore."""
+    body = ("from not S1[price>20] for 1 sec -> e2=S2[price>30] "
+            "select e2.sym as b insert into O;")
+    app = "@app:devicePatterns('prefer')\n" + HEAD + body
+    m = SiddhiManager()
+    rt = m.create_app_runtime(app)
+    assert any(isinstance(p, DevicePatternPlan) for p in rt._plans)
+    out = []
+    rt.add_callback("O", lambda evs: out.extend(tuple(e.data) for e in evs))
+    rt.start()
+    rt.set_time(T0 - 1)
+    rt.input_handler("S2").send(("early", 35.0), timestamp=T0 + 100)
+    rt.flush()                      # before the wait elapses: no match
+    snap = rt.snapshot()
+    m.shutdown()
+
+    m2 = SiddhiManager()
+    rt2 = m2.create_app_runtime(app)
+    out2 = []
+    rt2.add_callback("O", lambda evs: out2.extend(tuple(e.data)
+                                                  for e in evs))
+    rt2.start()
+    rt2.restore(snap)
+    rt2.set_time(T0 + 1100)         # wait elapses post-restore
+    rt2.input_handler("S2").send(("late", 35.0), timestamp=T0 + 1200)
+    rt2.flush()
+    m2.shutdown()
+    assert out == [] and out2 == [("late",)]
+
+
+# ---------------------------------------------------------------------------
+# differential fuzz: random tapes over the new shapes
+# ---------------------------------------------------------------------------
+
+FUZZ_SHAPES = [
+    "from not S1[price>20] for 300 milliseconds -> e2=S2[price>30] "
+    "select e2.sym as b insert into O;",
+    "from every not S1[price>15] for 250 milliseconds -> e2=S2[price>25] "
+    "select e2.sym as b insert into O;",
+    "from e1=S1[price>10], not S2[price>20] for 200 milliseconds "
+    "select e1.sym as a insert into O;",
+    "from e1=S1[price>10]<0:2> -> e2=S2[price>20] "
+    "select e2.sym as b insert into O;",
+    "from e1=S1[price>10] -> every not S2[price>15] for 250 milliseconds "
+    "-> e3=S3[price>20] select e1.sym as a, e3.sym as b insert into O;",
+]
+
+
+@pytest.mark.parametrize("si", range(len(FUZZ_SHAPES)))
+def test_fuzz_new_shapes(si):
+    rng = np.random.default_rng(100 + si)
+    body = FUZZ_SHAPES[si]
+    streams = ["S1", "S2", "S3"]
+    for trial in range(4):
+        n = 40
+        ts = T0 + np.cumsum(rng.integers(10, 120, size=n))
+        sends = [(streams[int(rng.integers(0, 3))],
+                  (f"E{i}", float(rng.integers(5, 40))), int(ts[i]))
+                 for i in range(n)]
+        marks = [int(ts[-1]) + 500]
+        both(body, sends, marks)
+
+
+# ---------------------------------------------------------------------------
+# optional-count run after a counting state (r4 matrix entry, now lowered)
+# ---------------------------------------------------------------------------
+
+OPT_AFTER_COUNT = ("from e1=S1[price>10]<1:2> -> e2=S2[price>20]<0:2> -> "
+                   "e3=S3[price>30] select e1[0].sym as a, e3.sym as c "
+                   "insert into O;")
+
+
+def test_opt_count_after_count_lowers():
+    m = SiddhiManager()
+    rt = m.create_app_runtime("@app:devicePatterns('always')\n" + HEAD
+                              + OPT_AFTER_COUNT)
+    assert any(isinstance(p, DevicePatternPlan) for p in rt._plans)
+    m.shutdown()
+
+
+def test_opt_count_after_count_zero_mid():
+    """e1 then e3 directly (zero e2 occurrences) matches."""
+    out = both(OPT_AFTER_COUNT, [("S1", ("A", 15.0), T0),
+                                 ("S3", ("C", 35.0), T0 + 100)])
+    assert out == [("A", "C")]
+
+
+def test_opt_count_after_count_with_mids():
+    out = both(OPT_AFTER_COUNT, [("S1", ("A", 15.0), T0),
+                                 ("S2", ("B", 25.0), T0 + 50),
+                                 ("S2", ("B2", 26.0), T0 + 60),
+                                 ("S3", ("C", 35.0), T0 + 100)])
+    assert out == [("A", "C")]
+
+
+def test_opt_count_after_count_fuzz():
+    rng = np.random.default_rng(77)
+    streams = ["S1", "S2", "S3"]
+    for trial in range(6):
+        n = 30
+        ts = T0 + np.cumsum(rng.integers(5, 60, size=n))
+        sends = [(streams[int(rng.integers(0, 3))],
+                  (f"E{i}", float(rng.integers(5, 40))), int(ts[i]))
+                 for i in range(n)]
+        both(OPT_AFTER_COUNT, sends)
+        both("from every e1=S1[price>10]<1:2> -> e2=S2[price>20]<0:2> -> "
+             "e3=S3[price>30] select e1[0].sym as a, e3.sym as c "
+             "insert into O;", sends)
+
+
+# ---------------------------------------------------------------------------
+# regressions from the r5 review
+# ---------------------------------------------------------------------------
+
+def test_rebase_preserves_no_first_sentinel():
+    """A ts-base rebase (forced by a >LOCAL_SPAN jump) must not turn the
+    NO_FIRST sentinel of an unstarted init slot into an ancient age."""
+    body = ("from e1=S1[price>10]<0:3> -> e2=S2[price>20] "
+            "within 1000 sec select e2.sym as b insert into O;")
+    jump = 4_000_000_000            # > 2^30 ms: forces a rebase
+    sends = [("S2", ("miss", 5.0), T0),             # arms, no match
+             ("S2", ("B", 25.0), T0 + jump)]        # post-rebase match
+    out = both(body, sends)
+    assert out == [("B",)]
+
+
+def test_absent_head_anchor_survives_restore():
+    """The START anchor is part of the snapshot: restoring late must not
+    re-anchor the wait at restore time (review r5)."""
+    body = ("from not S1[price>20] for 1 sec -> e2=S2[price>30] "
+            "select e2.sym as b insert into O;")
+    app = "@app:devicePatterns('prefer')\n" + HEAD + body
+    m = SiddhiManager()
+    rt = m.create_app_runtime(app)
+    rt.start()
+    rt.set_time(T0)                 # anchor at T0 -> deadline T0+1000
+    rt.flush()
+    snap = rt.snapshot()
+    m.shutdown()
+
+    m2 = SiddhiManager()
+    rt2 = m2.create_app_runtime(app)
+    out2 = []
+    rt2.add_callback("O", lambda evs: out2.extend(tuple(e.data)
+                                                  for e in evs))
+    rt2.start()
+    rt2.set_time(T0 + 9000)         # restore-time is late
+    rt2.restore(snap)
+    rt2.set_time(T0 + 9500)         # original deadline long past
+    rt2.input_handler("S2").send(("late", 35.0), timestamp=T0 + 9600)
+    rt2.flush()
+    m2.shutdown()
+    host = _run("@app:devicePatterns('never')\n" + HEAD + body,
+                [("S2", ("late", 35.0), T0 + 9600)], [T0 + 9500],
+                want_device=False)
+    assert out2 == [("late",)] == host
