@@ -247,6 +247,17 @@ def _plan_query_scoped(rt, q: ast.Query, default_name: str):
             rt, InterpSingleQueryPlan(name, rt, q, inp, target), q, name)
 
     if isinstance(inp, ast.JoinInputStream):
+        mode = getattr(rt, "device_joins", "auto")
+        if mode != "never":
+            from .join_device import DeviceJoinPlan, DeviceJoinUnsupported
+            try:
+                return attach_table_writer(
+                    rt, DeviceJoinPlan(name, rt, q, inp, target), q, name)
+            except DeviceJoinUnsupported as e:
+                if mode == "always":
+                    raise PlanError(
+                        f"query {name!r}: @app:deviceJoins('always') but "
+                        f"the shape is host-only: {e}")
         from ..interp.joins import InterpJoinQueryPlan
         return attach_table_writer(
             rt, InterpJoinQueryPlan(name, rt, q, inp, target), q, name)

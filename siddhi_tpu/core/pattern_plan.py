@@ -393,6 +393,11 @@ class DevicePatternPlan(QueryPlan):
     def _finalize_chunks(self) -> list:
         if not self._buffered:
             return []
+        if self.spec.needs_init_slot and self._init_on_tick:
+            # pin the START anchor while _buffered still holds the tape
+            # (pre-clock playback anchors at the earliest buffered event;
+            # after the pop the fallback would be the wall clock — review r5)
+            self._anchor_ms()
         bufs, self._buffered = self._buffered, []
 
         # 1. union columns over all buffered batches

@@ -108,6 +108,11 @@ class SiddhiAppRuntime:
         # "always" (device or error), "never" (host interpreter)
         dw = qast.find_annotation(app.annotations, "app:deviceWindows")
         self.device_windows = dw.element() if dw is not None else "auto"
+        # device window-joins: "auto" (device for supported shapes, host
+        # fallback), "always" (device or error), "never"
+        dj = qast.find_annotation(app.annotations, "app:deviceJoins")
+        self.device_joins = dj.element() if dj is not None else \
+            _os.environ.get("SIDDHI_DEVICE_JOINS", "auto")
         # stateless filter/projection: "auto" (jitted device kernel),
         # "never" (host interpreter — benchmarking / debugging)
         df = qast.find_annotation(app.annotations, "app:deviceFilters")

@@ -293,3 +293,31 @@ def test_absent_head_anchor_survives_restore():
                 [("S2", ("late", 35.0), T0 + 9600)], [T0 + 9500],
                 want_device=False)
     assert out2 == [("late",)] == host
+
+
+def test_absent_head_playback_anchor_without_set_time():
+    """Pre-clock playback: the START anchor must come from the earliest
+    buffered event, not the wall clock (review r5 — the wall anchor puts
+    the deadline ~50 years past the tape)."""
+    body = ("from not S1[price>20] for 1 sec -> e2=S2[price>30] "
+            "select e2.sym as b insert into O;")
+
+    def run(mode):
+        m = SiddhiManager()
+        rt = m.create_app_runtime(f"@app:devicePatterns('{mode}')\n"
+                                  + HEAD + body)
+        if mode == "prefer":
+            assert any(isinstance(p, DevicePatternPlan) for p in rt._plans)
+        out = []
+        rt.add_callback("O", lambda evs: out.extend(tuple(e.data)
+                                                    for e in evs))
+        rt.start()                       # NO set_time: clock unanchored
+        rt.input_handler("S1").send(("x", 5.0), timestamp=T0)  # not forbidden
+        rt.flush()
+        rt.set_time(T0 + 1100)           # wait elapses on the event timeline
+        rt.input_handler("S2").send(("B", 35.0), timestamp=T0 + 1200)
+        rt.flush()
+        m.shutdown()
+        return out
+    dev, host = run("prefer"), run("never")
+    assert dev == host == [("B",)]
