@@ -288,10 +288,9 @@ class DeviceJoinPlan(QueryPlan):
                 m = m & jnp.broadcast_to(ce.fn(env), (T,))
             return m
 
-        def probes(probe, other, p_ev, o_ev, p_pass, o_pass, T, NO, Mw):
-            """pairs (T, NO_tot) grid for probe side vs other's window."""
+        def probes(probe, other, p_ev, o_ev, p_pass, o_pass, NO, Mw):
+            """pairs (T, NO + T_other) grid: probe side vs other's window."""
             Lo = o_ev["mirror_n"]                      # i32 scalar
-            NO_tot = NO + o_ev["bT"]
             # opposite union: [mirror slots (NO cap) | other batch]
             def ucol(name):
                 return jnp.concatenate([o_ev[f"m.{name}"], o_ev[name]])
@@ -361,10 +360,10 @@ class DeviceJoinPlan(QueryPlan):
                 out = {"pl": bits32(pl), "pr": bits32(pr)}  # packed below
                 widthL = NR + TR        # left probes right's union
                 widthR = NL + TL
-                gl = probes(left, right, lev, rev, pl, pr, TL, NR,
+                gl = probes(left, right, lev, rev, pl, pr, NR,
                             right.win_len) if trig in ("all", "left") \
                     else jnp.zeros((TL, NR + TR), bool)
-                gr = probes(right, left, rev, lev, pr, pl, TR, NL,
+                gr = probes(right, left, rev, lev, pr, pl, NL,
                             left.win_len) if trig in ("all", "right") \
                     else jnp.zeros((TR, NL + TL), bool)
                 nL, idxL = compact_pairs(gl, M)

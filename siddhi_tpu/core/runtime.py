@@ -144,12 +144,20 @@ class SiddhiAppRuntime:
             bf = _el("buffer.size")
             if bf is not None:
                 self._async_buffer = max(1, int(bf))
-            if self._async_workers > 1:
+        # @app:enforceOrder restores cross-batch ordering under
+        # workers>1 via ticketed lock acquisition (reference:
+        # SiddhiAppParser.java:94-98)
+        self._enforce_order = qast.find_annotation(
+            app.annotations, "app:enforceOrder") is not None
+        self._order_mutex = None        # set when ordered workers start
+        if asy is not None:
+            if self._async_workers > 1 and not self._enforce_order:
                 import warnings
                 warnings.warn(
                     f"@app:async(workers={self._async_workers}): cross-batch "
                     f"ordering is not preserved with multiple workers (same "
-                    f"trade as the reference multi-worker StreamJunction)",
+                    f"trade as the reference multi-worker StreamJunction; "
+                    f"add @app:enforceOrder to restore it)",
                     RuntimeWarning, stacklevel=2)
         # auto-batching to a latency target: builders flush when their
         # oldest buffered event has waited this long, so micro-batch size
@@ -326,8 +334,20 @@ class SiddhiAppRuntime:
         # bounded: backpressure (reference buffer.size ring capacity)
         self._ingest_q = _queue.Queue(maxsize=self._async_buffer)
 
+        order = self._enforce_order and self._async_workers > 1
+        if order:
+            # @app:enforceOrder: pop+process is ATOMIC under an order
+            # mutex, so multi-worker scheduling jitter cannot reorder
+            # cross-batch processing (reference: SiddhiAppParser.java:94-98
+            # wraps the multi-worker junction).  Processing is serialized
+            # by the runtime lock anyway; the annotation trades the
+            # residual pop->process race away.
+            self._order_mutex = threading.Lock()
+
         def worker():
             while True:
+                if order:
+                    self._order_mutex.acquire()
                 item = self._ingest_q.get()
                 try:
                     if item is None:
@@ -342,6 +362,8 @@ class SiddhiAppRuntime:
                 except BaseException as e:   # surface at the flush barrier
                     self._ingest_err = e
                 finally:
+                    if order:
+                        self._order_mutex.release()
                     self._ingest_q.task_done()
 
         self._ingest_thread = threading.Thread(
@@ -735,6 +757,15 @@ class SiddhiAppRuntime:
     def _async_barrier(self) -> None:
         import queue as _queue
         owned = getattr(self._lock, "_is_owned", lambda: False)()
+        if owned and getattr(self, "_order_mutex", None) is not None:
+            # @app:enforceOrder: draining the queue inline here would
+            # process batches ahead of one a worker already popped (it is
+            # blocked on the lock we hold) — surface errors and return;
+            # the queued tail flushes, in order, after we release
+            if self._ingest_err is not None:
+                err, self._ingest_err = self._ingest_err, None
+                raise err
+            return
         if owned:
             # the caller holds the runtime lock (query()/snapshot()/
             # set_time() nested flush): the worker can't run, so drain the
@@ -1077,6 +1108,10 @@ class SiddhiManager:
     def __init__(self, isolated_broker: bool = False,
                  allow_scripts: bool = True):
         self.allow_scripts = allow_scripts
+        # entry-point extension discovery (once per process; reference:
+        # SiddhiExtensionLoader scans the classpath at manager creation)
+        from ..extension import discover_extensions
+        discover_extensions()
         self.persistence_store = None
         self.config_manager = None      # ConfigManager SPI (core/config.py)
         self._runtimes: dict = {}

@@ -94,6 +94,8 @@ def validate_meta(meta: ExtensionMeta, kind: str = "extension") -> None:
 
 # central metadata registry: (kind, namespace, lowercase name) -> meta
 _REGISTRY: dict = {}
+# set during entry-point discovery: duplicate registrations raise
+_strict_collisions = False
 
 
 def register_meta(kind: str, meta) -> None:
@@ -102,7 +104,14 @@ def register_meta(kind: str, meta) -> None:
     if meta is None:
         return
     validate_meta(meta, kind)
-    _REGISTRY[(kind, meta.namespace or "", meta.name.lower())] = meta
+    key = (kind, meta.namespace or "", meta.name.lower())
+    if _strict_collisions and key in _REGISTRY:
+        raise ExtensionError(
+            f"duplicate {kind} extension "
+            f"{(meta.namespace + ':') if meta.namespace else ''}"
+            f"{meta.name!r} (already registered) — entry-point extensions "
+            f"must use unique namespace:name pairs")
+    _REGISTRY[key] = meta
 
 
 def meta_for(kind: str, name: str, namespace: str = ""):
@@ -336,3 +345,54 @@ for _m in BUILTIN_WINDOWS:
     register_meta("window", _m)
 for _m in BUILTIN_AGGREGATORS:
     register_meta("aggregator", _m)
+
+
+# ---------------------------------------------------------------------------
+# entry-point discovery (reference: core:util/SiddhiExtensionLoader.java:50-95
+# scans the annotation-indexed classpath for @Extension classes and fills the
+# namespace:name -> class map; the Python analog scans installed packages'
+# entry points)
+# ---------------------------------------------------------------------------
+
+ENTRY_POINT_GROUP = "siddhi_tpu.extensions"
+_discovered = False
+_loaded_eps: set = set()      # "name = module:attr" values already invoked
+
+
+def discover_extensions(force: bool = False) -> list:
+    """Scan installed distributions for `[siddhi_tpu.extensions]` entry
+    points and invoke each (the loaded object must be a callable that
+    performs its `register_*` calls, passing ExtensionMeta so the
+    registration-time validation tier applies).  During the scan,
+    namespace:name collisions in the metadata registry raise
+    ExtensionError instead of silently overwriting (the reference loader
+    logs-and-keeps-first; we fail loud).  Runs once per process unless
+    `force`; returns the entry-point names loaded this call."""
+    global _discovered, _strict_collisions
+    if _discovered and not force:
+        return []
+    _discovered = True
+    import importlib.metadata as md
+    try:
+        eps = md.entry_points(group=ENTRY_POINT_GROUP)
+    except TypeError:       # pre-3.10 signature
+        eps = md.entry_points().get(ENTRY_POINT_GROUP, [])
+    loaded = []
+    _strict_collisions = True
+    try:
+        for ep in eps:
+            ident = f"{ep.name}={ep.value}"
+            if ident in _loaded_eps:
+                continue          # forced rescan: only NEW entry points run
+            reg = ep.load()
+            if not callable(reg):
+                raise ExtensionError(
+                    f"entry point {ep.name!r} in group "
+                    f"{ENTRY_POINT_GROUP!r} must load to a callable "
+                    f"register function, got {type(reg).__name__}")
+            reg()
+            _loaded_eps.add(ident)
+            loaded.append(ep.name)
+    finally:
+        _strict_collisions = False
+    return loaded
