@@ -420,11 +420,14 @@ class DeviceJoinPlan(QueryPlan):
         n = sum(b.n for b in mine)
         cols = {}
         for a in side.schema.attributes:
-            dt = self._np_dtype(a.type)
+            # ORIGINAL dtype: pass-through outputs gather from these
+            # host-side at full precision; the device upload (ev_of)
+            # downcasts its own padded copies (f32 DOUBLE policy)
+            dt = dtype_of(a.type)
             col = np.empty(n, dtype=dt)
             o = 0
             for b in mine:
-                col[o:o + b.n] = b.columns[a.name].astype(dt)
+                col[o:o + b.n] = b.columns[a.name]
                 o += b.n
             cols[a.name] = col
         ts = np.concatenate([b.timestamps for b in mine]) if mine \
@@ -583,11 +586,11 @@ class DeviceJoinPlan(QueryPlan):
         TL, TR = entry["TL"], entry["TR"]
 
         def union_col(side, key, cols, name, n, T):
-            dt = self._np_dtype(side.schema.type_of(name))
+            dt = dtype_of(side.schema.type_of(name))     # full precision
             w = max(side.win_len, 1)
             u = np.zeros(w + T, dtype=dt)
             mc, mn = entry["mirror_snap"][key]
-            u[:mn] = mc[name].astype(dt)[:mn]
+            u[:mn] = mc[name][:mn]
             u[w:w + n] = cols[name]
             return u
 
@@ -634,8 +637,7 @@ class DeviceJoinPlan(QueryPlan):
                     if ref == side_probe.ref:
                         cols_out[nm] = p_cols[attr][idx]
                     else:
-                        cols_out[nm] = np.zeros(
-                            idx.size, dtype=self._np_dtype(t))
+                        cols_out[nm] = np.zeros(idx.size, dtype=dtype_of(t))
                         nulls[nm] = np.ones(idx.size, bool)
             else:
                 # computed outputs over a null side: host closures

@@ -149,7 +149,13 @@ class SiddhiAppRuntime:
         # SiddhiAppParser.java:94-98)
         self._enforce_order = qast.find_annotation(
             app.annotations, "app:enforceOrder") is not None
-        self._order_mutex = None        # set when ordered workers start
+        if self._enforce_order and self._async_workers > 1:
+            # ordered processing is serialized by the runtime lock anyway:
+            # one worker with a FIFO queue gives identical semantics to
+            # N mutex-serialized workers, with none of the deadlock
+            # surface (reference: SiddhiAppParser.java:94-98 restores
+            # ordering over the multi-worker junction)
+            self._async_workers = 1
         if asy is not None:
             if self._async_workers > 1 and not self._enforce_order:
                 import warnings
@@ -334,20 +340,8 @@ class SiddhiAppRuntime:
         # bounded: backpressure (reference buffer.size ring capacity)
         self._ingest_q = _queue.Queue(maxsize=self._async_buffer)
 
-        order = self._enforce_order and self._async_workers > 1
-        if order:
-            # @app:enforceOrder: pop+process is ATOMIC under an order
-            # mutex, so multi-worker scheduling jitter cannot reorder
-            # cross-batch processing (reference: SiddhiAppParser.java:94-98
-            # wraps the multi-worker junction).  Processing is serialized
-            # by the runtime lock anyway; the annotation trades the
-            # residual pop->process race away.
-            self._order_mutex = threading.Lock()
-
         def worker():
             while True:
-                if order:
-                    self._order_mutex.acquire()
                 item = self._ingest_q.get()
                 try:
                     if item is None:
@@ -362,8 +356,6 @@ class SiddhiAppRuntime:
                 except BaseException as e:   # surface at the flush barrier
                     self._ingest_err = e
                 finally:
-                    if order:
-                        self._order_mutex.release()
                     self._ingest_q.task_done()
 
         self._ingest_thread = threading.Thread(
@@ -757,15 +749,6 @@ class SiddhiAppRuntime:
     def _async_barrier(self) -> None:
         import queue as _queue
         owned = getattr(self._lock, "_is_owned", lambda: False)()
-        if owned and getattr(self, "_order_mutex", None) is not None:
-            # @app:enforceOrder: draining the queue inline here would
-            # process batches ahead of one a worker already popped (it is
-            # blocked on the lock we hold) — surface errors and return;
-            # the queued tail flushes, in order, after we release
-            if self._ingest_err is not None:
-                err, self._ingest_err = self._ingest_err, None
-                raise err
-            return
         if owned:
             # the caller holds the runtime lock (query()/snapshot()/
             # set_time() nested flush): the worker can't run, so drain the

@@ -371,7 +371,6 @@ def discover_extensions(force: bool = False) -> list:
     global _discovered, _strict_collisions
     if _discovered and not force:
         return []
-    _discovered = True
     import importlib.metadata as md
     try:
         eps = md.entry_points(group=ENTRY_POINT_GROUP)
@@ -393,6 +392,9 @@ def discover_extensions(force: bool = False) -> list:
             reg()
             _loaded_eps.add(ident)
             loaded.append(ep.name)
+        # only a FULLY successful scan latches: a failing entry point can
+        # be fixed/uninstalled and the next manager retries the rest
+        _discovered = True
     finally:
         _strict_collisions = False
     return loaded

@@ -76,10 +76,24 @@ def test_entry_point_window_resolves_in_siddhiql(tmp_path):
 
 
 def test_namespace_collision_enforced(tmp_path):
-    src = REGISTER_SRC + textwrap.dedent('''
+    """A namespace:name registered twice WITHIN one scan collides (the
+    namespace is distinct from other tests so the collision exercised is
+    the in-scan double registration, not leftover registry state)."""
+    src = textwrap.dedent('''
     def register_dup():
-        register()
-        register()          # same unit:keepLast twice -> collision
+        from siddhi_tpu.extension import ExtensionMeta, Parameter, Example
+        from siddhi_tpu.interp.engine import register_window_type
+        from siddhi_tpu.interp import windows as W
+        meta = ExtensionMeta(
+            name="dupWin", namespace="dupns",
+            description="window registered twice",
+            parameters=(Parameter("n", ("int",), "size"),),
+            examples=(Example("#window.dupns:dupWin(1)", "dup"),))
+        for _ in range(2):
+            register_window_type(
+                "dupWin",
+                lambda args, ctx, schema: W.LengthWindow(1),
+                namespace="dupns", meta=meta)
     ''')
     _make_dist(tmp_path, "sidx_dup", "dup_ext", "sidx_dup:register_dup",
                src)

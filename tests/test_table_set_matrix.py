@@ -1,0 +1,232 @@
+"""Table set-update / update-or-insert / table-join scenario matrix,
+ported (shapes, not code) from the reference suites:
+.../query/table/set/SetUpdateInMemoryTableTestCase.java,
+SetUpdateOrInsertInMemoryTableTestCase.java, and JoinTableTestCase.java
+(VERDICT r4 #6 — joins/tables were the thinnest test axes)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+HEAD = ("define stream StockStream (symbol string, price double, "
+        "volume long);\n"
+        "define stream UpdateStockStream (symbol string, price double, "
+        "volume long);\n"
+        "define table StockTable (symbol string, price double, "
+        "volume long);\n"
+        "from StockStream insert into StockTable;\n")
+
+
+def run(app, stocks, updates, extra_sends=()):
+    m = SiddhiManager()
+    rt = m.create_app_runtime(app)
+    out = []
+    if "outputStream" in app:
+        rt.add_callback("outputStream",
+                        lambda evs: out.extend(tuple(e.data) for e in evs))
+    rt.start()
+    for row in stocks:
+        rt.send("StockStream", row)
+    rt.flush()
+    for row in updates:
+        rt.send("UpdateStockStream", row)
+    rt.flush()
+    for sid, row in extra_sends:
+        rt.send(sid, row)
+    rt.flush()
+    rows = sorted(rt.tables["StockTable"].all_rows())
+    m.shutdown()
+    return rows, out
+
+
+STOCKS = [("WSO2", 55.6, 100), ("IBM", 75.6, 100)]
+
+
+# -- SetUpdateInMemoryTableTestCase shapes --------------------------------
+
+def test_set_update_all_columns():
+    """setUpdate 1: set every column."""
+    app = (HEAD + "from UpdateStockStream update StockTable "
+           "set StockTable.price = price, StockTable.volume = volume "
+           "on StockTable.symbol == symbol;\n")
+    rows, _ = run(app, STOCKS, [("IBM", 100.0, 200)])
+    assert rows == [("IBM", 100.0, 200), ("WSO2", 55.6, 100)]
+
+
+def test_set_update_subset_of_columns():
+    """setUpdate 2: set a subset; untouched columns keep values."""
+    app = (HEAD + "from UpdateStockStream update StockTable "
+           "set StockTable.price = price "
+           "on StockTable.symbol == symbol;\n")
+    rows, _ = run(app, STOCKS, [("IBM", 100.0, 999)])
+    assert rows == [("IBM", 100.0, 100), ("WSO2", 55.6, 100)]
+
+
+def test_set_update_constant_expression():
+    """setUpdate 3: constant assignment."""
+    app = (HEAD + "from UpdateStockStream update StockTable "
+           "set StockTable.price = 0.0 "
+           "on StockTable.symbol == symbol;\n")
+    rows, _ = run(app, STOCKS, [("IBM", 1.0, 0)])
+    assert ("IBM", 0.0, 100) in rows
+
+
+def test_set_update_arithmetic_over_table_value():
+    """setUpdate 4: assignment reading the table's own column."""
+    app = (HEAD + "from UpdateStockStream update StockTable "
+           "set StockTable.price = StockTable.price + 10.0 "
+           "on StockTable.symbol == symbol;\n")
+    rows, _ = run(app, STOCKS, [("IBM", 0.0, 0)])
+    assert any(r[0] == "IBM" and abs(r[1] - 85.6) < 1e-9 for r in rows)
+
+
+def test_set_update_condition_on_non_key():
+    """setUpdate 5: condition over a non-key attribute hits many rows."""
+    app = (HEAD + "from UpdateStockStream update StockTable "
+           "set StockTable.volume = volume "
+           "on StockTable.volume == 100;\n")
+    rows, _ = run(app, STOCKS, [("ANY", 0.0, 7)])
+    assert all(r[2] == 7 for r in rows)
+
+
+def test_set_update_no_match_is_noop():
+    app = (HEAD + "from UpdateStockStream update StockTable "
+           "set StockTable.price = price "
+           "on StockTable.symbol == symbol;\n")
+    rows, _ = run(app, STOCKS, [("GOOG", 1.0, 1)])
+    assert rows == [("IBM", 75.6, 100), ("WSO2", 55.6, 100)]
+
+
+def test_set_update_event_expression():
+    """setUpdate 7: assignment mixing event and table values."""
+    app = (HEAD + "from UpdateStockStream update StockTable "
+           "set StockTable.volume = StockTable.volume + volume "
+           "on StockTable.symbol == symbol;\n")
+    rows, _ = run(app, STOCKS, [("WSO2", 0.0, 11)])
+    assert ("WSO2", 55.6, 111) in rows
+
+
+# -- SetUpdateOrInsertInMemoryTableTestCase shapes ------------------------
+
+def test_set_update_or_insert_updates_existing():
+    app = (HEAD + "from UpdateStockStream update or insert into StockTable "
+           "set StockTable.price = price "
+           "on StockTable.symbol == symbol;\n")
+    rows, _ = run(app, STOCKS, [("IBM", 200.0, 0)])
+    assert ("IBM", 200.0, 100) in rows and len(rows) == 2
+
+
+def test_set_update_or_insert_inserts_missing():
+    app = (HEAD + "from UpdateStockStream update or insert into StockTable "
+           "set StockTable.price = price "
+           "on StockTable.symbol == symbol;\n")
+    rows, _ = run(app, STOCKS, [("GOOG", 10.0, 5)])
+    assert ("GOOG", 10.0, 5) in rows and len(rows) == 3
+
+
+def test_set_update_or_insert_subset_insert_carries_event_row():
+    """The inserted row is the arriving event, not just the set columns."""
+    app = (HEAD + "from UpdateStockStream update or insert into StockTable "
+           "set StockTable.volume = 1 "
+           "on StockTable.symbol == symbol;\n")
+    rows, _ = run(app, STOCKS, [("MSFT", 9.0, 500)])
+    assert ("MSFT", 9.0, 500) in rows           # inserted as sent
+    rows2, _ = run(app, STOCKS, [("WSO2", 0.0, 500)])
+    assert ("WSO2", 55.6, 1) in rows2           # updated via set only
+
+
+# -- JoinTableTestCase shapes ---------------------------------------------
+
+def test_table_join_basic():
+    app = (HEAD +
+           "define stream CheckStream (symbol string);\n"
+           "@info(name='q') from CheckStream join StockTable "
+           "on CheckStream.symbol == StockTable.symbol "
+           "select CheckStream.symbol as symbol, StockTable.price as price "
+           "insert into outputStream;\n")
+    _rows, out = run(app, STOCKS, [],
+                     extra_sends=[("CheckStream", ("WSO2",))])
+    assert out == [("WSO2", 55.6)]
+
+
+def test_table_join_no_match_inner_silent():
+    app = (HEAD +
+           "define stream CheckStream (symbol string);\n"
+           "@info(name='q') from CheckStream join StockTable "
+           "on CheckStream.symbol == StockTable.symbol "
+           "select CheckStream.symbol as symbol, StockTable.price as price "
+           "insert into outputStream;\n")
+    _rows, out = run(app, STOCKS, [],
+                     extra_sends=[("CheckStream", ("GOOG",))])
+    assert out == []
+
+
+def test_table_join_left_outer_emits_nulls():
+    """JoinTableTestCase left-outer shape: stream side emits with nulls."""
+    app = (HEAD +
+           "define stream CheckStream (symbol string);\n"
+           "@info(name='q') from CheckStream left outer join StockTable "
+           "on CheckStream.symbol == StockTable.symbol "
+           "select CheckStream.symbol as symbol, StockTable.price as price "
+           "insert into outputStream;\n")
+    _rows, out = run(app, STOCKS, [],
+                     extra_sends=[("CheckStream", ("GOOG",))])
+    assert out == [("GOOG", None)]
+
+
+def test_table_join_count_aggregation():
+    """joinTest (dataTable count): count() over table join."""
+    app = (HEAD +
+           "define stream CountIn (symbol string);\n"
+           "@info(name='q') from CountIn join StockTable "
+           "on StockTable.volume == 100 "
+           "select count() as c insert into outputStream;\n")
+    _rows, out = run(app, STOCKS, [],
+                     extra_sends=[("CountIn", ("x",))])
+    # running count per joined row; the final value covers both rows
+    # (the reference asserts the last received event's count == 2)
+    assert out[-1] == (2,)
+
+
+def test_table_join_condition_on_table_attr():
+    app = (HEAD +
+           "define stream CheckStream (limitp double);\n"
+           "@info(name='q') from CheckStream join StockTable "
+           "on StockTable.price > CheckStream.limitp "
+           "select StockTable.symbol as symbol insert into outputStream;\n")
+    _rows, out = run(app, STOCKS, [],
+                     extra_sends=[("CheckStream", (60.0,))])
+    assert out == [("IBM",)]
+
+
+def test_table_join_unidirectional_implicit():
+    """A table never triggers: only stream arrivals emit."""
+    app = (HEAD +
+           "define stream CheckStream (symbol string);\n"
+           "@info(name='q') from StockTable join CheckStream "
+           "on CheckStream.symbol == StockTable.symbol "
+           "select StockTable.price as price insert into outputStream;\n")
+    _rows, out = run(app, STOCKS, [],
+                     extra_sends=[("CheckStream", ("IBM",))])
+    assert out == [(75.6,)]
+
+
+# -- delete + update interplay (UpdateFromTableTestCase flavor) -----------
+
+def test_update_then_delete_sequence():
+    app = (HEAD +
+           "define stream DeleteStream (symbol string);\n"
+           "from UpdateStockStream update StockTable "
+           "set StockTable.price = price on StockTable.symbol == symbol;\n"
+           "from DeleteStream delete StockTable "
+           "on StockTable.symbol == symbol;\n")
+    m = SiddhiManager()
+    rt = m.create_app_runtime(app)
+    rt.start()
+    for row in STOCKS:
+        rt.send("StockStream", row)
+    rt.send("UpdateStockStream", ("IBM", 1.0, 0))
+    rt.send("DeleteStream", ("WSO2",))
+    rt.flush()
+    rows = sorted(rt.tables["StockTable"].all_rows())
+    m.shutdown()
+    assert rows == [("IBM", 1.0, 100)]
