@@ -668,8 +668,7 @@ class DeviceJoinPlan(QueryPlan):
                         enc = self.rt.strings.encode
                         filled = [v if isinstance(v, (int, np.integer))
                                   else enc(v) for v in filled]
-                    cols_out[nm] = np.asarray(
-                        filled, dtype=self._np_dtype(t))
+                    cols_out[nm] = np.asarray(filled, dtype=dtype_of(t))
                     if isnull.any():
                         nulls[nm] = isnull
             segs.append((p_seq[idx], np.full(idx.size, side_rank, np.int8),

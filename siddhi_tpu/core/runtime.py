@@ -749,6 +749,18 @@ class SiddhiAppRuntime:
     def _async_barrier(self) -> None:
         import queue as _queue
         owned = getattr(self._lock, "_is_owned", lambda: False)()
+        if owned and self._enforce_order:
+            # @app:enforceOrder: the (single) worker may have POPPED a
+            # batch and be blocked on the lock we hold — draining the
+            # queue or builders inline would process newer batches first.
+            # Surface latched errors and return: the nested reader sees
+            # state as-of now; the queued tail flushes, in order, after
+            # we release (concurrent ingest has no defined serialization
+            # against a nested query/snapshot anyway).
+            if self._ingest_err is not None:
+                err, self._ingest_err = self._ingest_err, None
+                raise err
+            return
         if owned:
             # the caller holds the runtime lock (query()/snapshot()/
             # set_time() nested flush): the worker can't run, so drain the

@@ -144,6 +144,21 @@ def _seg_window_sum(seg, v, left, gpos, n):
     return incl - _seg_prefix_before(ks, segpfx, seg, left, n)
 
 
+def _seg_window_minmax(seg, v, left, gpos, n, is_max):
+    """Per-entry min/max over its segment's members in positions
+    [left, gpos]: one sort by (segment, position) + a log2 sparse table +
+    two searchsorted bound lookups (the grouped analog of the ungrouped
+    range-reduce; v must carry the neutral at invalid entries)."""
+    key = seg * n + jnp.arange(n, dtype=jnp.int64)
+    order = jnp.argsort(key)
+    ks = key[order]
+    vs = v[order]
+    table = _sparse_table(vs, is_max)
+    l = jnp.searchsorted(ks, seg * n + left)
+    r = jnp.searchsorted(ks, seg * n + gpos)
+    return _range_reduce(table, jnp.minimum(l, r), r, is_max)
+
+
 def _seg_running_sum(seg, v, n):
     ks, segpfx = _segmented_prefix(seg, v)
     return _seg_prefix_at(ks, segpfx, seg, jnp.arange(n, dtype=jnp.int64), n)
@@ -220,9 +235,8 @@ class DeviceWindowAggPlan(QueryPlan):
         if getattr(q.output, "events_for", ast.OutputEventsFor.CURRENT) \
                 != ast.OutputEventsFor.CURRENT:
             raise DeviceWindowUnsupported("expired-events output")
-        if q.selector.order_by or q.selector.limit is not None \
-                or q.selector.offset:
-            raise DeviceWindowUnsupported("order-by/limit")
+        self._order_by = list(q.selector.order_by)
+        self.limit, self.offset = q.selector.limit, q.selector.offset
         if any(isinstance(h, ast.StreamFunction) for h in inp.handlers):
             raise DeviceWindowUnsupported("stream functions")
         if inp.stream_id in rt.named_windows:
@@ -250,6 +264,7 @@ class DeviceWindowAggPlan(QueryPlan):
                 return a.value
             raise DeviceWindowUnsupported("non-constant window arg")
 
+        self._ext_ts_attr = None
         if wname == "length":
             self.kind = "length"
             self.L = int(_const(0))
@@ -259,6 +274,26 @@ class DeviceWindowAggPlan(QueryPlan):
         elif wname == "time":
             self.kind = "time"
             self.D = int(_const(0))
+            self.C = self.C_START
+        elif wname == "externaltime":
+            # sliding event-time window: same closed-form range reduction
+            # as `time`, with the window clock read from the declared
+            # timestamp ATTRIBUTE instead of arrival time — no scheduler
+            # at all (reference: ExternalTimeWindowProcessor.java expires
+            # purely on arriving timestamps; meaningful expiry assumes
+            # non-decreasing event time, as in the reference)
+            self.kind = "time"
+            var = wh.args[0]
+            if not isinstance(var, ast.Variable):
+                raise DeviceWindowUnsupported(
+                    "externalTime timestamp must be an attribute")
+            at = schema.type_of(var.attribute) \
+                if var.attribute in schema.types else None
+            if at not in (AttrType.INT, AttrType.LONG):
+                raise DeviceWindowUnsupported(
+                    "externalTime timestamp attribute must be int/long")
+            self._ext_ts_attr = var.attribute
+            self.D = int(_const(1))
             self.C = self.C_START
         elif wname == "lengthbatch":
             self.kind = "lengthbatch"
@@ -314,9 +349,6 @@ class DeviceWindowAggPlan(QueryPlan):
             for s, arg_ast in zip(raw_sites, site_args):
                 if s.name not in _INCR:
                     raise DeviceWindowUnsupported(f"aggregator {s.name}()")
-                if s.name in ("min", "max") and self.group_keys \
-                        and self.kind != "lengthbatch":
-                    raise DeviceWindowUnsupported("grouped sliding min/max")
                 arg_ce = (compile_expression(arg_ast, ctx)
                           if arg_ast is not None else None)
                 # strings are dictionary codes on device: min()/max() would
@@ -353,6 +385,10 @@ class DeviceWindowAggPlan(QueryPlan):
             raise DeviceWindowUnsupported(str(e))
 
         self._out_names = names
+        for ob in self._order_by:
+            if ob.var.attribute not in names:
+                raise DeviceWindowUnsupported(
+                    f"order by {ob.var.attribute!r}: not an output column")
         self.out_schema = StreamSchema(target or f"#{name}", tuple(
             ast.Attribute(n, t) for n, t in zip(names, types)))
 
@@ -374,8 +410,12 @@ class DeviceWindowAggPlan(QueryPlan):
         # the ts upload unless some expression reads __timestamp__.
         # lengthBatch still needs it — its non-slim output rows carry
         # device-side timestamps for events carried from prior batches.
-        self._needs_ts = (self.kind != "length"
+        # externalTime reads its clock from an uploaded event COLUMN.
+        self._needs_ts = ((self.kind != "length"
+                           and self._ext_ts_attr is None)
                           or "__timestamp__" in reads)
+        if self._ext_ts_attr is not None:
+            reads.add(self._ext_ts_attr)
         reads.discard("__timestamp__")
         unknown = [k for k in reads
                    if k not in schema.types and not k.startswith("__agg")]
@@ -470,6 +510,7 @@ class DeviceWindowAggPlan(QueryPlan):
         having = self.having
         carry_cols = self._carry_cols()
         cols = self.cols
+        ext_ts = self._ext_ts_attr
         L = getattr(self, "L", 0)
         D = getattr(self, "D", 0)
         N = C + T
@@ -554,6 +595,10 @@ class DeviceWindowAggPlan(QueryPlan):
                 if nm in ("min", "max"):
                     neutral = NEG if nm == "max" else POS
                     vv = jnp.where(all_valid, vals[i], neutral)
+                    if group_keys:
+                        aggs_full.append(_seg_window_minmax(
+                            seg, vv, left, gpos, N, nm == "max"))
+                        continue
                     table = _sparse_table(vv, nm == "max")
                     aggs_full.append(_range_reduce(
                         table, jnp.minimum(left, gpos), gpos, nm == "max"))
@@ -657,8 +702,11 @@ class DeviceWindowAggPlan(QueryPlan):
                 # and validity as a prefix count — 5 fewer upload bytes
                 # per event through the tunnel than i64 ts + bool valid;
                 # length kinds with no ts-reading expression skip ts
-                # upload altogether (position-bounded, not time-bounded)
-                if "__ts_off__" in env:
+                # upload altogether (position-bounded, not time-bounded);
+                # externalTime's window clock is the declared event column
+                if ext_ts is not None:
+                    ts64 = env[ext_ts].astype(jnp.int64)
+                elif "__ts_off__" in env:
                     ts64 = env["__ts_base__"] \
                         + env["__ts_off__"].astype(jnp.int64)
                 else:
@@ -881,8 +929,43 @@ class DeviceWindowAggPlan(QueryPlan):
             if a.type == AttrType.BOOL:
                 v = v != 0
             cols[a.name] = v.astype(dtype_of(a.type))
+        ts_out, cols = self._order_limit(ts_out, cols)
         out = EventBatch(self.out_schema, ts_out, cols, len(ts_out))
         return [OutputBatch(self.output_target, out)]
+
+    def _order_limit(self, ts_out, cols):
+        """order-by / offset / limit over one output chunk, host-side
+        (device rows are already materialized columns; stable multi-key
+        sort mirrors the interp selector's order_limit)."""
+        if not (self._order_by or self.limit is not None or self.offset):
+            return ts_out, cols
+        n = len(ts_out)
+        order = np.arange(n)
+        for ob in reversed(self._order_by):
+            col = cols[ob.var.attribute]
+            if self.out_schema.type_of(ob.var.attribute) == AttrType.STRING \
+                    and col.dtype.kind in "iu":
+                dec = self.rt.strings._to_str
+                col = np.array([dec[c] if 0 <= c < len(dec) else ""
+                                for c in col.tolist()])
+            # rank-inversion covers every dtype exactly (bool, i64 > 2^53,
+            # strings lexicographically) and DESC is integer negation of
+            # small ranks — no float round-trip (review r5)
+            _u, ranks = np.unique(col, return_inverse=True)
+            k = ranks[order].astype(np.int64)
+            if ob.order == ast.OrderDir.DESC:
+                k = -k
+            order = order[np.argsort(k, kind="stable")]
+        ts_out = ts_out[order]
+        cols = {k2: v[order] for k2, v in cols.items()}
+        off = self.offset or 0
+        if off:
+            ts_out = ts_out[off:]
+            cols = {k2: v[off:] for k2, v in cols.items()}
+        if self.limit is not None:
+            ts_out = ts_out[:self.limit]
+            cols = {k2: v[:self.limit] for k2, v in cols.items()}
+        return ts_out, cols
 
     # -- snapshot -------------------------------------------------------------
 

@@ -171,3 +171,90 @@ def test_f64_all_double_outputs():
         assert d[0] == h[0]
         for a, b in zip(d[1], h[1]):
             assert b == pytest.approx(a, rel=1e-9)
+
+
+# -- r5 widening: grouped sliding min/max, externalTime, order-by/limit ---
+
+@pytest.mark.parametrize("q", [
+    "from S#window.length(9) select sym, min(p) as lo, max(p) as hi "
+    "group by sym insert into O;",
+    "from S#window.length(4) select sym, max(p) as hi, sum(v) as sv "
+    "group by sym having hi > 50.0 insert into O;",
+    "from S#window.time(800) select sym, min(p) as lo group by sym "
+    "insert into O;",
+])
+def test_grouped_sliding_minmax(q):
+    differential(q, gen_rows(160, seed=31), seed=31)
+
+
+def test_grouped_sliding_minmax_device_engaged():
+    m = SiddhiManager()
+    rt = m.create_app_runtime(
+        "@app:deviceWindows('always')\n"
+        "define stream S (sym string, p double, v long);\n"
+        "from S#window.length(5) select sym, min(p) as lo group by sym "
+        "insert into O;")
+    assert any(isinstance(p, DeviceWindowAggPlan) for p in rt._plans)
+    m.shutdown()
+
+
+def test_external_time_differential():
+    """externalTime(et, D): window clock from an event attribute."""
+    head = ("@app:playback define stream S (sym string, p double, "
+            "v long, et long);\n")
+    q = ("from S#window.externalTime(et, 700) select sym, avg(p) as ap, "
+         "count() as c group by sym insert into O;")
+    r = random.Random(41)
+    ts, et = 1000, 50_000
+    rows = []
+    for _ in range(150):
+        ts += r.randint(1, 50)
+        et += r.randint(0, 300)
+        rows.append((ts, (f"s{r.randint(0, 2)}",
+                          round(r.uniform(0, 90), 2), r.randint(1, 9), et)))
+    dev_app = "@app:deviceWindows('always')\n" + head + q
+    host_app = "@app:deviceWindows('never')\n" + head + q
+    dev = run_app(dev_app, rows, rng=random.Random(5))
+    host = run_app(host_app, rows, rng=random.Random(5))
+    assert len(dev) == len(host), (len(dev), len(host))
+    for d, h in zip(dev, host):
+        assert d[0] == h[0], (d, h)
+        for a, b in zip(d[1], h[1]):
+            if isinstance(a, float):
+                assert b == pytest.approx(a, rel=2e-5, abs=2e-4), (d, h)
+            else:
+                assert a == b, (d, h)
+
+
+def test_external_time_device_engaged():
+    m = SiddhiManager()
+    rt = m.create_app_runtime(
+        "@app:deviceWindows('always')\n"
+        "define stream S (sym string, p double, et long);\n"
+        "from S#window.externalTime(et, 500) select sum(p) as s "
+        "insert into O;")
+    assert any(isinstance(p, DeviceWindowAggPlan) for p in rt._plans)
+    m.shutdown()
+
+
+@pytest.mark.parametrize("q", [
+    "from S#window.length(6) select sym, sum(p) as s group by sym "
+    "order by s insert into O;",
+    "from S#window.length(6) select sym, sum(p) as s group by sym "
+    "order by s desc limit 2 insert into O;",
+    "from S#window.lengthBatch(8) select sym, count() as c group by sym "
+    "order by sym limit 2 offset 1 insert into O;",
+])
+def test_order_by_limit_on_device_outputs(q):
+    differential(q, gen_rows(120, seed=51), seed=51)
+
+
+def test_order_by_device_engaged():
+    m = SiddhiManager()
+    rt = m.create_app_runtime(
+        "@app:deviceWindows('always')\n"
+        "define stream S (sym string, p double, v long);\n"
+        "from S#window.length(5) select sym, sum(p) as s group by sym "
+        "order by s desc limit 3 insert into O;")
+    assert any(isinstance(p, DeviceWindowAggPlan) for p in rt._plans)
+    m.shutdown()
