@@ -624,11 +624,17 @@ class DevicePatternPlan(QueryPlan):
             ends = ends[ends > 0]
             to = np.searchsorted(ts_mono, ts_mono[ends - 1] + W, side="right")
             return CS, int(np.max(to - ends))
-        K = min(int(cfg["lanes"]), max(1, N))
+        # K rides pow2 buckets: latency-capped ingest produces VARIABLE
+        # small flushes, and every distinct K is a fresh kernel compile
+        # (~10 s through the tunnel); empty lanes are free
+        K = min(int(cfg["lanes"]), pow2_at_least(max(1, N), lo=8))
         CS, H = _halo(K)
         if CS < H:
-            K = pow2_at_least(max(1, N // max(H, 1)), lo=1)
-            K = min(K, int(cfg["lanes"]))
+            # halo-dominated: fewer, longer chunks (lo=8 keeps the K
+            # bucket set tiny — empty lanes are free, fresh compiles
+            # through the tunnel are not)
+            K = min(int(cfg["lanes"]),
+                    pow2_at_least(max(1, N // max(H, 1)), lo=8))
             CS, H = _halo(K)
         if self.mesh is not None:
             # lane axis shards over the mesh: K must divide evenly over
@@ -637,7 +643,7 @@ class DevicePatternPlan(QueryPlan):
             if K % nd:
                 K = -(-K // nd) * nd
                 CS, H = _halo(K)
-        T = pow2_at_least(CS + H)
+        T = pow2_at_least(CS + H, lo=64)
 
         # fresh i32 bases every flush (no persistent device state)
         ts_base = int(ts_mono[0])

@@ -411,6 +411,12 @@ class DeviceWindowAggPlan(QueryPlan):
         # lengthBatch still needs it — its non-slim output rows carry
         # device-side timestamps for events carried from prior batches.
         # externalTime reads its clock from an uploaded event COLUMN.
+        if self._ext_ts_attr is not None and "__timestamp__" in reads:
+            # the external column drives the window CLOCK; expressions
+            # reading __timestamp__ must see the ARRIVAL time (host
+            # parity) — carrying both per event isn't worth it
+            raise DeviceWindowUnsupported(
+                "externalTime with __timestamp__-reading expressions")
         self._needs_ts = ((self.kind != "length"
                            and self._ext_ts_attr is None)
                           or "__timestamp__" in reads)
