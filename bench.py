@@ -723,7 +723,7 @@ def main():
     head = ("@app:partitionCapacity(1000)\n@app:deviceSlots(32)\n")
     configs["4_partitioned_1k"] = bench_config(
         "partitioned", head + C4, HOST["patterns"] + C4,
-        n=2 << 18, batch=1 << 18, keys=1000, latency=True)
+        n=2 << 18, batch=1 << 18, keys=1000, latency=True, repeats=5)
     configs["4_partitioned_1k"]["kernel_eps"] = kernel_eps(
         head + C4, "pattern", batch=1 << 18, keys=1000)
 

@@ -23,6 +23,24 @@ core:SiddhiAppRuntime.java:93):
     rt.flush()          # drain micro-batch through the compiled kernels
 """
 
+import os as _os
+
+# Persistent kernel cache: query plans jit-compile sizeable XLA programs
+# (~10 s each through a tunneled TPU); caching compiled executables on
+# disk makes every later runtime (or process) that builds the same query
+# shape start warm.  Set SIDDHI_JAX_CACHE=off to disable, or to a path
+# to relocate (default ~/.cache/siddhi_tpu/jax).
+_cache = _os.environ.get("SIDDHI_JAX_CACHE", "")
+if _cache.lower() != "off":
+    try:
+        import jax as _jax
+        _dir = _cache or _os.path.join(
+            _os.path.expanduser("~"), ".cache", "siddhi_tpu", "jax")
+        _os.makedirs(_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _dir)
+    except Exception:       # pragma: no cover - cache is best-effort
+        pass
+
 from .query import ast, parse, parse_expression, parse_query, parse_store_query
 from .core.runtime import SiddhiAppRuntime, SiddhiManager
 from .core.schema import StreamSchema
