@@ -25,20 +25,30 @@ core:SiddhiAppRuntime.java:93):
 
 import os as _os
 
-# Persistent kernel cache: query plans jit-compile sizeable XLA programs
-# (~10 s each through a tunneled TPU); caching compiled executables on
-# disk makes every later runtime (or process) that builds the same query
-# shape start warm.  Set SIDDHI_JAX_CACHE=off to disable, or to a path
-# to relocate (default ~/.cache/siddhi_tpu/jax).
-_cache = _os.environ.get("SIDDHI_JAX_CACHE", "")
-if _cache.lower() != "off":
+
+def _enable_kernel_cache() -> None:
+    """Persistent kernel cache: query plans jit-compile sizeable XLA
+    programs (~10 s each through a tunneled TPU); caching compiled
+    executables on disk makes every later runtime (or process) building
+    the same query shape start warm.  The directory is keyed by backend
+    platform — artifacts AOT-compiled under one backend's flag set must
+    not load under another's.  Set SIDDHI_JAX_CACHE=off to disable, or
+    to a path to relocate (default ~/.cache/siddhi_tpu/jax-<platform>).
+    Called lazily at SiddhiManager creation (the backend is decided by
+    then)."""
+    cache = _os.environ.get("SIDDHI_JAX_CACHE", "")
+    if cache.lower() == "off":
+        return
     try:
-        import jax as _jax
-        _dir = _cache or _os.path.join(
-            _os.path.expanduser("~"), ".cache", "siddhi_tpu", "jax")
-        _os.makedirs(_dir, exist_ok=True)
-        _jax.config.update("jax_compilation_cache_dir", _dir)
-    except Exception:       # pragma: no cover - cache is best-effort
+        import jax
+        if jax.config.jax_compilation_cache_dir:
+            return              # already configured (by us or the user)
+        d = cache or _os.path.join(
+            _os.path.expanduser("~"), ".cache", "siddhi_tpu",
+            f"jax-{jax.default_backend()}")
+        _os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+    except Exception:           # pragma: no cover - cache is best-effort
         pass
 
 from .query import ast, parse, parse_expression, parse_query, parse_store_query

@@ -1103,6 +1103,9 @@ class SiddhiManager:
     def __init__(self, isolated_broker: bool = False,
                  allow_scripts: bool = True):
         self.allow_scripts = allow_scripts
+        # persistent XLA kernel cache (backend-keyed dir; best-effort)
+        from .. import _enable_kernel_cache
+        _enable_kernel_cache()
         # entry-point extension discovery (once per process; reference:
         # SiddhiExtensionLoader scans the classpath at manager creation)
         from ..extension import discover_extensions

@@ -295,6 +295,28 @@ class DeviceWindowAggPlan(QueryPlan):
             self._ext_ts_attr = var.attribute
             self.D = int(_const(1))
             self.C = self.C_START
+        elif wname == "externaltimebatch":
+            # tumbling over an event-time attribute: lengthBatch's
+            # segmented-scan machinery with ts-derived bucket ids
+            # (reference: ExternalTimeBatchWindowProcessor.java:520 —
+            # bucket boundaries at start + k*duration, flushed when an
+            # arriving timestamp crosses the boundary)
+            self.kind = "externaltimebatch"
+            var = wh.args[0]
+            if not isinstance(var, ast.Variable):
+                raise DeviceWindowUnsupported(
+                    "externalTimeBatch timestamp must be an attribute")
+            at = schema.type_of(var.attribute) \
+                if var.attribute in schema.types else None
+            if at not in (AttrType.INT, AttrType.LONG):
+                raise DeviceWindowUnsupported(
+                    "externalTimeBatch timestamp attribute must be int/long")
+            if len(wh.args) > 2:
+                raise DeviceWindowUnsupported(
+                    "externalTimeBatch start-time/timeout args")
+            self._ext_ts_attr = var.attribute
+            self.D = int(_const(1))
+            self.C = self.C_START
         elif wname == "lengthbatch":
             self.kind = "lengthbatch"
             self.L = int(_const(0))
@@ -411,14 +433,18 @@ class DeviceWindowAggPlan(QueryPlan):
         # lengthBatch still needs it — its non-slim output rows carry
         # device-side timestamps for events carried from prior batches.
         # externalTime reads its clock from an uploaded event COLUMN.
-        if self._ext_ts_attr is not None and "__timestamp__" in reads:
-            # the external column drives the window CLOCK; expressions
-            # reading __timestamp__ must see the ARRIVAL time (host
-            # parity) — carrying both per event isn't worth it
+        if self._ext_ts_attr is not None and self.kind == "time" \
+                and "__timestamp__" in reads:
+            # sliding externalTime: the external column drives the window
+            # CLOCK; expressions reading __timestamp__ must see the
+            # ARRIVAL time (host parity) — carrying both per event isn't
+            # worth it.  (externalTimeBatch carries arrival ts anyway for
+            # its non-slim row stamps, so both are available there.)
             raise DeviceWindowUnsupported(
                 "externalTime with __timestamp__-reading expressions")
-        self._needs_ts = ((self.kind != "length"
-                           and self._ext_ts_attr is None)
+        self._needs_ts = ((self.kind == "externaltimebatch")
+                          or (self.kind != "length"
+                              and self._ext_ts_attr is None)
                           or "__timestamp__" in reads)
         if self._ext_ts_attr is not None:
             reads.add(self._ext_ts_attr)
@@ -448,7 +474,7 @@ class DeviceWindowAggPlan(QueryPlan):
 
     def _carry_cols(self) -> list:
         """Event columns that must ride in the carry buffer."""
-        if self.kind == "lengthbatch":
+        if self.kind in ("lengthbatch", "externaltimebatch"):
             return list(self.cols)      # rows emit later: full env needed
         need = set(self.group_keys)
         for _nm, arg, _t in self.sites:
@@ -456,11 +482,15 @@ class DeviceWindowAggPlan(QueryPlan):
                 need |= set(arg.reads) & set(self.in_schema.types)
         return sorted(need)
 
+    EXT_START_SENTINEL = -(2 ** 62)
+
     def _init_state(self) -> dict:
         C = self.C
         st = {"ts": jnp.full(C, -_TS_PAD),
               "valid": jnp.zeros(C, dtype=bool),
               "seen": jnp.int64(0)}
+        if self.kind == "externaltimebatch":
+            st["start"] = jnp.int64(self.EXT_START_SENTINEL)
         for k in self._carry_cols():
             with compute_dtypes(self._mode):
                 st[f"c.{k}"] = jnp.zeros(
@@ -696,6 +726,68 @@ class DeviceWindowAggPlan(QueryPlan):
                 nst[f"c.{c}"] = sl(env_all[c])
             return nst, outs, row_ok, row_ts, jnp.int32(0)
 
+        def step_extbatch(state, bts, bvalid, bcols, k):
+            """externalTimeBatch: lengthBatch's per-bucket segmented scans
+            with bucket ids (ets - start) // D; completed buckets (any
+            later-bucket event arrived) emit, the current bucket's raw
+            events carry.  Assumes nondecreasing event time, as the
+            reference does."""
+            SENT = jnp.int64(DeviceWindowAggPlan.EXT_START_SENTINEL)
+            all_ts = jnp.concatenate([state["ts"], bts])      # arrival
+            all_valid = jnp.concatenate([state["valid"], bvalid])
+            env_all = {c: jnp.concatenate([state[f"c.{c}"], bcols[c]])
+                       for c in carry_cols}
+            env_all["__timestamp__"] = all_ts
+            ets = env_all[ext_ts].astype(jnp.int64)
+            idx0 = jnp.argmax(all_valid)          # first valid entry
+            first_e = ets[idx0]
+            start = jnp.where(state["start"] == SENT, first_e,
+                              state["start"])
+            Dj = jnp.int64(D)
+            b = jnp.where(all_valid, (ets - start) // Dj, jnp.int64(-1))
+            bfirst = b[idx0]
+            brel = jnp.where(all_valid, b - bfirst, jnp.int64(-1))
+            blast = jnp.max(b)                    # monotone ts: current
+            if group_keys:
+                seg = group_seg(env_all, all_valid, N)
+                segb = jnp.where(all_valid, brel * (N + 1) + seg,
+                                 jnp.int64((N + 2) * (N + 1)))
+            else:
+                segb = None
+            vals = site_vals(env_all, N)
+            rsum = ((lambda s_, v_: _mono_running_sum(s_, v_))
+                    if not group_keys else
+                    (lambda s_, v_: _seg_running_sum(s_, v_, N)))
+            rmm = ((lambda s_, v_, mx: _mono_running_minmax(s_, v_, mx))
+                   if not group_keys else
+                   (lambda s_, v_, mx: _seg_running_minmax(s_, v_, mx, N)))
+            segk = brel if not group_keys else segb
+            aggs = []
+            for i, (nm, _arg, _ot) in enumerate(sites):
+                if nm in ("min", "max"):
+                    neutral = NEG if nm == "max" else POS
+                    vv = jnp.where(all_valid, vals[i], neutral)
+                    aggs.append(rmm(segk, vv, nm == "max"))
+                else:
+                    v = (all_valid.astype(FDT) if nm == "count"
+                         else jnp.where(all_valid, vals[i], 0.0))
+                    s = rsum(segk, v)
+                    if nm == "avg":
+                        s = s / jnp.maximum(rsum(segk, all_valid.astype(FDT)),
+                                            1.0)
+                    aggs.append(s)
+            emit = all_valid & (b < blast)
+            outs, row_ok = finish(env_all, aggs, emit)
+            row_ts = all_ts
+            pend = all_valid & (b == blast)
+            sl = lambda a: jax.lax.dynamic_slice(a, (k,), (C,))
+            nst = {"seen": state["seen"] + k, "ts": sl(all_ts),
+                   "valid": sl(pend), "start": start}
+            for c in carry_cols:
+                nst[f"c.{c}"] = sl(env_all[c])
+            overflow = (jnp.sum(pend) > C).astype(jnp.int32)
+            return nst, outs, row_ok, row_ts, overflow
+
         def compact(mask, arr, fill):
             pos = jnp.cumsum(mask.astype(jnp.int32), dtype=jnp.int32) - mask
             wpos = jnp.where(mask, pos, T)
@@ -709,8 +801,11 @@ class DeviceWindowAggPlan(QueryPlan):
                 # per event through the tunnel than i64 ts + bool valid;
                 # length kinds with no ts-reading expression skip ts
                 # upload altogether (position-bounded, not time-bounded);
-                # externalTime's window clock is the declared event column
-                if ext_ts is not None:
+                # sliding externalTime's window clock is the declared
+                # event column (externalTimeBatch keeps ARRIVAL time here
+                # for its row stamps; its bucket ids read the column
+                # inside step_extbatch)
+                if ext_ts is not None and kind == "time":
                     ts64 = env[ext_ts].astype(jnp.int64)
                 elif "__ts_off__" in env:
                     ts64 = env["__ts_base__"] \
@@ -731,6 +826,8 @@ class DeviceWindowAggPlan(QueryPlan):
                 bcols = {c: compact(mask, env[c], 0) for c in cols}
                 if kind == "lengthbatch":
                     res = step_lengthbatch(state, bts, bvalid, bcols, k)
+                elif kind == "externaltimebatch":
+                    res = step_extbatch(state, bts, bvalid, bcols, k)
                 else:
                     res = step_sliding(state, bts, bvalid, bcols, k)
                 return pack(res, mask, k)
@@ -746,7 +843,7 @@ class DeviceWindowAggPlan(QueryPlan):
                 .sum(axis=1).astype(jnp.uint32)   # sum may promote to u64
             return jax.lax.bitcast_convert_type(w, jnp.int32)
 
-        slim = kind != "lengthbatch"
+        slim = kind not in ("lengthbatch", "externaltimebatch")
         has_filter = filt is not None
 
         def pack(res, mask, k):
@@ -864,7 +961,7 @@ class DeviceWindowAggPlan(QueryPlan):
         return {"pre": pre, "env": env, "batch": batch, "T": T, "res": res}
 
     def _materialize(self, entry: dict) -> list:
-        slim = self.kind != "lengthbatch"
+        slim = self.kind not in ("lengthbatch", "externaltimebatch")
         bpack = None
         while True:
             res = entry["res"]

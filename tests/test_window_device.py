@@ -258,3 +258,61 @@ def test_order_by_device_engaged():
         "order by s desc limit 3 insert into O;")
     assert any(isinstance(p, DeviceWindowAggPlan) for p in rt._plans)
     m.shutdown()
+
+
+def _differential_et(q, rows, seed):
+    head = ("@app:playback define stream S (sym string, p double, "
+            "v long, et long);\n")
+    dev = run_app("@app:deviceWindows('always')\n" + head + q, rows,
+                  rng=random.Random(seed))
+    host = run_app("@app:deviceWindows('never')\n" + head + q, rows,
+                   rng=random.Random(seed))
+    assert len(dev) == len(host), (len(dev), len(host), dev[:3], host[:3])
+    for d, h in zip(dev, host):
+        assert d[0] == h[0], (d, h)
+        for a, b in zip(d[1], h[1]):
+            if isinstance(a, float):
+                assert b == pytest.approx(a, rel=2e-5, abs=2e-4), (d, h)
+            else:
+                assert a == b, (d, h)
+
+
+def _et_rows(n, seed, gap=300):
+    r = random.Random(seed)
+    ts, et = 1000, 50_000
+    rows = []
+    for _ in range(n):
+        ts += r.randint(1, 50)
+        et += r.randint(0, gap)
+        rows.append((ts, (f"s{r.randint(0, 2)}",
+                          round(r.uniform(0, 90), 2), r.randint(1, 9), et)))
+    return rows
+
+
+@pytest.mark.parametrize("q", [
+    "from S#window.externalTimeBatch(et, 700) select sum(p) as s, "
+    "count() as c insert into O;",
+    "from S#window.externalTimeBatch(et, 900) select sym, max(p) as hi, "
+    "avg(v) as av group by sym insert into O;",
+])
+def test_external_time_batch_differential(q):
+    _differential_et(q, _et_rows(150, 61), 61)
+
+
+def test_external_time_batch_sparse_buckets():
+    """Empty buckets between events emit nothing (the reference advances
+    start through them silently)."""
+    _differential_et(
+        "from S#window.externalTimeBatch(et, 200) select count() as c "
+        "insert into O;", _et_rows(80, 62, gap=1500), 62)
+
+
+def test_external_time_batch_device_engaged():
+    m = SiddhiManager()
+    rt = m.create_app_runtime(
+        "@app:deviceWindows('always')\n"
+        "define stream S (sym string, p double, et long);\n"
+        "from S#window.externalTimeBatch(et, 500) select sum(p) as s "
+        "insert into O;")
+    assert any(isinstance(p, DeviceWindowAggPlan) for p in rt._plans)
+    m.shutdown()
