@@ -620,6 +620,79 @@ def _mark(label, t0):
           file=sys.stderr, flush=True)
 
 
+def trace_breakdown(app, n_batches=16, batch=2048, keys=8,
+                    trace_out="bench_trace.json"):
+    """Per-stage breakdown of end-to-end detect latency (config 3 shape):
+    run the tape with statistics + the flight recorder on, reset after
+    warm-up (so steady state is measured, not compiles), then read the
+    stage histograms back.  The warm-up pass covers the ENTIRE tape —
+    match-buffer growth (the (T, M) retry shape) only triggers on the
+    batch whose match volume overflows the first-flush guess, so a
+    prefix warm-up would leave a fresh ~1s compile inside the timed
+    region and misattribute the breakdown to it; the timed pass replays
+    the tape shifted forward past the `within` horizon (stale partials
+    expire, time stays monotonic, every kernel shape is already cached).
+    `coverage` is the fraction of the timed wall clock the named stage
+    spans account for — the observability acceptance bar (>= 0.9 means
+    regressions are attributable); the remainder is python dispatch glue
+    between spans.  Valid because the traced app is synchronous (no
+    @app:async): all spans run on the caller thread, so their seconds
+    are disjoint slices of the wall clock — an async app would overlap
+    ingest with dispatch and the sum would overstate.  Also exports the
+    recorder as Chrome trace_event JSON (`trace_out`)."""
+    from siddhi_tpu import SiddhiManager
+
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(app)
+    rt.enable_stats(True)
+    rt.stats.tracer.enabled = True
+    delivered = [0]
+    rt.add_batch_callback(
+        "Out", lambda b: delivered.__setitem__(0, delivered[0] + b.n))
+    rt.start()
+    h = rt.input_handler(STREAM)
+    tape = make_tape(n_batches * batch, batch, keys=keys)
+    batches = _columnar(rt, STREAM, tape, keys)
+    for cols, ts in batches:
+        h.send_batch(cols, ts)
+    rt.flush()
+    rt.stats.reset()                 # steady state only: compiles are done
+    delivered[0] = 0
+    # replay shifted well past the within-window so the warm pass's
+    # partials expire instead of matching across the seam
+    shift = np.int64(int(batches[-1][1][-1]) - int(batches[0][1][0])
+                     + 60_000)
+    n_timed = sum(int(t[1].shape[0]) for t in batches)
+    t0 = time.perf_counter()
+    for cols, ts in batches:
+        h.send_batch(cols, ts + shift)
+    rt.flush()
+    wall = time.perf_counter() - t0
+    rep = rt.statistics()
+    n_trace = rt.stats.export_chrome_trace(trace_out)
+    mgr.shutdown()
+
+    stages = {st: td for st, td in rep["stages"].items()
+              if td.get("seconds") and st not in ("parse", "plan")}
+    covered = sum(td["seconds"] for td in stages.values())
+    out = {
+        "events": n_timed, "batch": batch, "matches": delivered[0],
+        "end_to_end_s": round(wall, 4),
+        "eps": round(n_timed / wall),
+        "coverage": round(covered / wall, 3),
+        "stages": {st: {
+            "seconds": round(td["seconds"], 4),
+            "share": round(td["seconds"] / wall, 3),
+            **{k: td[k] for k in ("p50_ms", "p95_ms", "p99_ms") if k in td},
+        } for st, td in sorted(stages.items(),
+                               key=lambda kv: -kv[1]["seconds"])},
+        "chrome_trace": {"path": trace_out, "events": n_trace},
+    }
+    if "device" in rep:
+        out["device"] = rep["device"]
+    return out
+
+
 # ---------------------------------------------------------------------------
 # native single-core calibration (no JVM in the image: an -O2 C++ run of
 # the same matcher algorithms upper-bounds single-JVM single-thread
@@ -691,7 +764,18 @@ def native_baseline():
     return out
 
 
-def main():
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    if "--trace" in argv:
+        # fast mode: per-stage breakdown of config 3 only (the
+        # diagnosability check — where does a detect-latency millisecond
+        # go?), one JSON line, ~seconds of runtime
+        tr = trace_breakdown(DEV["patterns"] + C3)
+        print(json.dumps({"metric": "stage_breakdown_config3",
+                          "value": tr["coverage"],
+                          "unit": "fraction_of_e2e_latency_attributed",
+                          **tr}))
+        return
     t0 = time.perf_counter()
     configs = {}
 
@@ -726,7 +810,8 @@ def main():
         {"batch": c3["batch"], "eps": c3["device_eps"], "p99_ms": None}]
     c3["latency_demo"] = latency_demo(DEV["patterns"] + C3,
                                       HOST["patterns"] + C3)
-    _mark("frontier + latency demo done", t0)
+    c3["trace"] = trace_breakdown(DEV["patterns"] + C3)
+    _mark("frontier + latency demo + trace done", t0)
 
     head = ("@app:partitionCapacity(1000)\n@app:deviceSlots(32)\n")
     configs["4_partitioned_1k"] = bench_config(
@@ -816,7 +901,7 @@ def main():
     _mark("native baseline done", t0)
 
     h = configs["4_partitioned_1k"]
-    print(json.dumps({
+    detail = {
         "metric": "partitioned_pattern_throughput_1k_keys",
         "value": h["device_eps"],
         "unit": "events/sec",
@@ -840,6 +925,27 @@ def main():
                          "not compute, bound most configs here",
         },
         "configs": configs,
+    }
+    with open("BENCH_DETAIL.json", "w") as f:
+        json.dump(detail, f, indent=1)
+    # ONE short stdout line: drivers keep only the stdout TAIL, so the
+    # full per-config detail (which blew past their capture window —
+    # BENCH_r05 "parsed": null) goes to BENCH_DETAIL.json and the
+    # parseable summary stays well under 2 kB
+    tr = c3.get("trace", {})
+    print(json.dumps({
+        "metric": detail["metric"], "value": detail["value"],
+        "unit": detail["unit"], "vs_baseline": detail["vs_baseline"],
+        "vs_production_claim": detail["vs_production_claim"],
+        "p99_detect_ms": detail["p99_detect_ms"],
+        "trace_coverage_config3": tr.get("coverage"),
+        "stage_shares_config3": {st: d["share"] for st, d in
+                                 tr.get("stages", {}).items()},
+        "configs": {k: {"eps": v["device_eps"], "speedup": v["speedup"],
+                        **({"p99_ms": v["p99_detect_ms"]}
+                           if v.get("p99_detect_ms") is not None else {})}
+                    for k, v in configs.items()},
+        "detail": "BENCH_DETAIL.json",
     }))
 
 

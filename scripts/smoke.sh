@@ -1,0 +1,62 @@
+#!/usr/bin/env bash
+# CI smoke: the tier-1 suite plus a ~5-second end-to-end service check
+# (deploy an app over REST, push events, assert /metrics exposes
+# nonzero counters).  Exits nonzero on any failure.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "== tier-1 tests =="
+python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider
+
+echo "== service /metrics smoke =="
+python - <<'EOF'
+import json
+import sys
+import time
+import urllib.request
+
+from siddhi_tpu.service import SiddhiService
+
+svc = SiddhiService(port=0).start()
+base = f"http://127.0.0.1:{svc.port}"
+deadline = time.time() + 5.0
+try:
+    app = ("@app:name('Smoke')\n"
+           "define stream S (sym string, p double);\n"
+           "@info(name='q') from S[p > 10] select sym, p insert into Out;\n")
+    req = urllib.request.Request(f"{base}/siddhi/artifact/deploy",
+                                 data=app.encode(), method="POST")
+    assert json.loads(urllib.request.urlopen(req).read())["app"] == "Smoke"
+    for i in range(20):
+        req = urllib.request.Request(
+            f"{base}/siddhi/artifact/event",
+            data=json.dumps({"app": "Smoke", "stream": "S",
+                             "data": [f"K{i % 4}", 9.0 + i]}).encode(),
+            method="POST")
+        urllib.request.urlopen(req).read()
+    text = ""
+    while time.time() < deadline:
+        with urllib.request.urlopen(f"{base}/metrics") as r:
+            ctype = r.headers["Content-Type"]
+            text = r.read().decode()
+        if 'siddhi_tpu_events_total{app="Smoke",stream="S"} 20' in text:
+            break
+        time.sleep(0.2)
+    assert "version=0.0.4" in ctype, f"bad content type {ctype!r}"
+    assert 'siddhi_tpu_events_total{app="Smoke",stream="S"} 20' in text, \
+        "events_total never reached 20:\n" + text[:1500]
+    assert "siddhi_tpu_query_latency_seconds" in text
+    for ln in text.splitlines():             # exposition parses
+        if ln and not ln.startswith("#"):
+            float("nan") if ln.rsplit(" ", 1)[1] == "NaN" \
+                else float(ln.rsplit(" ", 1)[1])
+    print(f"OK: /metrics valid, nonzero counters "
+          f"({len(text.splitlines())} lines)")
+finally:
+    svc.stop()
+EOF
+
+echo "smoke: PASS"

@@ -52,6 +52,7 @@ from .expr import (ExprError, MultiStreamContext, SingleStreamContext,
 from .planner import (OutputBatch, PlanError, QueryPlan,
                       selector_has_aggregators)
 from .nfa_device import _hi32, _lo32, join64_np, pow2_at_least as pow2
+from .telemetry import call_kernel, env_nbytes
 from .schema import StreamSchema, TIMESTAMP_DTYPE, dtype_of
 
 _I32 = jnp.int32
@@ -443,8 +444,9 @@ class DeviceJoinPlan(QueryPlan):
         if not self._buffered:
             return []
         bufs, self._buffered = self._buffered, []
-        lc, lts, lseq, ln = self._side_arrays(self.left, bufs)
-        rc, rts, rseq, rn = self._side_arrays(self.right, bufs)
+        with self.rt.stats.stage("host_build", plan=self.name):
+            lc, lts, lseq, ln = self._side_arrays(self.left, bufs)
+            rc, rts, rseq, rn = self._side_arrays(self.right, bufs)
         if ln == 0 and rn == 0:
             return []
         TL, TR = pow2(max(ln, 1)), pow2(max(rn, 1))
@@ -497,8 +499,14 @@ class DeviceJoinPlan(QueryPlan):
     def _dispatch(self, lev, rev, TL, TR, NL, NR, meta, M=None,
                   mirror_snap=None) -> dict:
         M = M if M is not None else max(self._m_hint, 16)
-        fn = self._block_fn(TL, TR, NL, NR, M)
-        res = fn(lev, rev)
+        if not self.rt.stats.enabled:
+            res = self._block_fn(TL, TR, NL, NR, M)(lev, rev)
+        else:
+            hit = (TL, TR, NL, NR, M) in self._fn_cache
+            fn = self._block_fn(TL, TR, NL, NR, M)
+            res = call_kernel(
+                self.rt.stats, self.name, fn, (lev, rev), cache_hit=hit,
+                nbytes=env_nbytes(lev) + env_nbytes(rev))
         for k in ("i", "f"):
             if k in res:
                 try:    # start the D2H pull while the device computes
@@ -520,7 +528,8 @@ class DeviceJoinPlan(QueryPlan):
 
     def _materialize(self, entry: dict, update_mirrors: bool = False) -> list:
         while True:
-            ipack = np.asarray(entry["res"]["i"])      # ONE pull
+            with self.rt.stats.stage("transfer", plan=self.name):
+                ipack = np.asarray(entry["res"]["i"])      # ONE pull
             nL, nR = int(ipack[0]), int(ipack[1])
             M = entry["M"]
             if max(nL, nR) <= M:

@@ -250,6 +250,14 @@ class MultiQueryDevicePatternPlan:
 
     # -- QueryPlan surface -------------------------------------------------
 
+    def device_metrics(self) -> dict:
+        """Sampled gauges of the fused kernel (lane = query instance, so
+        occupancy here reads as per-query pending-match population)."""
+        m = self.inner.device_metrics()
+        m["fused_queries"] = self.n_queries
+        m["padded_lanes"] = self.inner.P - self.n_queries
+        return m
+
     def flush_pending(self):
         return []
 

@@ -625,6 +625,28 @@ class NFAKernel:
         })
         return st
 
+    def occupancy(self, state) -> dict:
+        """Sampled lane/slot occupancy + state-frontier width — the
+        quantities that govern throughput on this kernel (state-set
+        width / lane utilization; cf. Simultaneous Finite Automata,
+        arxiv 1405.0562).  One D2H pull of `occ` (A, P) i32; call from
+        a metrics scrape, not the hot path."""
+        occ = np.asarray(state["occ"])
+        S = self.spec.S
+        live = (occ > 0) & (occ <= S)          # stationed partial matches
+        per_lane = live.sum(axis=0)
+        active = per_lane > 0
+        d = {"slots_total": int(occ.size),
+             "slots_live": int(per_lane.sum()),
+             "slots_parked": int((occ == S + 1).sum()),
+             "lanes_total": int(occ.shape[1]),
+             "lanes_active": int(active.sum()),
+             "frontier_width_max": int(per_lane.max()) if occ.size else 0}
+        if d["lanes_active"]:
+            d["frontier_width_mean"] = round(
+                float(per_lane[active].mean()), 3)
+        return d
+
     # -- env helpers -----------------------------------------------------
 
     def _caps_env(self, caps: dict) -> dict:

@@ -30,6 +30,7 @@ from .nfa_device import (ChainSpec, DeviceNFAUnsupported, LOCAL_SPAN,
 from .planner import (AGGREGATOR_NAMES, OutputBatch, PlanError, QueryPlan,
                       selector_has_aggregators)
 from .schema import StreamSchema, TIMESTAMP_DTYPE, dtype_of
+from .telemetry import call_kernel, env_nbytes
 
 _I32 = np.int32
 
@@ -380,6 +381,32 @@ class DevicePatternPlan(QueryPlan):
             self._seq_base = min_seq
         self.state = self._shard(st)
 
+    # -- telemetry ---------------------------------------------------------
+
+    def _call_block(self, kern: NFAKernel, T: int, M: int, st, ev):
+        """Invoke one jitted NFA block recording compile/kernel stage,
+        block-cache hit/miss, and the H2D payload size."""
+        stats = self.rt.stats
+        if not stats.enabled:
+            return kern.block_fn(T, M)(st, ev)
+        hit = (T, M) in kern._block_cache
+        fn = kern.block_fn(T, M)
+        return call_kernel(stats, self.name, fn, (st, ev),
+                           cache_hit=hit, nbytes=env_nbytes(ev))
+
+    def device_metrics(self) -> dict:
+        """Sampled device gauges: lane occupancy + state-frontier width
+        (one D2H pull of `occ`), partition-key fill, capacity drops."""
+        d = {"lanes_total": int(self.P)}
+        if self._chunk_cfg is None:
+            d.update(self.kernel.occupancy(self.state))
+        if self.part_key_fns is not None:
+            # distinct from lanes_active (lanes holding LIVE partial
+            # matches): keys ever assigned to a lane
+            d["keys_assigned"] = len(self._key_to_part)
+        d["dropped_partials"] = int(self.dropped)
+        return d
+
     # -- QueryPlan interface -------------------------------------------------
 
     def process(self, stream_id: str, batch: EventBatch) -> list:
@@ -400,105 +427,109 @@ class DevicePatternPlan(QueryPlan):
             self._anchor_ms()
         bufs, self._buffered = self._buffered, []
 
-        # 1. union columns over all buffered batches
-        N = sum(b.n for _s, b in bufs)
-        ts = np.empty(N, dtype=np.int64)
-        seq = np.empty(N, dtype=np.int64)
-        scode = np.empty(N, dtype=_I32)
-        part = np.empty(N, dtype=_I32)
-        cols: dict = {}
-        for si, attr, t in self._grid_attrs:
-            cols[f"{si}.{attr}"] = np.zeros(N, dtype=self._np_dtype(t))
-        o = 0
-        for sid, b in bufs:
-            si = self._scode[sid]
-            sl = slice(o, o + b.n)
-            ts[sl] = b.timestamps
-            seq[sl] = b.seqs if b.seqs is not None else np.arange(o, o + b.n)
-            scode[sl] = si
-            part[sl] = self.part_of(sid, b)
-            for sj, attr, _t in self._grid_attrs:
-                if sj == si:
-                    cols[f"{si}.{attr}"][sl] = b.columns[attr]
-            o += b.n
+        with self.rt.stats.stage("host_build", plan=self.name):
+            # 1. union columns over all buffered batches
+            N = sum(b.n for _s, b in bufs)
+            ts = np.empty(N, dtype=np.int64)
+            seq = np.empty(N, dtype=np.int64)
+            scode = np.empty(N, dtype=_I32)
+            part = np.empty(N, dtype=_I32)
+            cols: dict = {}
+            for si, attr, t in self._grid_attrs:
+                cols[f"{si}.{attr}"] = np.zeros(N, dtype=self._np_dtype(t))
+            o = 0
+            for sid, b in bufs:
+                si = self._scode[sid]
+                sl = slice(o, o + b.n)
+                ts[sl] = b.timestamps
+                seq[sl] = b.seqs if b.seqs is not None \
+                    else np.arange(o, o + b.n)
+                scode[sl] = si
+                part[sl] = self.part_of(sid, b)
+                for sj, attr, _t in self._grid_attrs:
+                    if sj == si:
+                        cols[f"{si}.{attr}"][sl] = b.columns[attr]
+                o += b.n
 
-        # 2. order by arrival, compute index-within-partition (broadcast
-        # mode: every lane sees every event, so the grid is (T, 1))
-        order = np.lexsort((seq,))
-        ts, seq, scode, part = ts[order], seq[order], scode[order], part[order]
-        for k in cols:
-            cols[k] = cols[k][order]
+            # 2. order by arrival, compute index-within-partition (broadcast
+            # mode: every lane sees every event, so the grid is (T, 1))
+            order = np.lexsort((seq,))
+            ts, seq, scode, part = (ts[order], seq[order], scode[order],
+                                    part[order])
+            for k in cols:
+                cols[k] = cols[k][order]
         if self._chunk_cfg is not None:
             return self._run_chunked_flat(ts, seq, scode, cols)
-        if self.broadcast_events:
-            idx_within = np.arange(N, dtype=np.int64)
-            part = np.zeros(N, dtype=_I32)
-        else:
-            by_part = np.lexsort((seq, part))
-            idx_within = np.empty(N, dtype=np.int64)
-            sp = part[by_part]
-            run_start = np.flatnonzero(np.r_[True, sp[1:] != sp[:-1]])
-            run_id = np.cumsum(np.r_[True, sp[1:] != sp[:-1]]) - 1
-            idx_within[by_part] = np.arange(N) - run_start[run_id]
+        with self.rt.stats.stage("host_build", plan=self.name):
+            if self.broadcast_events:
+                idx_within = np.arange(N, dtype=np.int64)
+                part = np.zeros(N, dtype=_I32)
+            else:
+                by_part = np.lexsort((seq, part))
+                idx_within = np.empty(N, dtype=np.int64)
+                sp = part[by_part]
+                run_start = np.flatnonzero(np.r_[True, sp[1:] != sp[:-1]])
+                run_id = np.cumsum(np.r_[True, sp[1:] != sp[:-1]]) - 1
+                idx_within[by_part] = np.arange(N) - run_start[run_id]
 
-        # 3. i32 offset bases (+ rebase persistent state before overflow).
-        # The base is chosen from the flush MAX so headroom is always
-        # restored even when a stale event pins the minimum; events older
-        # than base - LOCAL_SPAN clamp low (their age saturates and
-        # `within` expires them — never a silent wrap).
-        budget = LOCAL_SPAN - (1 << 16)
-        if self._ts_base is None:
-            lo = int(ts.min())
-            if self.spec.needs_init_slot and self._init_on_tick:
-                lo = min(lo, self._anchor_ms())
-            self._ts_base = max(lo, int(ts.max()) - budget)
-            self._seq_base = max(int(seq.min()), int(seq.max()) - budget)
-        if int(ts.max()) - self._ts_base >= budget \
-                or int(seq.max()) - self._seq_base >= budget:
-            self._rebase(max(int(ts.min()), int(ts.max()) - budget),
-                         max(int(seq.min()), int(seq.max()) - budget))
-        ts32 = np.clip(ts - self._ts_base, -LOCAL_SPAN, LOCAL_SPAN).astype(_I32)
-        seq32 = np.clip(seq - self._seq_base, -LOCAL_SPAN, LOCAL_SPAN).astype(_I32)
-        self._last_seq = max(self._last_seq, int(seq.max()))
+            # 3. i32 offset bases (+ rebase persistent state before overflow).
+            # The base is chosen from the flush MAX so headroom is always
+            # restored even when a stale event pins the minimum; events older
+            # than base - LOCAL_SPAN clamp low (their age saturates and
+            # `within` expires them — never a silent wrap).
+            budget = LOCAL_SPAN - (1 << 16)
+            if self._ts_base is None:
+                lo = int(ts.min())
+                if self.spec.needs_init_slot and self._init_on_tick:
+                    lo = min(lo, self._anchor_ms())
+                self._ts_base = max(lo, int(ts.max()) - budget)
+                self._seq_base = max(int(seq.min()), int(seq.max()) - budget)
+            if int(ts.max()) - self._ts_base >= budget \
+                    or int(seq.max()) - self._seq_base >= budget:
+                self._rebase(max(int(ts.min()), int(ts.max()) - budget),
+                             max(int(seq.min()), int(seq.max()) - budget))
+            ts32 = np.clip(ts - self._ts_base, -LOCAL_SPAN, LOCAL_SPAN).astype(_I32)
+            seq32 = np.clip(seq - self._seq_base, -LOCAL_SPAN, LOCAL_SPAN).astype(_I32)
+            self._last_seq = max(self._last_seq, int(seq.max()))
 
-        # 4. run dense (T, P) blocks (chunked if one partition hogs the
-        # batch); T_CAP widens for small P so single-partition patterns
-        # amortize per-block overhead over longer scans
-        T_CAP = min(8192, max(512, (1 << 19) // max(self.P, 1)))
-        if self.broadcast_events:
-            T_CAP = 4096
-        GW = 1 if self.broadcast_events else self.P    # grid width
-        multi = len(self.spec.stream_ids) > 1
-        chunk_evs: list = []
-        n_chunks = int(idx_within.max()) // T_CAP + 1
-        for c in range(n_chunks):
-            m = (idx_within >= c * T_CAP) & (idx_within < (c + 1) * T_CAP)
-            if not m.any():
-                continue
-            t_local = (idx_within[m] - c * T_CAP).astype(np.int64)
-            T = pow2_at_least(int(t_local.max()) + 1)
-            ev = {"__ts__": np.zeros((T, GW), _I32),
-                  "__seq__": np.zeros((T, GW), _I32),
-                  "__valid__": np.zeros((T, GW), bool)}
-            if multi:
-                ev["__scode__"] = np.full((T, GW), -1, _I32)
-            for k, v in cols.items():
-                ev[k] = np.zeros((T, GW), v.dtype)
-            pm = part[m]
-            ev["__ts__"][t_local, pm] = ts32[m]
-            ev["__seq__"][t_local, pm] = seq32[m]
-            if multi:
-                ev["__scode__"][t_local, pm] = scode[m]
-            ev["__valid__"][t_local, pm] = True
-            for k, v in cols.items():
-                ev[k][t_local, pm] = v[m]
-            ev["__base_ts__"] = np.int64(self._ts_base)
-            ev["__base_seq__"] = np.int64(self._seq_base)
-            if self.spec.needs_init_slot and self._init_on_tick:
-                ev["__anchor__"] = np.int32(np.clip(
-                    self._anchor_ms() - self._ts_base,
-                    -LOCAL_SPAN, LOCAL_SPAN))
-            chunk_evs.append((ev, T))
+            # 4. run dense (T, P) blocks (chunked if one partition hogs the
+            # batch); T_CAP widens for small P so single-partition patterns
+            # amortize per-block overhead over longer scans
+            T_CAP = min(8192, max(512, (1 << 19) // max(self.P, 1)))
+            if self.broadcast_events:
+                T_CAP = 4096
+            GW = 1 if self.broadcast_events else self.P    # grid width
+            multi = len(self.spec.stream_ids) > 1
+            chunk_evs: list = []
+            n_chunks = int(idx_within.max()) // T_CAP + 1
+            for c in range(n_chunks):
+                m = (idx_within >= c * T_CAP) & (idx_within < (c + 1) * T_CAP)
+                if not m.any():
+                    continue
+                t_local = (idx_within[m] - c * T_CAP).astype(np.int64)
+                T = pow2_at_least(int(t_local.max()) + 1)
+                ev = {"__ts__": np.zeros((T, GW), _I32),
+                      "__seq__": np.zeros((T, GW), _I32),
+                      "__valid__": np.zeros((T, GW), bool)}
+                if multi:
+                    ev["__scode__"] = np.full((T, GW), -1, _I32)
+                for k, v in cols.items():
+                    ev[k] = np.zeros((T, GW), v.dtype)
+                pm = part[m]
+                ev["__ts__"][t_local, pm] = ts32[m]
+                ev["__seq__"][t_local, pm] = seq32[m]
+                if multi:
+                    ev["__scode__"][t_local, pm] = scode[m]
+                ev["__valid__"][t_local, pm] = True
+                for k, v in cols.items():
+                    ev[k][t_local, pm] = v[m]
+                ev["__base_ts__"] = np.int64(self._ts_base)
+                ev["__base_seq__"] = np.int64(self._seq_base)
+                if self.spec.needs_init_slot and self._init_on_tick:
+                    ev["__anchor__"] = np.int32(np.clip(
+                        self._anchor_ms() - self._ts_base,
+                        -LOCAL_SPAN, LOCAL_SPAN))
+                chunk_evs.append((ev, T))
 
         return self._run_chunks(chunk_evs)
 
@@ -527,9 +558,8 @@ class DevicePatternPlan(QueryPlan):
                     M = max(self._m_hint, pow2_at_least(32 * T))
                 else:
                     M = max(self._m_hint, _m_bucket(2 * T))
-                fn = self.kernel.block_fn(T, M)
                 pre = st
-                st, out = fn(st, ev)
+                st, out = self._call_block(self.kernel, T, M, pre, ev)
                 try:    # start the D2H pull while the device still computes
                     out["i"].copy_to_host_async()
                 except Exception:
@@ -537,17 +567,18 @@ class DevicePatternPlan(QueryPlan):
                 dispatched.append((j, pre, ev, T, M, out))
             restart = None
             for j, pre, ev, T, M, out in dispatched:
-                ipack = np.asarray(out["i"])   # ONE device->host transfer
-                fpack = np.asarray(out["f"]) if "f" in out else None
+                with self.rt.stats.stage("transfer", plan=self.name):
+                    ipack = np.asarray(out["i"])   # ONE D2H transfer
+                    fpack = np.asarray(out["f"]) if "f" in out else None
                 n, ofs, ofl = (int(ipack[0, 0]), int(ipack[0, 1]),
                                int(ipack[0, 2]))
                 while n > M:                   # exact re-run, bigger buffer
                     M = pow2_at_least(n) if self.broadcast_events \
                         else _m_bucket(n)
-                    fn = self.kernel.block_fn(T, M)
-                    _st2, out = fn(pre, ev)
-                    ipack = np.asarray(out["i"])
-                    fpack = np.asarray(out["f"]) if "f" in out else None
+                    _st2, out = self._call_block(self.kernel, T, M, pre, ev)
+                    with self.rt.stats.stage("transfer", plan=self.name):
+                        ipack = np.asarray(out["i"])
+                        fpack = np.asarray(out["f"]) if "f" in out else None
                     n, ofs, ofl = (int(ipack[0, 0]), int(ipack[0, 1]),
                                    int(ipack[0, 2]))
                 self._m_hint = max(self._m_hint, M)
@@ -599,99 +630,100 @@ class DevicePatternPlan(QueryPlan):
         split into K own-chunks, gathered into lanes on device.  Blocks
         carry no device state, so flushes pipeline independently
         (@app:devicePipeline) and retries are self-contained."""
-        cfg = self._chunk_cfg
-        W = int(cfg["W"])
-        if self._tail is not None:
-            ts = np.concatenate([self._tail["ts"], ts])
-            seq = np.concatenate([self._tail["seq"], seq])
-            scode = np.concatenate([self._tail["scode"], scode])
-            cols = {k: np.concatenate([self._tail["cols"][k], v])
-                    for k, v in cols.items()}
-        N = len(ts)
-        ts_mono = np.maximum.accumulate(ts)
-        # `within` compares RAW event timestamps, but halo/tail bounds
-        # search the running max — a regressed (out-of-order) timestamp
-        # could place a still-completable event past the searched bound.
-        # Widening the window by the worst regression keeps every such
-        # event inside the halo/tail (over-covering is harmless).
-        W = W + int(np.max(ts_mono - ts)) if N else W
+        with self.rt.stats.stage("host_build", plan=self.name):
+            cfg = self._chunk_cfg
+            W = int(cfg["W"])
+            if self._tail is not None:
+                ts = np.concatenate([self._tail["ts"], ts])
+                seq = np.concatenate([self._tail["seq"], seq])
+                scode = np.concatenate([self._tail["scode"], scode])
+                cols = {k: np.concatenate([self._tail["cols"][k], v])
+                        for k, v in cols.items()}
+            N = len(ts)
+            ts_mono = np.maximum.accumulate(ts)
+            # `within` compares RAW event timestamps, but halo/tail bounds
+            # search the running max — a regressed (out-of-order) timestamp
+            # could place a still-completable event past the searched bound.
+            # Widening the window by the worst regression keeps every such
+            # event inside the halo/tail (over-covering is harmless).
+            W = W + int(np.max(ts_mono - ts)) if N else W
 
-        # lane geometry: halo-dominated data (few events per W) gets
-        # fewer, longer chunks; K buckets to pow2 so kernels are reused
-        def _halo(K: int):
-            CS = -(-N // K)
-            ends = np.unique(np.minimum(np.arange(1, K + 1) * CS, N))
-            ends = ends[ends > 0]
-            to = np.searchsorted(ts_mono, ts_mono[ends - 1] + W, side="right")
-            return CS, int(np.max(to - ends))
-        # K rides pow2 buckets: latency-capped ingest produces VARIABLE
-        # small flushes, and every distinct K is a fresh kernel compile
-        # (~10 s through the tunnel); empty lanes are free
-        K = min(int(cfg["lanes"]), pow2_at_least(max(1, N), lo=8))
-        CS, H = _halo(K)
-        if CS < H:
-            # halo-dominated: fewer, longer chunks (lo=8 keeps the K
-            # bucket set tiny — empty lanes are free, fresh compiles
-            # through the tunnel are not)
-            K = min(int(cfg["lanes"]),
-                    pow2_at_least(max(1, N // max(H, 1)), lo=8))
+            # lane geometry: halo-dominated data (few events per W) gets
+            # fewer, longer chunks; K buckets to pow2 so kernels are reused
+            def _halo(K: int):
+                CS = -(-N // K)
+                ends = np.unique(np.minimum(np.arange(1, K + 1) * CS, N))
+                ends = ends[ends > 0]
+                to = np.searchsorted(ts_mono, ts_mono[ends - 1] + W, side="right")
+                return CS, int(np.max(to - ends))
+            # K rides pow2 buckets: latency-capped ingest produces VARIABLE
+            # small flushes, and every distinct K is a fresh kernel compile
+            # (~10 s through the tunnel); empty lanes are free
+            K = min(int(cfg["lanes"]), pow2_at_least(max(1, N), lo=8))
             CS, H = _halo(K)
-        if self.mesh is not None:
-            # lane axis shards over the mesh: K must divide evenly over
-            # the device count (K = min(lanes, N) can be arbitrary)
-            nd = self.mesh.devices.size
-            if K % nd:
-                K = -(-K // nd) * nd
+            if CS < H:
+                # halo-dominated: fewer, longer chunks (lo=8 keeps the K
+                # bucket set tiny — empty lanes are free, fresh compiles
+                # through the tunnel are not)
+                K = min(int(cfg["lanes"]),
+                        pow2_at_least(max(1, N // max(H, 1)), lo=8))
                 CS, H = _halo(K)
-        T = pow2_at_least(CS + H, lo=64)
+            if self.mesh is not None:
+                # lane axis shards over the mesh: K must divide evenly over
+                # the device count (K = min(lanes, N) can be arbitrary)
+                nd = self.mesh.devices.size
+                if K % nd:
+                    K = -(-K // nd) * nd
+                    CS, H = _halo(K)
+            T = pow2_at_least(CS + H, lo=64)
 
-        # fresh i32 bases every flush (no persistent device state)
-        ts_base = int(ts_mono[0])
-        seq_base = int(seq[0])
-        ts32 = np.clip(ts - ts_base, -LOCAL_SPAN, LOCAL_SPAN).astype(_I32)
-        self._last_seq = max(self._last_seq, int(seq[-1]))
-        # completions at or before the previous flush's last seq are
-        # replays — suppressed ON DEVICE so they never cross the tunnel
-        prev_off = np.int32(np.clip(self._prev_last_seq - seq_base,
-                                    -LOCAL_SPAN, LOCAL_SPAN))
+            # fresh i32 bases every flush (no persistent device state)
+            ts_base = int(ts_mono[0])
+            seq_base = int(seq[0])
+            ts32 = np.clip(ts - ts_base, -LOCAL_SPAN, LOCAL_SPAN).astype(_I32)
+            self._last_seq = max(self._last_seq, int(seq[-1]))
+            # completions at or before the previous flush's last seq are
+            # replays — suppressed ON DEVICE so they never cross the tunnel
+            prev_off = np.int32(np.clip(self._prev_last_seq - seq_base,
+                                        -LOCAL_SPAN, LOCAL_SPAN))
 
-        # flat-buffer capacity: fine-granular bucket + one granule of
-        # headroom, STICKY per plan — the replay tail appearing after
-        # flush 1 (or drifting in size) must not change F, because every
-        # distinct F is a ~10s recompile through the tunnel.  Shrinks only
-        # when the flush size drops 4x (batch regime change).
-        f_min = (N // 2048 + 2) * 2048
-        F = max(getattr(self, "_chunk_F", 0), f_min)
-        if F > 4 * f_min:
-            F = f_min
-        self._chunk_F = F
+            # flat-buffer capacity: fine-granular bucket + one granule of
+            # headroom, STICKY per plan — the replay tail appearing after
+            # flush 1 (or drifting in size) must not change F, because every
+            # distinct F is a ~10s recompile through the tunnel.  Shrinks only
+            # when the flush size drops 4x (batch regime change).
+            f_min = (N // 2048 + 2) * 2048
+            F = max(getattr(self, "_chunk_F", 0), f_min)
+            if F > 4 * f_min:
+                F = f_min
+            self._chunk_F = F
 
-        def pad(a):
-            out = np.zeros(F, dtype=a.dtype)
-            out[:N] = a
-            return out
-        ev = {"__flat.__ts__": pad(ts32),
-              "__cs__": np.int32(CS), "__nev__": np.int32(N),
-              "__prev_seq__": prev_off,
-              "__base_ts__": np.int64(ts_base),
-              "__base_seq__": np.int64(seq_base)}
-        if seq[-1] - seq[0] == N - 1:
-            # consecutive seqs derive on device from one scalar
-            ev["__seq0__"] = np.int32(0)
-        else:
-            ev["__flat.__seq__"] = pad(
-                np.clip(seq - seq_base, -LOCAL_SPAN, LOCAL_SPAN).astype(_I32))
-        if len(self.spec.stream_ids) > 1:
-            ev["__flat.__scode__"] = pad(scode)
-        for k, v in cols.items():
-            ev[f"__flat.{k}"] = pad(v)
+            def pad(a):
+                out = np.zeros(F, dtype=a.dtype)
+                out[:N] = a
+                return out
+            ev = {"__flat.__ts__": pad(ts32),
+                  "__cs__": np.int32(CS), "__nev__": np.int32(N),
+                  "__prev_seq__": prev_off,
+                  "__base_ts__": np.int64(ts_base),
+                  "__base_seq__": np.int64(seq_base)}
+            if seq[-1] - seq[0] == N - 1:
+                # consecutive seqs derive on device from one scalar
+                ev["__seq0__"] = np.int32(0)
+            else:
+                ev["__flat.__seq__"] = pad(
+                    np.clip(seq - seq_base, -LOCAL_SPAN, LOCAL_SPAN).astype(_I32))
+            if len(self.spec.stream_ids) > 1:
+                ev["__flat.__scode__"] = pad(scode)
+            for k, v in cols.items():
+                ev[f"__flat.{k}"] = pad(v)
 
-        last_ts = int(ts_mono[-1])
-        keep = ts_mono >= last_ts - W
-        self._tail = {"ts": ts[keep], "seq": seq[keep],
-                      "scode": scode[keep],
-                      "cols": {k: v[keep] for k, v in cols.items()}}
-        self._prev_last_seq = int(seq[-1])
+            last_ts = int(ts_mono[-1])
+            keep = ts_mono >= last_ts - W
+            self._tail = {"ts": ts[keep], "seq": seq[keep],
+                          "scode": scode[keep],
+                          "cols": {k: v[keep] for k, v in cols.items()}}
+            self._prev_last_seq = int(seq[-1])
 
         # M sizing: the first flush guesses from N (could retry once);
         # after that the hint PINS it — an N-based floor would drift
@@ -707,20 +739,21 @@ class DevicePatternPlan(QueryPlan):
         return out
 
     def _dispatch_chunk(self, ev, K, T, M, ts_base, seq_base) -> dict:
-        kern = self._chunk_kernel(K)
-        fn = kern.block_fn(T, M)
-        st0 = kern.init_state()
-        if self.mesh is not None:
-            # lane-axis sharding: state (.., K) shards over the mesh, the
-            # flat event buffers replicate (each device gathers its own
-            # lanes' chunk+halo windows on device)
-            st0 = jax.tree_util.tree_map(
-                lambda a: jax.device_put(a, self._part_sharding(np.ndim(a))
-                                         if np.ndim(a) and np.shape(a)[-1] == K
-                                         else self._part_sharding(0)), st0)
-            ev = {k: jax.device_put(v, self._part_sharding(0))
-                  for k, v in ev.items()}
-        _st, out = fn(st0, ev)
+        with self.rt.stats.stage("host_build", plan=self.name):
+            kern = self._chunk_kernel(K)
+            st0 = kern.init_state()
+            if self.mesh is not None:
+                # lane-axis sharding: state (.., K) shards over the mesh, the
+                # flat event buffers replicate (each device gathers its own
+                # lanes' chunk+halo windows on device)
+                st0 = jax.tree_util.tree_map(
+                    lambda a: jax.device_put(
+                        a, self._part_sharding(np.ndim(a))
+                        if np.ndim(a) and np.shape(a)[-1] == K
+                        else self._part_sharding(0)), st0)
+                ev = {k: jax.device_put(v, self._part_sharding(0))
+                      for k, v in ev.items()}
+        _st, out = self._call_block(kern, T, M, st0, ev)
         for key in ("i", "f"):
             if key in out:
                 try:    # start the D2H pull while the device computes
@@ -732,8 +765,10 @@ class DevicePatternPlan(QueryPlan):
 
     def _materialize_chunk(self, e: dict):
         while True:
-            ipack = np.asarray(e["out"]["i"])
-            fpack = np.asarray(e["out"]["f"]) if "f" in e["out"] else None
+            with self.rt.stats.stage("transfer", plan=self.name):
+                ipack = np.asarray(e["out"]["i"])
+                fpack = np.asarray(e["out"]["f"]) if "f" in e["out"] \
+                    else None
             n, ofs, ofl = (int(ipack[0, 0]), int(ipack[0, 1]),
                            int(ipack[0, 2]))
             if n > e["M"]:
@@ -774,83 +809,85 @@ class DevicePatternPlan(QueryPlan):
 
     def _unpack_block(self, ipack, fpack, n: int):
         """Columnar match table from one block's packed output."""
-        if self.kernel.having is not None:
-            valid = ipack[1] != 0                 # (M,)
-            ii = 2
-        else:
-            valid = np.arange(ipack.shape[1]) < n
-            ii = 1
-        if not valid.any():
-            return None
-        # unpack columns in out_names order (columnar, no per-row python):
-        # f32 rows are bitcast into the i32 pack, f64 rows (f64 mode) come
-        # from the float pack, i64 as hi/lo row pairs
-        row = {}
-        fi = 0
-        for nm in self.kernel.out_names:
-            dt = np.dtype(self.kernel.out_dtypes[nm])
-            if dt == np.float64:
-                row[nm] = fpack[fi]; fi += 1
-            elif dt == np.float32:
-                row[nm] = ipack[ii].view(np.float32); ii += 1
-            elif dt == np.int64:
-                row[nm] = join64_np(ipack[ii], ipack[ii + 1]); ii += 2
+        with self.rt.stats.stage("scatter", plan=self.name):
+            if self.kernel.having is not None:
+                valid = ipack[1] != 0                 # (M,)
+                ii = 2
             else:
-                row[nm] = ipack[ii]; ii += 1
-        tss = row["__timestamp__"][valid].astype(np.int64) + self._ts_base
-        seqs = row["__seq__"][valid].astype(np.int64) + self._seq_base
-        hseqs = row["__head_seq__"][valid]
-        self._last_qids = (row["__qid__"][valid]
-                           if self.kernel.emit_qid else None)
-        data = {}
-        for nm, t in zip(self._names, self._types):
-            col = row[nm][valid]
-            if t == ast.AttrType.BOOL:
-                col = col != 0
-            data[nm] = col.astype(dtype_of(t))
-        nulls = {}
-        for nm, ref in self.kernel.null_outputs.items():
-            pres = row.get(f"__present__.{ref}")
-            if pres is not None:
-                mask = pres[valid] == 0
-                if mask.any():
-                    nulls[nm] = mask
-        return (tss, seqs, hseqs, data, nulls, self._last_qids)
+                valid = np.arange(ipack.shape[1]) < n
+                ii = 1
+            if not valid.any():
+                return None
+            # unpack columns in out_names order (columnar, no per-row python):
+            # f32 rows are bitcast into the i32 pack, f64 rows (f64 mode) come
+            # from the float pack, i64 as hi/lo row pairs
+            row = {}
+            fi = 0
+            for nm in self.kernel.out_names:
+                dt = np.dtype(self.kernel.out_dtypes[nm])
+                if dt == np.float64:
+                    row[nm] = fpack[fi]; fi += 1
+                elif dt == np.float32:
+                    row[nm] = ipack[ii].view(np.float32); ii += 1
+                elif dt == np.int64:
+                    row[nm] = join64_np(ipack[ii], ipack[ii + 1]); ii += 2
+                else:
+                    row[nm] = ipack[ii]; ii += 1
+            tss = row["__timestamp__"][valid].astype(np.int64) + self._ts_base
+            seqs = row["__seq__"][valid].astype(np.int64) + self._seq_base
+            hseqs = row["__head_seq__"][valid]
+            self._last_qids = (row["__qid__"][valid]
+                               if self.kernel.emit_qid else None)
+            data = {}
+            for nm, t in zip(self._names, self._types):
+                col = row[nm][valid]
+                if t == ast.AttrType.BOOL:
+                    col = col != 0
+                data[nm] = col.astype(dtype_of(t))
+            nulls = {}
+            for nm, ref in self.kernel.null_outputs.items():
+                pres = row.get(f"__present__.{ref}")
+                if pres is not None:
+                    mask = pres[valid] == 0
+                    if mask.any():
+                        nulls[nm] = mask
+            return (tss, seqs, hseqs, data, nulls, self._last_qids)
 
     def _rows_to_batches(self, chunks: list) -> list:
         """chunks: list of (tss, seqs, hseqs, data) columnar match tables."""
-        chunks = [c for c in chunks if c is not None]
-        if not chunks or self.events_for == ast.OutputEventsFor.EXPIRED:
-            return []
-        if self.broadcast_events:
-            raise RuntimeError("multi-query plans use finalize_multi()")
-        tss = np.concatenate([c[0] for c in chunks])
-        seqs = np.concatenate([c[1] for c in chunks])
-        hseqs = np.concatenate([c[2] for c in chunks])
-        data = {nm: np.concatenate([c[3][nm] for c in chunks])
-                for nm in self._names}
-        nulls_all = {}
-        if any(c[4] for c in chunks):
-            for nm in self._names:
-                parts = [c[4].get(nm, np.zeros(len(c[0]), bool))
-                         for c in chunks]
-                m = np.concatenate(parts)
-                if m.any():
-                    nulls_all[nm] = m
-        # emit in completion order; same-event ties by head arrival
-        # (reference emits pending-list == arrival order)
-        o = np.lexsort((hseqs, seqs))
-        if self.offset:
-            o = o[self.offset:]
-        if self.limit is not None:
-            o = o[:self.limit]
-        if not len(o):
-            return []
-        cols = {nm: data[nm][o] for nm in self._names}
-        nulls = {nm: m[o] for nm, m in nulls_all.items()} or None
-        batch = EventBatch(self.out_schema, tss[o].astype(TIMESTAMP_DTYPE),
-                           cols, len(o), seqs[o], nulls)
-        return [OutputBatch(self.output_target, batch)]
+        with self.rt.stats.stage("scatter", plan=self.name):
+            chunks = [c for c in chunks if c is not None]
+            if not chunks or self.events_for == ast.OutputEventsFor.EXPIRED:
+                return []
+            if self.broadcast_events:
+                raise RuntimeError("multi-query plans use finalize_multi()")
+            tss = np.concatenate([c[0] for c in chunks])
+            seqs = np.concatenate([c[1] for c in chunks])
+            hseqs = np.concatenate([c[2] for c in chunks])
+            data = {nm: np.concatenate([c[3][nm] for c in chunks])
+                    for nm in self._names}
+            nulls_all = {}
+            if any(c[4] for c in chunks):
+                for nm in self._names:
+                    parts = [c[4].get(nm, np.zeros(len(c[0]), bool))
+                             for c in chunks]
+                    m = np.concatenate(parts)
+                    if m.any():
+                        nulls_all[nm] = m
+            # emit in completion order; same-event ties by head arrival
+            # (reference emits pending-list == arrival order)
+            o = np.lexsort((hseqs, seqs))
+            if self.offset:
+                o = o[self.offset:]
+            if self.limit is not None:
+                o = o[:self.limit]
+            if not len(o):
+                return []
+            cols = {nm: data[nm][o] for nm in self._names}
+            nulls = {nm: m[o] for nm, m in nulls_all.items()} or None
+            batch = EventBatch(self.out_schema, tss[o].astype(TIMESTAMP_DTYPE),
+                               cols, len(o), seqs[o], nulls)
+            return [OutputBatch(self.output_target, batch)]
 
     def finalize_multi(self):
         """Multi-query mode: drain buffered events and return the raw

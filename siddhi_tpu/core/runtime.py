@@ -226,7 +226,7 @@ class SiddhiAppRuntime:
         self._async_outbox: list = []   # full builders staged under the lock
         self._outbox_mutex = threading.Lock()   # orders producer enqueues
 
-        from .stats import StatisticsManager
+        from .telemetry import StatisticsManager
         self.stats = StatisticsManager(self)
         sa = qast.find_annotation(app.annotations, "app:statistics")
         if sa is not None and (sa.element() or "true").lower() != "false":
@@ -240,7 +240,8 @@ class SiddhiAppRuntime:
                 self.stats.configure(rep or "console", iv_s)
         self._debugger = None
 
-        self._build()
+        with self.stats.stage("plan"):
+            self._build()
 
     # -- construction --------------------------------------------------------
 
@@ -462,7 +463,7 @@ class SiddhiAppRuntime:
 
     def debug(self):
         """Attach the step debugger (reference: SiddhiAppRuntime.debug:575)."""
-        from .stats import SiddhiDebugger
+        from .telemetry import SiddhiDebugger
         if self._debugger is None:
             self._debugger = SiddhiDebugger(self)
         return self._debugger
@@ -569,51 +570,54 @@ class SiddhiAppRuntime:
         if missing:
             raise ValueError(
                 f"stream {stream_id!r}: send_batch missing columns {missing}")
-        cols: dict = {}
-        to_encode: list = []
-        n = None
-        for a in attrs:
-            v = columns[a.name]
-            if a.type == qast.AttrType.STRING:
-                arr = np.asarray(v)
-                if arr.dtype.kind in "iu":          # pre-encoded dict codes
-                    arr = arr.astype(np.int32, copy=False)
-                else:                               # str values: encode
-                    if arr.ndim != 1:
-                        raise ValueError(
-                            f"stream {stream_id!r}: column {a.name!r} must "
-                            f"be a 1-d array/list of str, got {v!r}")
-                    to_encode.append(a.name)        # ...under the lock (the
-                    arr = arr.tolist()              # StringTable is shared)
+        with self.stats.stage("ingest") as _sp:
+            cols: dict = {}
+            to_encode: list = []
+            n = None
+            for a in attrs:
+                v = columns[a.name]
+                if a.type == qast.AttrType.STRING:
+                    arr = np.asarray(v)
+                    if arr.dtype.kind in "iu":          # pre-encoded dict codes
+                        arr = arr.astype(np.int32, copy=False)
+                    else:                               # str values: encode
+                        if arr.ndim != 1:
+                            raise ValueError(
+                                f"stream {stream_id!r}: column {a.name!r} must "
+                                f"be a 1-d array/list of str, got {v!r}")
+                        to_encode.append(a.name)        # ...under the lock (the
+                        arr = arr.tolist()              # StringTable is shared)
+                else:
+                    arr = np.asarray(v, dtype=_dtype_of(a.type))
+                if isinstance(arr, list):
+                    rows_in = len(arr)
+                elif arr.ndim != 1:
+                    raise ValueError(
+                        f"stream {stream_id!r}: column {a.name!r} must be a "
+                        f"1-d array/list of values, got shape {arr.shape}")
+                else:
+                    rows_in = arr.shape[0]
+                if n is None:
+                    n = rows_in
+                elif rows_in != n:
+                    raise ValueError(
+                        f"stream {stream_id!r}: column {a.name!r} has "
+                        f"{rows_in} rows, expected {n}")
+                cols[a.name] = arr
+            if not n:
+                return
+            if self.stats.enabled:   # row count known only at span close
+                _sp.events = n       # (guard: _NOOP is a shared singleton)
+            if timestamps is None:
+                ts = None
             else:
-                arr = np.asarray(v, dtype=_dtype_of(a.type))
-            if isinstance(arr, list):
-                rows_in = len(arr)
-            elif arr.ndim != 1:
-                raise ValueError(
-                    f"stream {stream_id!r}: column {a.name!r} must be a "
-                    f"1-d array/list of values, got shape {arr.shape}")
-            else:
-                rows_in = arr.shape[0]
-            if n is None:
-                n = rows_in
-            elif rows_in != n:
-                raise ValueError(
-                    f"stream {stream_id!r}: column {a.name!r} has "
-                    f"{rows_in} rows, expected {n}")
-            cols[a.name] = arr
-        if not n:
-            return
-        if timestamps is None:
-            ts = None
-        else:
-            ts = np.atleast_1d(np.asarray(timestamps, dtype=np.int64))
-            if ts.shape[0] == 1 and n > 1:
-                ts = np.full(n, int(ts[0]), dtype=np.int64)
-            if ts.shape[0] != n:
-                raise ValueError(
-                    f"stream {stream_id!r}: {ts.shape[0]} timestamps for "
-                    f"{n} rows")
+                ts = np.atleast_1d(np.asarray(timestamps, dtype=np.int64))
+                if ts.shape[0] == 1 and n > 1:
+                    ts = np.full(n, int(ts[0]), dtype=np.int64)
+                if ts.shape[0] != n:
+                    raise ValueError(
+                        f"stream {stream_id!r}: {ts.shape[0]} timestamps for "
+                        f"{n} rows")
         with self._lock:
             for name in to_encode:      # shared-table writes: locked
                 cols[name] = self.strings.encode_many(cols[name])
@@ -826,33 +830,38 @@ class SiddhiAppRuntime:
                 if not self._pending:
                     continue
             sid, batch = self._pending.pop(0)
-            if self.stats.enabled:
-                self.stats.on_stream_batch(sid, batch.n)
-            for cb in self._batch_callbacks.get(sid, ()):
-                cb(batch)
-            for cb in self._stream_callbacks.get(sid, ()):  # junction callbacks
-                cb(self._decode(batch))
-            fault_err = None
-            for plan in self._subscribers.get(sid, ()):
-                if self._debugger is not None:
-                    self._debugger.check_in(plan, batch)
-                try:
-                    if self.stats.enabled:
-                        with self.stats.time_plan(plan.name, batch.n):
+            # the stream timer opens a batch-trace scope and feeds the
+            # per-stream latency histogram (one clock read per batch)
+            with self.stats.time_stream(sid, batch.n):
+                cbs_b = self._batch_callbacks.get(sid, ())
+                cbs_s = self._stream_callbacks.get(sid, ())
+                if cbs_b or cbs_s:
+                    with self.stats.stage("scatter", events=batch.n):
+                        for cb in cbs_b:
+                            cb(batch)
+                        for cb in cbs_s:    # junction callbacks: each gets
+                            cb(self._decode(batch))   # its own Event list
+                fault_err = None
+                for plan in self._subscribers.get(sid, ()):
+                    if self._debugger is not None:
+                        self._debugger.check_in(plan, batch)
+                    try:
+                        if self.stats.enabled:
+                            with self.stats.time_plan(plan.name, batch.n):
+                                obs = plan.process(sid, batch)
+                        else:
                             obs = plan.process(sid, batch)
-                    else:
-                        obs = plan.process(sid, batch)
-                except Exception as e:
-                    if ("!" + sid) not in self.schemas:
-                        raise
-                    fault_err = e        # route once per batch, below
-                    continue
-                if self._debugger is not None:
-                    self._debugger.check_out(plan, obs)
-                for ob in obs:
-                    self._emit(plan, ob)
-            if fault_err is not None:
-                self._route_fault_batch(sid, batch, fault_err)
+                    except Exception as e:
+                        if ("!" + sid) not in self.schemas:
+                            raise
+                        fault_err = e        # route once per batch, below
+                        continue
+                    if self._debugger is not None:
+                        self._debugger.check_out(plan, obs)
+                    for ob in obs:
+                        self._emit(plan, ob)
+                if fault_err is not None:
+                    self._route_fault_batch(sid, batch, fault_err)
 
     def _route_fault_batch(self, sid: str, batch: EventBatch, err) -> bool:
         """@OnError(action='stream'): reroute a failing batch's events into
@@ -898,12 +907,16 @@ class SiddhiAppRuntime:
             return
         cb_name = getattr(ob, "callback_name", None) \
             or getattr(plan, "callback_name", plan.name)
-        for cb in self._query_callbacks.get(cb_name, ()):
-            events = self._decode(ob.batch)
-            if ob.is_expired:
-                cb(int(ob.batch.timestamps[-1]), None, events)
-            else:
-                cb(int(ob.batch.timestamps[-1]), events, None)
+        cbs = self._query_callbacks.get(cb_name, ())
+        if cbs:
+            with self.stats.stage("scatter", events=ob.batch.n):
+                ts_last = int(ob.batch.timestamps[-1]) if ob.batch.n else 0
+                for cb in cbs:              # fresh Event list per callback:
+                    events = self._decode(ob.batch)   # mutation-safe
+                    if ob.is_expired:
+                        cb(ts_last, None, events)
+                    else:
+                        cb(ts_last, events, None)
         # table targets route through the plan's table writer (reference:
         # OutputParser-chosen Insert/Update/Delete/UpdateOrInsert callbacks)
         if plan.table_writer is not None:
@@ -1130,9 +1143,15 @@ class SiddhiManager:
         self.sink_handler_factory = factory
 
     def create_app_runtime(self, app: Union[str, qast.SiddhiApp]) -> SiddhiAppRuntime:
+        parse_s = 0.0
         if isinstance(app, str):
+            t0 = time.perf_counter()
             app = parse(app)
+            parse_s = time.perf_counter() - t0
         rt = SiddhiAppRuntime(app, self)
+        if parse_s:
+            # measured before the runtime (and its stats manager) existed
+            rt.stats.note_stage("parse", parse_s)
         self._runtimes[rt.app.name] = rt
         return rt
 

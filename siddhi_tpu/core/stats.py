@@ -1,204 +1,26 @@
-"""Statistics trackers + step debugger.
+"""Back-compat shim — the statistics/debugger surface moved to
+`telemetry.py`, which folds the old per-batch trackers into the full
+observability layer (span tracing, latency histograms, device metrics,
+Prometheus exposition).  Import from `siddhi_tpu.core.telemetry` in new
+code; this module re-exports the complete public surface so existing
+imports (and registered reporters) keep working against the SAME
+registries."""
+from .telemetry import (  # noqa: F401
+    Histogram,
+    PROM_LATEST,
+    PipelineTracer,
+    REPORTERS,
+    STAGES,
+    SiddhiDebugger,
+    StatisticsManager,
+    Tracker,
+    XLA_CACHE,
+    register_stats_reporter,
+    render_prometheus,
+)
 
-Reference: core:util/statistics/metrics/SiddhiStatisticsManager.java:35-85
-(Codahale registry with throughput/latency/memory trackers wired into
-StreamJunction.sendEvent:157 and ProcessStreamReceiver.process:88-94);
-core:debugger/SiddhiDebugger.java:36-139 (per-query IN/OUT breakpoints).
-
-Here trackers hang off the runtime's batch dispatch loop — per-batch, not
-per-event, so enabled statistics cost one clock read per (stream, plan)
-batch.  The debugger fires its callback synchronously at micro-batch
-boundaries (the engine's natural step unit) instead of blocking a thread
-on a semaphore."""
-from __future__ import annotations
-
-import time
-from collections import defaultdict
-from typing import Callable, Optional
-
-
-class Tracker:
-    __slots__ = ("events", "batches", "seconds")
-
-    def __init__(self):
-        self.events = 0
-        self.batches = 0
-        self.seconds = 0.0
-
-    def as_dict(self) -> dict:
-        d = {"events": self.events, "batches": self.batches}
-        if self.seconds:
-            d["seconds"] = self.seconds
-            if self.events:
-                d["latency_us_per_event"] = 1e6 * self.seconds / self.events
-            d["throughput_eps"] = (self.events / self.seconds
-                                   if self.seconds else None)
-        return d
-
-
-REPORTERS: dict = {}
-
-
-def register_stats_reporter(name: str, fn, meta=None) -> None:
-    """fn(app_name, report_dict) — the reporter SPI (reference:
-    SiddhiStatisticsManager.java:35-85 console/JMX reporters)."""
-    from ..extension import register_meta
-    register_meta("stats-reporter", meta)
-    REPORTERS[name.lower()] = fn
-
-
-def _console_reporter(app: str, report: dict) -> None:
-    import json as _json
-    print(f"[siddhi-stats] {app}: {_json.dumps(report, default=str)}")
-
-
-def _log_reporter(app: str, report: dict) -> None:
-    import logging
-    logging.getLogger("siddhi_tpu.stats").info("%s: %s", app, report)
-
-
-REPORTERS["console"] = _console_reporter
-REPORTERS["log"] = _log_reporter
-
-
-class StatisticsManager:
-    """Per-stream throughput + per-query latency (+ state memory sizing).
-    `@app:statistics(reporter='console', interval='5 sec')` starts a
-    periodic reporter thread (reference: @app:statistics reporter/interval,
-    SiddhiAppParser.java:108-144)."""
-
-    def __init__(self, rt):
-        self.rt = rt
-        self.enabled = False
-        self.stream_in: dict = defaultdict(Tracker)
-        self.query: dict = defaultdict(Tracker)
-        self._t0 = time.perf_counter()
-        self.reporter = None
-        self.interval_s: float = 5.0
-        self._rep_thread = None
-        self._rep_stop = None
-
-    def configure(self, reporter: str, interval_s: float) -> None:
-        fn = REPORTERS.get((reporter or "console").lower())
-        if fn is None:
-            raise ValueError(f"unknown statistics reporter {reporter!r}; "
-                             f"have {sorted(REPORTERS)}")
-        self.reporter = fn
-        self.interval_s = interval_s
-
-    def start_reporting(self) -> None:
-        import threading
-        if self.reporter is None or self._rep_thread is not None:
-            return
-        self._rep_stop = threading.Event()
-
-        def pump():
-            while not self._rep_stop.wait(self.interval_s):
-                try:
-                    self.reporter(self.rt.app.name, self.report())
-                except Exception:
-                    pass
-        self._rep_thread = threading.Thread(
-            target=pump, name="siddhi-stats-report", daemon=True)
-        self._rep_thread.start()
-
-    def stop_reporting(self) -> None:
-        if self._rep_stop is not None:
-            self._rep_stop.set()
-            self._rep_thread.join(timeout=2)
-            self._rep_thread = None
-            self._rep_stop = None
-
-    def on_stream_batch(self, sid: str, n: int) -> None:
-        t = self.stream_in[sid]
-        t.events += n
-        t.batches += 1
-
-    def time_plan(self, name: str, n: int):
-        """Context manager timing one plan.process batch."""
-        return _PlanTimer(self.query[name], n)
-
-    def memory_bytes(self) -> int:
-        """Approximate retained state size (reference:
-        ObjectSizeCalculator.java:66 — we pickle-size the snapshot)."""
-        import pickle
-        try:
-            return len(pickle.dumps(self.rt._snapshot_locked()))
-        except Exception:
-            return -1
-
-    def report(self) -> dict:
-        up = time.perf_counter() - self._t0
-        return {
-            "uptime_s": up,
-            "streams": {k: v.as_dict() for k, v in self.stream_in.items()},
-            "queries": {k: v.as_dict() for k, v in self.query.items()},
-        }
-
-    def reset(self) -> None:
-        self.stream_in.clear()
-        self.query.clear()
-        self._t0 = time.perf_counter()
-
-
-class _PlanTimer:
-    __slots__ = ("tracker", "n", "start")
-
-    def __init__(self, tracker: Tracker, n: int):
-        self.tracker = tracker
-        self.n = n
-
-    def __enter__(self):
-        self.start = time.perf_counter()
-        return self
-
-    def __exit__(self, *exc):
-        self.tracker.seconds += time.perf_counter() - self.start
-        self.tracker.events += self.n
-        self.tracker.batches += 1
-        return False
-
-
-class SiddhiDebugger:
-    """Micro-batch-boundary breakpoints (reference: SiddhiDebugger.java:36:
-    acquireBreakPoint(query, IN|OUT) + SiddhiDebuggerCallback.debugEvent).
-
-    The callback runs synchronously inside the dispatch loop; inspect live
-    state via runtime.snapshot() / runtime.tables etc. from within it."""
-
-    IN = "in"
-    OUT = "out"
-
-    def __init__(self, rt):
-        self.rt = rt
-        self._breakpoints: set = set()       # (query_name, point)
-        self._callback: Optional[Callable] = None
-
-    def acquire_breakpoint(self, query_name: str, point: str = IN) -> None:
-        if query_name not in self.rt._known_query_names:
-            raise KeyError(f"unknown query {query_name!r}")
-        self._breakpoints.add((query_name, point))
-
-    def release_breakpoint(self, query_name: str, point: str = IN) -> None:
-        self._breakpoints.discard((query_name, point))
-
-    def release_all(self) -> None:
-        self._breakpoints.clear()
-
-    def set_callback(self, fn: Callable) -> None:
-        """fn(query_name, point, events) — events are decoded host Events."""
-        self._callback = fn
-
-    # -- engine hooks --------------------------------------------------------
-
-    def check_in(self, plan, batch) -> None:
-        name = getattr(plan, "callback_name", plan.name)
-        if self._callback and (name, self.IN) in self._breakpoints:
-            self._callback(name, self.IN, self.rt._decode(batch))
-
-    def check_out(self, plan, out_batches: list) -> None:
-        name = getattr(plan, "callback_name", plan.name)
-        if self._callback and (name, self.OUT) in self._breakpoints:
-            for ob in out_batches:
-                if ob.batch.n:
-                    self._callback(name, self.OUT, self.rt._decode(ob.batch))
+__all__ = [
+    "Histogram", "PipelineTracer", "Tracker", "StatisticsManager",
+    "SiddhiDebugger", "REPORTERS", "PROM_LATEST", "STAGES", "XLA_CACHE",
+    "register_stats_reporter", "render_prometheus",
+]

@@ -1,0 +1,779 @@
+"""Deep telemetry: pipeline tracing, latency histograms, device metrics,
+the statistics/reporter SPI, and Prometheus text exposition.
+
+Folds the former `stats.py` trackers into one observability layer
+(reference surface: core:util/statistics/metrics/SiddhiStatisticsManager.java:35-85
+— Codahale registry with throughput/latency/memory trackers — plus
+core:debugger/SiddhiDebugger.java:36-139).  What the reference cannot see
+— and this engine must — are the device-economics quantities that govern
+throughput on TPU (SURVEY §3.3; Simultaneous Finite Automata,
+arxiv 1405.0562): jit compile count/wall-time, kernel-cache hit rates,
+host->device transfer bytes, NFA lane occupancy and state-frontier
+width, and window/join carry-buffer fill.
+
+Layout:
+
+  * `Histogram` — HDR-style fixed log-bucket latency histogram (pure
+    python, no deps): 16 sub-buckets per octave over 1 µs..~4000 s, so
+    p50/p95/p99 carry <= ~4.5 % relative quantile error at O(1)/record.
+  * `Tracker` — per-(stream|query|stage) counter + histogram.
+  * `PipelineTracer` — span-based flight recorder: a bounded ring of the
+    last N batch traces (lex/parse -> plan -> compile -> host-batch-build
+    -> device-dispatch -> block_until_ready -> callback-scatter), with
+    Chrome `trace_event` JSON export.
+  * `StatisticsManager` — hangs off the runtime's batch dispatch loop;
+    enabled statistics cost one clock read per (stream, plan) batch.
+  * reporter SPI (`register_stats_reporter`) with console / log /
+    prometheus reporters; `render_prometheus` emits the text exposition
+    served by `service.py`'s `GET /metrics`.
+  * `SiddhiDebugger` — micro-batch-boundary breakpoints (unchanged).
+
+Pipeline stage names (the leaf spans; `report()["stages"]`):
+  parse, plan, compile, host_build, ingest, kernel, transfer, scatter.
+`kernel` is the jitted dispatch call (async: it returns once the device
+has the work); `transfer` is block_until_ready + the D2H pull, so on the
+async path it includes the device execution wait.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from collections import defaultdict, deque
+from typing import Callable, Optional
+
+STAGES = ("parse", "plan", "compile", "host_build", "ingest", "kernel",
+          "transfer", "scatter")
+
+
+# ---------------------------------------------------------------------------
+# histogram
+# ---------------------------------------------------------------------------
+
+class Histogram:
+    """HDR-style fixed log-bucket histogram over seconds.
+
+    Bucket i covers [MIN * 2^(i/SUB), MIN * 2^((i+1)/SUB)): geometric
+    buckets, SUB per octave — the classic HdrHistogram trade of bounded
+    relative error for O(1) record and a few hundred ints of memory.
+    Values clamp at both ends (1 µs .. ~4000 s)."""
+
+    SUB = 16                       # sub-buckets per octave
+    MIN = 1e-6                     # 1 µs resolution floor
+    OCTAVES = 32                   # ~4300 s ceiling
+    NBUCKETS = SUB * OCTAVES
+
+    __slots__ = ("counts", "count", "sum", "min", "max")
+
+    def __init__(self):
+        self.counts = [0] * self.NBUCKETS
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = 0.0
+
+    def record(self, seconds: float) -> None:
+        if seconds < 0.0:
+            seconds = 0.0
+        self.count += 1
+        self.sum += seconds
+        if seconds < self.min:
+            self.min = seconds
+        if seconds > self.max:
+            self.max = seconds
+        if seconds <= self.MIN:
+            i = 0
+        else:
+            i = int(math.log2(seconds / self.MIN) * self.SUB)
+            if i >= self.NBUCKETS:
+                i = self.NBUCKETS - 1
+        self.counts[i] += 1
+
+    @classmethod
+    def bucket_hi(cls, i: int) -> float:
+        """Upper bound (seconds) of bucket i."""
+        return cls.MIN * 2.0 ** ((i + 1) / cls.SUB)
+
+    def percentile(self, p: float) -> Optional[float]:
+        """p in [0, 100] -> seconds (bucket upper bound, clamped to the
+        observed max so a lone sample reports itself exactly)."""
+        if not self.count:
+            return None
+        target = max(1, math.ceil(self.count * p / 100.0))
+        acc = 0
+        for i, c in enumerate(self.counts):
+            if not c:
+                continue
+            acc += c
+            if acc >= target:
+                return min(self.bucket_hi(i), self.max)
+        return self.max
+
+    def quantiles(self, ps=(50, 95, 99)) -> dict:
+        return {p: self.percentile(p) for p in ps}
+
+    def reset(self) -> None:
+        self.counts = [0] * self.NBUCKETS
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = 0.0
+
+
+# ---------------------------------------------------------------------------
+# trackers
+# ---------------------------------------------------------------------------
+
+class Tracker:
+    __slots__ = ("events", "batches", "seconds", "hist")
+
+    def __init__(self):
+        self.events = 0
+        self.batches = 0
+        self.seconds = 0.0
+        self.hist = Histogram()
+
+    def observe(self, seconds: float, events: int = 0) -> None:
+        """One timed batch."""
+        self.events += events
+        self.batches += 1
+        self.seconds += seconds
+        self.hist.record(seconds)
+
+    def as_dict(self) -> dict:
+        d = {"events": self.events, "batches": self.batches}
+        if self.seconds:
+            d["seconds"] = self.seconds
+            if self.events:
+                d["latency_us_per_event"] = 1e6 * self.seconds / self.events
+            # key OMITTED (not None) when seconds is falsy: a consumer
+            # summing/dividing report values must not meet nulls
+            d["throughput_eps"] = self.events / self.seconds
+        if self.hist.count:
+            for p in (50, 95, 99):
+                v = self.hist.percentile(p)
+                if v is not None:
+                    d[f"p{p}_ms"] = round(v * 1e3, 4)
+        return d
+
+
+# ---------------------------------------------------------------------------
+# span tracing / flight recorder
+# ---------------------------------------------------------------------------
+
+class PipelineTracer:
+    """Bounded in-memory flight recorder of the last N batch traces.
+
+    A "batch trace" is the list of stage spans recorded while one
+    micro-batch moved through the dispatch loop; spans recorded outside
+    a batch scope (parse/plan/compile at build time) become standalone
+    one-span traces.  Span nesting is positional — Chrome's trace viewer
+    reconstructs parent/child from (ts, dur) containment per thread, so
+    the recorder stores flat (name, t0, dur, plan) tuples."""
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = capacity
+        self.enabled = False
+        self.traces: deque = deque(maxlen=capacity)
+        self._tls = threading.local()
+        self._t0 = time.perf_counter()
+
+    # -- batch scope -------------------------------------------------------
+
+    def begin_batch(self, label: str) -> None:
+        if not self.enabled:
+            return
+        self._tls.spans = []
+        self._tls.label = label
+        self._tls.bt0 = time.perf_counter()
+
+    def end_batch(self) -> None:
+        if not self.enabled:
+            return
+        spans = getattr(self._tls, "spans", None)
+        if spans is None:
+            return
+        now = time.perf_counter()
+        self.traces.append({
+            "label": self._tls.label,
+            "t0": self._tls.bt0 - self._t0,
+            "dur": now - self._tls.bt0,
+            "tid": threading.get_ident() % 100_000,
+            "spans": spans,
+        })
+        self._tls.spans = None
+
+    def add(self, name: str, t0: float, dur: float,
+            plan: Optional[str] = None) -> None:
+        if not self.enabled:
+            return
+        rec = (name, t0 - self._t0, dur, plan)
+        spans = getattr(self._tls, "spans", None)
+        if spans is None:            # standalone span (build-time etc.)
+            self.traces.append({
+                "label": name, "t0": t0 - self._t0, "dur": dur,
+                "tid": threading.get_ident() % 100_000, "spans": [rec]})
+        else:
+            spans.append(rec)
+
+    # -- export ------------------------------------------------------------
+
+    def chrome_trace(self) -> list:
+        """Chrome `trace_event` JSON (the array form): load via
+        chrome://tracing or https://ui.perfetto.dev."""
+        evs = []
+        for tr in list(self.traces):
+            evs.append({"name": tr["label"], "cat": "batch", "ph": "X",
+                        "ts": round(tr["t0"] * 1e6, 1),
+                        "dur": round(tr["dur"] * 1e6, 1),
+                        "pid": 1, "tid": tr["tid"]})
+            for name, t0, dur, plan in tr["spans"]:
+                ev = {"name": name, "cat": "stage", "ph": "X",
+                      "ts": round(t0 * 1e6, 1), "dur": round(dur * 1e6, 1),
+                      "pid": 1, "tid": tr["tid"]}
+                if plan:
+                    ev["args"] = {"plan": plan}
+                evs.append(ev)
+        return evs
+
+    def export_chrome_trace(self, path: str) -> int:
+        evs = self.chrome_trace()
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(evs, f)
+        os.replace(tmp, path)
+        return len(evs)
+
+    def reset(self) -> None:
+        self.traces.clear()
+
+
+class _Noop:
+    seconds = 0.0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _Noop()
+
+
+class _StageTimer:
+    __slots__ = ("mgr", "name", "events", "plan", "t0", "seconds")
+
+    def __init__(self, mgr, name, events, plan):
+        self.mgr = mgr
+        self.name = name
+        self.events = events
+        self.plan = plan
+        self.seconds = 0.0
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self.t0
+        self.seconds = dt
+        self.mgr.stages[self.name].observe(dt, self.events)
+        self.mgr.tracer.add(self.name, self.t0, dt, plan=self.plan)
+        return False
+
+
+class _PlanTimer:
+    __slots__ = ("mgr", "name", "n", "start")
+
+    def __init__(self, mgr, name, n):
+        self.mgr = mgr
+        self.name = name
+        self.n = n
+
+    def __enter__(self):
+        self.start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self.start
+        self.mgr.query[self.name].observe(dt, self.n)
+        self.mgr.tracer.add(f"query:{self.name}", self.start, dt)
+        return False
+
+
+class _StreamTimer:
+    __slots__ = ("mgr", "sid", "n", "start")
+
+    def __init__(self, mgr, sid, n):
+        self.mgr = mgr
+        self.sid = sid
+        self.n = n
+
+    def __enter__(self):
+        self.mgr.tracer.begin_batch(f"{self.sid} x{self.n}")
+        self.start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self.start
+        self.mgr.stream_in[self.sid].observe(dt, self.n)
+        self.mgr.tracer.end_batch()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# XLA persistent-cache observation (process-global, best-effort)
+# ---------------------------------------------------------------------------
+
+XLA_CACHE = {"hits": 0, "misses": 0}
+
+
+def _watch_xla_cache() -> None:
+    """Count the persistent compilation cache's hit/miss events (the
+    disk cache enabled by `_enable_kernel_cache`).  Event names are jax
+    internals — match loosely and tolerate absence."""
+    try:
+        from jax._src import monitoring as _mon
+
+        def _listener(event, *a, **k):
+            if "cache_hit" in event:
+                XLA_CACHE["hits"] += 1
+            elif "cache_miss" in event:
+                XLA_CACHE["misses"] += 1
+        _mon.register_event_listener(_listener)
+    except Exception:      # pragma: no cover - observation is best-effort
+        pass
+
+
+_watch_xla_cache()
+
+
+# ---------------------------------------------------------------------------
+# kernel-call instrumentation helper (shared by the device modules)
+# ---------------------------------------------------------------------------
+
+def env_nbytes(env) -> int:
+    """Host->device payload size of one kernel argument dict."""
+    try:
+        return sum(int(getattr(v, "nbytes", 0)) for v in env.values())
+    except Exception:
+        return 0
+
+
+def call_kernel(stats, plan: str, fn, args: tuple, *, cache_hit: bool,
+                nbytes: int = 0):
+    """Invoke a jitted kernel `fn(*args)` recording: per-plan fn-cache
+    hit/miss, H2D bytes, and a `compile` (fn-cache miss — the call that
+    pays trace + XLA compilation) or `kernel` (steady-state dispatch)
+    stage span.  Classification rides the caller's cache probe so a
+    block compiled while stats were off is never misreported as a
+    compile after `enable_stats(True)`."""
+    if stats is None or not stats.enabled:
+        return fn(*args)
+    stats.on_kernel_cache(plan, cache_hit)
+    if nbytes:
+        stats.add_transfer_bytes(plan, nbytes)
+    with stats.stage("kernel" if cache_hit else "compile", plan=plan) as sp:
+        out = fn(*args)
+    if not cache_hit:
+        stats.on_compile(plan, sp.seconds)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# reporter SPI
+# ---------------------------------------------------------------------------
+
+REPORTERS: dict = {}
+
+# latest Prometheus exposition per app, refreshed by the `prometheus`
+# reporter (scrape-side consumers can also hit service.py's GET /metrics,
+# which renders live instead)
+PROM_LATEST: dict = {}
+
+# latest raw report per app — the $SIDDHI_PROM_FILE writer renders ALL
+# apps from here so concurrent reporters don't clobber each other's series
+_PROM_REPORTS: dict = {}
+
+
+def register_stats_reporter(name: str, fn, meta=None) -> None:
+    """fn(app_name, report_dict) — the reporter SPI (reference:
+    SiddhiStatisticsManager.java:35-85 console/JMX reporters).
+    Re-registering a name overrides it."""
+    from ..extension import register_meta
+    register_meta("stats-reporter", meta)
+    REPORTERS[name.lower()] = fn
+
+
+def _console_reporter(app: str, report: dict) -> None:
+    import sys
+    print(f"[siddhi-stats] {app}: {json.dumps(report, default=str)}",
+          file=sys.stderr)
+
+
+def _log_reporter(app: str, report: dict) -> None:
+    import logging
+    logging.getLogger("siddhi_tpu.stats").info("%s: %s", app, report)
+
+
+def _prometheus_reporter(app: str, report: dict) -> None:
+    """Render the report as Prometheus text exposition; kept in
+    PROM_LATEST[app] and (optionally) written atomically to
+    $SIDDHI_PROM_FILE for file-based scrape setups (node_exporter
+    textfile collector).  The file always carries EVERY reporting app
+    (rendered from the latest report of each), so two runtimes sharing
+    one process don't alternate-clobber each other's series."""
+    PROM_LATEST[app] = render_prometheus({app: report})
+    _PROM_REPORTS[app] = report
+    path = os.environ.get("SIDDHI_PROM_FILE")
+    if path:
+        try:
+            text = render_prometheus(dict(sorted(_PROM_REPORTS.items())))
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(text)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+
+REPORTERS["console"] = _console_reporter
+REPORTERS["log"] = _log_reporter
+REPORTERS["prometheus"] = _prometheus_reporter
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+_DEV_COUNTERS = {
+    "compiles": ("siddhi_tpu_jit_compiles_total",
+                 "jit kernel compilations per plan"),
+    "compile_seconds": ("siddhi_tpu_jit_compile_seconds_total",
+                        "wall time spent in jit compilation per plan"),
+    "cache_hits": ("siddhi_tpu_kernel_cache_hits_total",
+                   "per-plan jitted-block cache hits"),
+    "cache_misses": ("siddhi_tpu_kernel_cache_misses_total",
+                     "per-plan jitted-block cache misses"),
+    "h2d_bytes": ("siddhi_tpu_h2d_transfer_bytes_total",
+                  "host->device payload bytes shipped per plan"),
+}
+
+
+def _esc(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n",
+                                                                    "\\n")
+
+
+def _fmt(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    if f != f or f in (math.inf, -math.inf):
+        return "NaN" if f != f else ("+Inf" if f > 0 else "-Inf")
+    return repr(f)
+
+
+class _Prom:
+    """Accumulates samples grouped per metric so # HELP / # TYPE render
+    exactly once per metric name (the exposition-format requirement)."""
+
+    def __init__(self):
+        self.metrics: dict = {}          # name -> (type, help, [samples])
+
+    def add(self, name, mtype, help_, labels: dict, value,
+            suffix: str = "") -> None:
+        if value is None:
+            return
+        ent = self.metrics.setdefault(name, (mtype, help_, []))
+        lab = ",".join(f'{k}="{_esc(v)}"' for k, v in labels.items())
+        ent[2].append(f"{name}{suffix}{{{lab}}} {_fmt(value)}"
+                      if lab else f"{name}{suffix} {_fmt(value)}")
+
+    def render(self) -> str:
+        out = []
+        for name, (mtype, help_, samples) in self.metrics.items():
+            out.append(f"# HELP {name} {help_}")
+            out.append(f"# TYPE {name} {mtype}")
+            out.extend(samples)
+        return "\n".join(out) + "\n"
+
+
+def _summary(doc: _Prom, name: str, help_: str, labels: dict, td: dict):
+    """One tracker dict -> a Prometheus summary (quantiles + _sum/_count)."""
+    for q, key in ((0.5, "p50_ms"), (0.95, "p95_ms"), (0.99, "p99_ms")):
+        if key in td:
+            doc.add(name, "summary", help_,
+                    {**labels, "quantile": str(q)}, td[key] / 1e3)
+    doc.add(name, "summary", help_, labels, td.get("seconds", 0.0),
+            suffix="_sum")
+    doc.add(name, "summary", help_, labels, td.get("batches", 0),
+            suffix="_count")
+
+
+def render_prometheus(reports: dict) -> str:
+    """reports: {app_name: StatisticsManager.report() dict} ->
+    Prometheus text exposition (format 0.0.4)."""
+    doc = _Prom()
+    for app, rep in reports.items():
+        al = {"app": app}
+        doc.add("siddhi_tpu_uptime_seconds", "gauge",
+                "runtime uptime", al, rep.get("uptime_s"))
+        for sid, td in rep.get("streams", {}).items():
+            sl = {**al, "stream": sid}
+            doc.add("siddhi_tpu_events_total", "counter",
+                    "events ingested per stream", sl, td.get("events", 0))
+            doc.add("siddhi_tpu_batches_total", "counter",
+                    "micro-batches dispatched per stream", sl,
+                    td.get("batches", 0))
+            if "p50_ms" in td:
+                _summary(doc, "siddhi_tpu_stream_latency_seconds",
+                         "per-batch dispatch latency per stream", sl, td)
+        for qn, td in rep.get("queries", {}).items():
+            ql = {**al, "query": qn}
+            doc.add("siddhi_tpu_query_events_total", "counter",
+                    "events processed per query", ql, td.get("events", 0))
+            _summary(doc, "siddhi_tpu_query_latency_seconds",
+                     "per-batch processing latency per query", ql, td)
+        for st, td in rep.get("stages", {}).items():
+            _summary(doc, "siddhi_tpu_stage_latency_seconds",
+                     "per-span latency per pipeline stage",
+                     {**al, "stage": st}, td)
+        for plan, m in rep.get("device", {}).items():
+            pl = {**al, "plan": plan}
+            for key, v in m.items():
+                if key in _DEV_COUNTERS:
+                    name, help_ = _DEV_COUNTERS[key]
+                    doc.add(name, "counter", help_, pl, v)
+                elif isinstance(v, (int, float)):
+                    doc.add("siddhi_tpu_device", "gauge",
+                            "device-side gauges (lane occupancy, frontier "
+                            "width, buffer fill, drops)",
+                            {**pl, "metric": key}, v)
+    # process-wide (not per-app): emitted ONCE, unlabeled — an app label
+    # would duplicate the same counter N times across a multi-app scrape
+    # and N-fold overcount any PromQL sum()
+    xc = next((r["xla_cache"] for r in reports.values()
+               if r.get("xla_cache")), None)
+    if xc:
+        doc.add("siddhi_tpu_xla_cache_hits_total", "counter",
+                "persistent XLA compilation cache hits (process-wide)",
+                {}, xc.get("hits", 0))
+        doc.add("siddhi_tpu_xla_cache_misses_total", "counter",
+                "persistent XLA compilation cache misses (process-wide)",
+                {}, xc.get("misses", 0))
+    return doc.render()
+
+
+# ---------------------------------------------------------------------------
+# the statistics manager
+# ---------------------------------------------------------------------------
+
+class StatisticsManager:
+    """Per-stream throughput + per-query and per-stage latency histograms
+    (+ device metrics + flight recorder).
+    `@app:statistics(reporter='console', interval='5 sec')` starts a
+    periodic reporter thread (reference: @app:statistics reporter/interval,
+    SiddhiAppParser.java:108-144)."""
+
+    def __init__(self, rt):
+        self.rt = rt
+        self.enabled = False
+        self.stream_in: dict = defaultdict(Tracker)
+        self.query: dict = defaultdict(Tracker)
+        self.stages: dict = defaultdict(Tracker)
+        self.device: dict = defaultdict(lambda: defaultdict(float))
+        self.tracer = PipelineTracer()
+        self._t0 = time.perf_counter()
+        self.reporter = None
+        self.interval_s: float = 5.0
+        self._rep_thread = None
+        self._rep_stop = None
+
+    # -- reporters -----------------------------------------------------------
+
+    def configure(self, reporter: str, interval_s: float) -> None:
+        fn = REPORTERS.get((reporter or "console").lower())
+        if fn is None:
+            raise ValueError(f"unknown statistics reporter {reporter!r}; "
+                             f"have {sorted(REPORTERS)}")
+        self.reporter = fn
+        self.interval_s = interval_s
+
+    def start_reporting(self) -> None:
+        if self.reporter is None or self._rep_thread is not None:
+            return
+        self._rep_stop = threading.Event()
+
+        def pump():
+            while not self._rep_stop.wait(self.interval_s):
+                try:
+                    self.reporter(self.rt.app.name, self.report())
+                except Exception:
+                    pass
+        self._rep_thread = threading.Thread(
+            target=pump, name="siddhi-stats-report", daemon=True)
+        self._rep_thread.start()
+
+    def stop_reporting(self) -> None:
+        if self._rep_stop is not None:
+            self._rep_stop.set()
+            self._rep_thread.join(timeout=2)
+            self._rep_thread = None
+            self._rep_stop = None
+
+    # -- recording hooks -----------------------------------------------------
+
+    def time_stream(self, sid: str, n: int):
+        """Times one micro-batch's full pass through the dispatch loop
+        (callbacks + every subscribed plan) and opens a batch-trace scope."""
+        if not self.enabled:
+            return _NOOP
+        return _StreamTimer(self, sid, n)
+
+    def time_plan(self, name: str, n: int):
+        """Context manager timing one plan.process batch."""
+        return _PlanTimer(self, name, n)
+
+    def stage(self, name: str, events: int = 0, plan: Optional[str] = None):
+        """Context manager timing one pipeline-stage span."""
+        if not self.enabled:
+            return _NOOP
+        return _StageTimer(self, name, events, plan)
+
+    def note_stage(self, name: str, seconds: float, events: int = 0) -> None:
+        """Record an already-measured span (parse time measured before
+        the runtime — and its stats manager — existed)."""
+        if not self.enabled:
+            return
+        self.stages[name].observe(seconds, events)
+
+    def on_kernel_cache(self, plan: str, hit: bool) -> None:
+        if self.enabled:
+            self.device[plan]["cache_hits" if hit else "cache_misses"] += 1
+
+    def on_compile(self, plan: str, seconds: float) -> None:
+        if self.enabled:
+            d = self.device[plan]
+            d["compiles"] += 1
+            d["compile_seconds"] += seconds
+
+    def add_transfer_bytes(self, plan: str, nbytes: int) -> None:
+        if self.enabled:
+            self.device[plan]["h2d_bytes"] += nbytes
+
+    # -- reporting -----------------------------------------------------------
+
+    def memory_bytes(self) -> int:
+        """Approximate retained state size (reference:
+        ObjectSizeCalculator.java:66 — we pickle-size the snapshot)."""
+        import pickle
+        try:
+            return len(pickle.dumps(self.rt._snapshot_locked()))
+        except Exception:
+            return -1
+
+    def device_report(self) -> dict:
+        """Per-plan device metrics: the accumulated counters merged with
+        each plan's sampled gauges (lane occupancy, frontier width,
+        buffer fill) — sampled on demand, one D2H pull per stateful
+        plan, so scrapes pay the cost, not the hot path."""
+        out = {name: {k: (int(v) if float(v).is_integer() else v)
+                      for k, v in ctr.items()}
+               for name, ctr in self.device.items()}
+        for p in getattr(self.rt, "_plans", ()):
+            dm = getattr(p, "device_metrics", None)
+            if dm is None:
+                continue
+            try:
+                m = dm()
+            except Exception:
+                continue
+            if m:
+                out.setdefault(p.name, {}).update(m)
+        return out
+
+    def report(self) -> dict:
+        up = time.perf_counter() - self._t0
+        rep = {
+            "uptime_s": up,
+            "streams": {k: v.as_dict() for k, v in self.stream_in.items()},
+            "queries": {k: v.as_dict() for k, v in self.query.items()},
+            "stages": {k: v.as_dict() for k, v in self.stages.items()},
+        }
+        dev = self.device_report()
+        if dev:
+            rep["device"] = dev
+        if XLA_CACHE["hits"] or XLA_CACHE["misses"]:
+            rep["xla_cache"] = dict(XLA_CACHE)
+        return rep
+
+    def prometheus(self) -> str:
+        return render_prometheus({self.rt.app.name: self.report()})
+
+    def export_chrome_trace(self, path: str) -> int:
+        """Write the flight recorder as Chrome trace_event JSON; returns
+        the event count."""
+        return self.tracer.export_chrome_trace(path)
+
+    def reset(self) -> None:
+        self.stream_in.clear()
+        self.query.clear()
+        self.stages.clear()
+        self.device.clear()
+        self.tracer.reset()
+        self._t0 = time.perf_counter()
+
+
+# ---------------------------------------------------------------------------
+# debugger (unchanged surface)
+# ---------------------------------------------------------------------------
+
+class SiddhiDebugger:
+    """Micro-batch-boundary breakpoints (reference: SiddhiDebugger.java:36:
+    acquireBreakPoint(query, IN|OUT) + SiddhiDebuggerCallback.debugEvent).
+
+    The callback runs synchronously inside the dispatch loop; inspect live
+    state via runtime.snapshot() / runtime.tables etc. from within it."""
+
+    IN = "in"
+    OUT = "out"
+
+    def __init__(self, rt):
+        self.rt = rt
+        self._breakpoints: set = set()       # (query_name, point)
+        self._callback: Optional[Callable] = None
+
+    def acquire_breakpoint(self, query_name: str, point: str = IN) -> None:
+        if query_name not in self.rt._known_query_names:
+            raise KeyError(f"unknown query {query_name!r}")
+        self._breakpoints.add((query_name, point))
+
+    def release_breakpoint(self, query_name: str, point: str = IN) -> None:
+        self._breakpoints.discard((query_name, point))
+
+    def release_all(self) -> None:
+        self._breakpoints.clear()
+
+    def set_callback(self, fn: Callable) -> None:
+        """fn(query_name, point, events) — events are decoded host Events."""
+        self._callback = fn
+
+    # -- engine hooks --------------------------------------------------------
+
+    def check_in(self, plan, batch) -> None:
+        name = getattr(plan, "callback_name", plan.name)
+        if self._callback and (name, self.IN) in self._breakpoints:
+            self._callback(name, self.IN, self.rt._decode(batch))
+
+    def check_out(self, plan, out_batches: list) -> None:
+        name = getattr(plan, "callback_name", plan.name)
+        if self._callback and (name, self.OUT) in self._breakpoints:
+            for ob in out_batches:
+                if ob.batch.n:
+                    self._callback(name, self.OUT, self.rt._decode(ob.batch))

@@ -45,6 +45,7 @@ from .expr import (CompiledExpr, ExprError, SingleStreamContext,
 from .planner import (AGGREGATOR_NAMES, OutputBatch, PlanError, QueryPlan,
                       selector_has_aggregators)
 from .schema import StreamSchema, TIMESTAMP_DTYPE, dtype_of
+from .telemetry import call_kernel, env_nbytes
 
 
 class DeviceWindowUnsupported(Exception):
@@ -913,24 +914,25 @@ class DeviceWindowAggPlan(QueryPlan):
     def process(self, stream_id: str, batch: EventBatch) -> list:
         if batch.n == 0:
             return []
-        T = pow2_at_least(batch.n)
-        if self.mesh is not None:
-            # the sharded 't' axis must divide the device count
-            T = max(T, self.mesh.devices.size)
-        env = {"__nvalid__": np.int32(batch.n)}
-        if self._needs_ts:
-            base = int(batch.timestamps[0])
-            off = batch.timestamps - base
-            wide = bool(batch.n and (off.max() >= 2**31
-                                     or off.min() < -2**31))
-            env["__ts_off__"] = _pad(off.astype(
-                np.int64 if wide else np.int32), T, 0)
-            env["__ts_base__"] = np.int64(base)
-        for c in self.cols:
-            col = batch.columns[c]
-            if not self.f64 and col.dtype == np.float64:
-                col = col.astype(np.float32)     # device DOUBLE policy
-            env[c] = _pad(col, T, 0)
+        with self.rt.stats.stage("host_build", plan=self.name):
+            T = pow2_at_least(batch.n)
+            if self.mesh is not None:
+                # the sharded 't' axis must divide the device count
+                T = max(T, self.mesh.devices.size)
+            env = {"__nvalid__": np.int32(batch.n)}
+            if self._needs_ts:
+                base = int(batch.timestamps[0])
+                off = batch.timestamps - base
+                wide = bool(batch.n and (off.max() >= 2**31
+                                         or off.min() < -2**31))
+                env["__ts_off__"] = _pad(off.astype(
+                    np.int64 if wide else np.int32), T, 0)
+                env["__ts_base__"] = np.int64(base)
+            for c in self.cols:
+                col = batch.columns[c]
+                if not self.f64 and col.dtype == np.float64:
+                    col = col.astype(np.float32)     # device DOUBLE policy
+                env[c] = _pad(col, T, 0)
         self._inflight.append(self._dispatch(env, batch, T))
         outs: list = []
         # depth-D pipeline (opt-in @app:devicePipeline): batch i's pull
@@ -949,8 +951,14 @@ class DeviceWindowAggPlan(QueryPlan):
 
     def _dispatch(self, env: dict, batch: EventBatch, T: int) -> dict:
         pre = self.state
-        fn = self._step_fn(T, self.C)
-        res = fn(self.state, env)
+        if not self.rt.stats.enabled:
+            res = self._step_fn(T, self.C)(self.state, env)
+        else:
+            hit = (T, self.C) in getattr(self, "_step_cache", {})
+            fn = self._step_fn(T, self.C)
+            res = call_kernel(
+                self.rt.stats, self.name, fn, (self.state, env),
+                cache_hit=hit, nbytes=env_nbytes(env))
         for key in ("b", "i", "f"):
             if key in res:
                 try:    # start the D2H pull while the device computes
@@ -965,11 +973,12 @@ class DeviceWindowAggPlan(QueryPlan):
         bpack = None
         while True:
             res = entry["res"]
-            if slim:
-                bpack = np.asarray(res["b"])
-                overflow = int(bpack[0])
-            else:
-                overflow = int(np.asarray(res["i"])[0, 0])
+            with self.rt.stats.stage("transfer", plan=self.name):
+                if slim:
+                    bpack = np.asarray(res["b"])
+                    overflow = int(bpack[0])
+                else:
+                    overflow = int(np.asarray(res["i"])[0, 0])
             if not overflow:
                 break
             # carry overflow: grow C and replay this entry plus everything
@@ -982,8 +991,9 @@ class DeviceWindowAggPlan(QueryPlan):
                       for e in chain]
             entry = redone[0]
             self._inflight = redone[1:]
-        ipack = np.asarray(res["i"]) if "i" in res else None
-        fpack = np.asarray(res["f"]) if "f" in res else None
+        with self.rt.stats.stage("transfer", plan=self.name):
+            ipack = np.asarray(res["i"]) if "i" in res else None
+            fpack = np.asarray(res["f"]) if "f" in res else None
         batch = entry["batch"]
         T = entry["T"]
         from .nfa_device import join64_np
@@ -1071,6 +1081,15 @@ class DeviceWindowAggPlan(QueryPlan):
         return ts_out, cols
 
     # -- snapshot -------------------------------------------------------------
+
+    def device_metrics(self) -> dict:
+        """Sampled carry-buffer fill (one D2H pull of the valid mask)."""
+        try:
+            fill = int(np.asarray(self.state["valid"]).sum())
+        except Exception:
+            return {}
+        return {"window_capacity": int(self.C), "window_fill": fill,
+                "window_fill_ratio": round(fill / max(self.C, 1), 4)}
 
     def state_dict(self) -> dict:
         return {"state": {k: np.asarray(v) for k, v in self.state.items()},

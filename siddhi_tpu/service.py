@@ -12,6 +12,12 @@ Endpoints (JSON unless noted):
                                      "timestamp": optional ms}
   POST /siddhi/artifact/query       {"app": ..., "query": "from T select ..."}
   GET  /siddhi/artifact/stats?siddhiApp=<name>
+  GET  /metrics[?siddhiApp=<name>]  Prometheus text exposition (0.0.4) over
+                                    every deployed app (or just <name>)
+
+Deployed runtimes run with statistics ENABLED (a served engine is meant
+to be scraped; one clock read per micro-batch) unless the app itself
+says `@app:statistics('false')`.
 
 Run:  python -m siddhi_tpu.service [port]     (or SiddhiService(port).start())
 """
@@ -24,6 +30,10 @@ from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
 from . import SiddhiManager
+from .core.telemetry import render_prometheus
+from .query import ast as qast
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 class SiddhiService:
@@ -40,6 +50,15 @@ class SiddhiService:
                 blob = json.dumps(body).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def _reply_text(self, code: int, text: str,
+                            ctype: str = PROM_CONTENT_TYPE) -> None:
+                blob = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(blob)))
                 self.end_headers()
                 self.wfile.write(blob)
@@ -81,7 +100,18 @@ class SiddhiService:
                         self._reply(200, {"apps": sorted(service.runtimes)})
                     elif u.path == "/siddhi/artifact/stats":
                         app = q.get("siddhiApp", [None])[0]
-                        self._reply(200, service.stats(app))
+                        if app not in service.runtimes:
+                            self._reply(404, {"error":
+                                              f"no deployed app {app!r}"})
+                        else:
+                            self._reply(200, service.stats(app))
+                    elif u.path == "/metrics":
+                        app = q.get("siddhiApp", [None])[0]
+                        if app is not None and app not in service.runtimes:
+                            self._reply(404, {"error":
+                                              f"no deployed app {app!r}"})
+                        else:
+                            self._reply_text(200, service.metrics(app))
                     else:
                         self._reply(404, {"error": f"no route {u.path}"})
                 except Exception as e:
@@ -96,6 +126,11 @@ class SiddhiService:
     def deploy(self, app_text: str) -> str:
         rt = self.manager.create_app_runtime(app_text)
         name = rt.app.name
+        # served runtimes default statistics ON (the /metrics scrape is
+        # the point of running as a service); an @app:statistics annotation
+        # of any flavor was already applied by the runtime constructor
+        if qast.find_annotation(rt.app.annotations, "app:statistics") is None:
+            rt.enable_stats(True)
         old = self.runtimes.pop(name, None)
         if old is not None:
             old.shutdown()
@@ -118,6 +153,13 @@ class SiddhiService:
 
     def stats(self, app: str) -> dict:
         return self.runtimes[app].stats.report()
+
+    def metrics(self, app: Optional[str] = None) -> str:
+        """Prometheus text exposition rendered LIVE from every deployed
+        runtime's statistics (or just `app`'s when given)."""
+        names = [app] if app is not None else sorted(self.runtimes)
+        return render_prometheus(
+            {n: self.runtimes[n].stats.report() for n in names})
 
     # -- lifecycle --------------------------------------------------------
 

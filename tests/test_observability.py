@@ -49,6 +49,210 @@ def test_statistics_runtime_toggle(mgr):
     assert rt.statistics()["streams"]["S"]["events"] == 1
 
 
+def test_histogram_quantiles():
+    from siddhi_tpu.core.telemetry import Histogram
+    h = Histogram()
+    assert h.percentile(99) is None          # empty -> None, never 0
+    for ms in (1, 2, 5, 10, 100):
+        h.record(ms / 1e3)
+    # log-bucket bound: reported quantile within ~2^(1/16) of exact
+    assert 0.001 <= h.percentile(50) <= 0.0055
+    assert 0.05 <= h.percentile(99) <= 0.1001
+    assert h.percentile(100) == h.max
+    one = Histogram()
+    one.record(0.25)
+    assert one.percentile(99) == 0.25        # lone sample: exact (clamped)
+    one.reset()
+    assert one.count == 0 and one.percentile(50) is None
+
+
+def test_tracker_as_dict_guards():
+    """No null-valued keys: throughput/latency OMITTED when nothing was
+    timed (a consumer summing report values must not meet None)."""
+    from siddhi_tpu.core.telemetry import Tracker
+    t = Tracker()
+    t.events, t.batches = 10, 1              # counted but never timed
+    d = t.as_dict()
+    assert "throughput_eps" not in d and "latency_us_per_event" not in d
+    assert None not in d.values()
+    t.observe(0.5, events=10)
+    d = t.as_dict()
+    assert d["throughput_eps"] == pytest.approx(20 / 0.5)
+    assert d["latency_us_per_event"] == pytest.approx(1e6 * 0.5 / 20)
+    zero_ev = Tracker()
+    zero_ev.observe(0.5, events=0)           # timed but empty batch
+    d = zero_ev.as_dict()
+    assert "latency_us_per_event" not in d and None not in d.values()
+
+
+def test_statistics_percentiles(mgr):
+    rt = mgr.create_app_runtime("""
+        @app:statistics('true')
+        define stream S (x int);
+        @info(name='q1') from S[x > 0] select x insert into O;
+    """)
+    collect(rt, "O")
+    import numpy as np
+    h = rt.input_handler("S")
+    for i in range(4):
+        h.send_batch({"x": np.arange(1, 6, dtype=np.int32)})
+    rt.flush()
+    rep = rt.statistics()
+    for scope, key in (("streams", "S"), ("queries", "q1"),
+                       ("stages", "scatter")):
+        td = rep[scope][key]
+        assert td["p50_ms"] <= td["p95_ms"] <= td["p99_ms"]
+    assert rep["stages"]["ingest"]["events"] == 20   # columnar ingest span
+    assert rep["stages"]["plan"]["batches"] == 1     # build-time span
+
+
+def test_reporter_spi_register_and_override(mgr):
+    from siddhi_tpu.core.telemetry import REPORTERS, register_stats_reporter
+    calls_a, calls_b = [], []
+    register_stats_reporter("spiTest", lambda app, rep: calls_a.append(app))
+    assert REPORTERS["spitest"] is not None          # name lowercased
+    register_stats_reporter("SPITest",
+                            lambda app, rep: calls_b.append(app))  # override
+    rt = mgr.create_app_runtime("""
+        @app:name('SpiApp')
+        @app:statistics(reporter='spiTest', interval='20 milliseconds')
+        define stream S (x int);
+        from S select x insert into O;
+    """)
+    assert rt.stats.reporter is REPORTERS["spitest"]
+    rt.stats.reporter("SpiApp", rt.statistics())
+    assert calls_b == ["SpiApp"] and calls_a == []   # override won
+    del REPORTERS["spitest"]
+
+
+def test_unknown_reporter_rejected(mgr):
+    with pytest.raises(Exception, match="unknown statistics reporter"):
+        mgr.create_app_runtime("""
+            @app:statistics(reporter='nosuch', interval='1 sec')
+            define stream S (x int);
+            from S select x insert into O;
+        """)
+
+
+def test_periodic_reporting_and_clean_stop(mgr):
+    """@app:statistics(reporter=..., interval=...) starts the pump on
+    rt.start() and rt.shutdown() leaves no timer thread behind."""
+    import threading
+    import time as _time
+    from siddhi_tpu.core.telemetry import REPORTERS, register_stats_reporter
+    got = []
+    register_stats_reporter("trap", lambda app, rep: got.append(rep))
+    rt = mgr.create_app_runtime("""
+        @app:name('PumpApp')
+        @app:statistics(reporter='trap', interval='20 milliseconds')
+        define stream S (x int);
+        from S select x insert into O;
+    """)
+    collect(rt, "O")
+    rt.start()
+    rt.input_handler("S").send((1,))
+    rt.flush()
+    deadline = _time.time() + 5
+    while not got and _time.time() < deadline:
+        _time.sleep(0.01)
+    assert got, "periodic reporter never fired"
+    assert "S" in got[-1]["streams"]
+    rt.shutdown()
+    assert not [t for t in threading.enumerate()
+                if t.name == "siddhi-stats-report" and t.is_alive()], \
+        "reporter thread leaked past shutdown()"
+    n = len(got)
+    _time.sleep(0.08)
+    assert len(got) == n                     # pump really stopped
+    del REPORTERS["trap"]
+
+
+def test_prometheus_render(mgr):
+    from siddhi_tpu.core.telemetry import render_prometheus
+    rt = mgr.create_app_runtime("""
+        @app:statistics('true')
+        define stream S (x int);
+        @info(name='q1') from S[x > 0] select x insert into O;
+    """)
+    collect(rt, "O")
+    rt.input_handler("S").send([(i,) for i in range(1, 8)])
+    rt.flush()
+    text = render_prometheus({"App1": rt.statistics()})
+    assert text.endswith("\n")
+    assert 'siddhi_tpu_events_total{app="App1",stream="S"} 7' in text
+    assert 'quantile="0.99"' in text
+    # exposition format: HELP/TYPE exactly once per metric name
+    helps = [ln.split()[2] for ln in text.splitlines()
+             if ln.startswith("# HELP")]
+    assert len(helps) == len(set(helps))
+    for ln in text.splitlines():             # every sample line parses
+        if ln.startswith("#") or not ln:
+            continue
+        val = ln.rsplit(" ", 1)[1]
+        assert val == "NaN" or float(val) is not None
+
+
+def test_chrome_trace_export(mgr, tmp_path):
+    import json as _json
+    rt = mgr.create_app_runtime("""
+        @app:statistics('true')
+        define stream S (x int);
+        from S[x > 0] select x insert into O;
+    """)
+    rt.stats.tracer.enabled = True
+    collect(rt, "O")
+    rt.input_handler("S").send([(1,), (2,)])
+    rt.flush()
+    path = str(tmp_path / "trace.json")
+    n = rt.stats.export_chrome_trace(path)
+    evs = _json.loads(open(path).read())
+    assert n == len(evs) and n > 0
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in evs)
+    batches = [e for e in evs if e["cat"] == "batch"]
+    assert any(e["name"].startswith("S x") for e in batches)
+
+
+def test_flight_recorder_bounded():
+    from siddhi_tpu.core.telemetry import PipelineTracer
+    tr = PipelineTracer(capacity=4)
+    tr.enabled = True
+    for i in range(10):
+        tr.add(f"span{i}", float(i), 0.001)
+    assert len(tr.traces) == 4               # ring: last N only
+    assert tr.traces[0]["label"] == "span6"
+
+
+def test_device_metrics_sampled(mgr):
+    """Device gauges (lane occupancy / frontier width) ride the stats
+    report for device pattern plans — sampled at scrape, not per batch."""
+    rt = mgr.create_app_runtime("""
+        @app:statistics('true')
+        @app:devicePatterns('always')
+        define stream S (sym string, p double);
+        partition with (sym of S) begin
+          @info(name='q') from every e1=S[p > 10] -> e2=S[p > e1.p]
+            within 1 sec
+          select e1.p as a, e2.p as b insert into O;
+        end;
+    """)
+    collect(rt, "O")
+    h = rt.input_handler("S")
+    ts0 = 1_700_000_000_000
+    for rnd in range(2):         # identical rounds: round 2 reuses the
+        for i in range(8):       # compiled block -> a `kernel` span
+            h.send(("K1" if i % 2 else "K2", 11.0 + i),
+                   timestamp=ts0 + (rnd * 8 + i) * 10)
+        rt.flush()
+    rep = rt.statistics()
+    dev = rep["device"]["q"]
+    assert dev["lanes_total"] >= 1
+    assert dev["compiles"] >= 1 and dev["compile_seconds"] > 0
+    assert dev["h2d_bytes"] > 0
+    assert {"kernel", "transfer"} <= set(rep["stages"])
+    # the compile span is attributed separately from steady-state kernel
+    assert rep["stages"]["compile"]["seconds"] > 0
+
+
 def test_debugger_breakpoints(mgr):
     rt = mgr.create_app_runtime("""
         define stream S (x int);

@@ -62,3 +62,50 @@ def test_bad_app_is_a_400(svc):
     with pytest.raises(urllib.error.HTTPError) as e:
         _post(svc, "/siddhi/artifact/deploy", "define nonsense;", raw=True)
     assert e.value.code == 400
+
+
+def test_stats_unknown_app_404(svc):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(svc, "/siddhi/artifact/stats?siddhiApp=Nope")
+    assert e.value.code == 404
+    assert "error" in json.loads(e.value.read())
+
+
+def test_metrics_endpoint(svc):
+    _post(svc, "/siddhi/artifact/deploy", APP, raw=True)
+    for p in (11.0, 12.0, 3.0):
+        _post(svc, "/siddhi/artifact/event",
+              {"app": "RestApp", "stream": "S", "data": ["IBM", p]})
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.port}/metrics") as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        assert "version=0.0.4" in r.headers["Content-Type"]
+        text = r.read().decode()
+    # deployed runtimes are scrape-ready: stats on by default in service
+    assert 'siddhi_tpu_events_total{app="RestApp",stream="S"} 3' in text
+    assert "# HELP siddhi_tpu_events_total" in text
+    assert "# TYPE siddhi_tpu_events_total counter" in text
+    # per-app filter returns the same exposition
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.port}/metrics?siddhiApp=RestApp") as r:
+        assert 'app="RestApp"' in r.read().decode()
+
+
+def test_metrics_unknown_app_404(svc):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(svc, "/metrics?siddhiApp=Nope")
+    assert e.value.code == 404
+    assert "error" in json.loads(e.value.read())
+
+
+def test_statistics_false_opts_out_of_service_stats(svc):
+    _post(svc, "/siddhi/artifact/deploy",
+          "@app:name('Quiet')\n@app:statistics('false')\n"
+          "define stream S (x int);\nfrom S select x insert into O;\n",
+          raw=True)
+    _post(svc, "/siddhi/artifact/event",
+          {"app": "Quiet", "stream": "S", "data": [1]})
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.port}/metrics?siddhiApp=Quiet") as r:
+        text = r.read().decode()
+    assert "siddhi_tpu_events_total" not in text
