@@ -71,13 +71,15 @@ def _columnar(rt, stream, tape, keys):
 
 
 def run_tape(app, stream, tape, keys, out_streams=("Out",), warm=1,
-             repeats=1):
+             repeats=1, stats_out=None):
     """Feed the tape through a fresh runtime via the PUBLIC columnar
     ingest path (InputHandler.send_batch).  The timed region is split
     into `repeats` equal segments measured independently (state carries
     across segments — a continuous stream); returns
     (median events/sec, matches in segment 1, [per-segment eps]).
-    Callers compare segment-1 match counts across engines."""
+    Callers compare segment-1 match counts across engines.
+    `stats_out`: dict to fill with the runtime's device gauges (overlap
+    ratio, queue depth — pipeline.py telemetry) before shutdown."""
     from siddhi_tpu import SiddhiManager
 
     mgr = SiddhiManager()
@@ -107,9 +109,25 @@ def run_tape(app, stream, tape, keys, out_streams=("Out",), warm=1,
         eps_runs.append(n_seg / (time.perf_counter() - t0))
         if r == 0:
             seg1_matches = counted[0] - warm_matches
+    if stats_out is not None:
+        stats_out["device"] = rt.statistics().get("device", {})
     mgr.shutdown()
     return float(np.median(eps_runs)), seg1_matches, \
         [round(e) for e in eps_runs]
+
+
+def _overlap_summary(stats: dict) -> dict:
+    """Pull the pipeline gauges (pipeline.py) out of a stats_out dict:
+    the max overlap_ratio across plans plus total dispatch count."""
+    dev = stats.get("device", {})
+    ratios = [m["overlap_ratio"] for m in dev.values()
+              if "overlap_ratio" in m]
+    return {
+        "overlap_ratio": max(ratios) if ratios else None,
+        "plans_with_overlap": len(ratios),
+        "dispatches": sum(int(m.get("pipeline_dispatches", 0))
+                          for m in dev.values()),
+    }
 
 
 def p99_latency(app, stream, tape, keys, out_stream="Out", warm=10):
@@ -231,8 +249,10 @@ def bench_config(name, dev_app, host_app, n, batch, keys=8, dt_ms=1,
     enable output pipelining, which must NOT be active for latency."""
     tape = make_tape(n * repeats + warm * batch, batch, keys=keys,
                      dt_ms=dt_ms)
+    dev_stats: dict = {}
     dev_eps, dev_matches, dev_runs = run_tape(
-        dev_app, STREAM, tape, keys, out_streams, warm, repeats=repeats)
+        dev_app, STREAM, tape, keys, out_streams, warm, repeats=repeats,
+        stats_out=dev_stats)
     # host consumes exactly the device's segment 1 (seg_len batches), so
     # the zero-false-match counts compare identical event streams
     seg_len = max(1, (len(tape) - warm) // repeats)
@@ -254,6 +274,8 @@ def bench_config(name, dev_app, host_app, n, batch, keys=8, dt_ms=1,
         "speedup": round(dev_eps / host_eps, 2),
         "events": n, "batch": batch, "matches": dev_matches,
     }
+    res.update({k: v for k, v in _overlap_summary(dev_stats).items()
+                if v is not None})
     if latency:
         lat_tape = make_tape(2048 * 16, 2048, keys=keys, dt_ms=dt_ms)
         lat_app = lat_dev_app or dev_app
@@ -369,13 +391,16 @@ select a.symbol as s, a.price as lp, b.price as rp insert into Out;
 def bench_join(n, batch, keys=1000, repeats=3):
     """Config 6 (extra, VERDICT r4 #2): stream-stream window join.
     Each side receives n/2 events; device = dense probe-grid kernel,
-    host = the interp join (per-event probe of the retained window)."""
+    host = the interp join (per-event probe of the retained window).
+    Also measured: the same device engine UNPIPELINED (depth 0), so the
+    eps delta attributable to the async dispatch pipeline is explicit
+    and cross-checked against the overlap_ratio telemetry."""
     from siddhi_tpu import SiddhiManager
 
-    def run(head, total, measure_repeats):
+    def run(head, total, measure_repeats, pipe=True, stats_out=None):
         mgr = SiddhiManager()
         rt = mgr.create_app_runtime(head + PIPE + JOIN_APP
-                                    if "never" not in head
+                                    if "never" not in head and pipe
                                     else head + JOIN_APP)
         counted = [0]
         rt.add_batch_callback(
@@ -406,19 +431,75 @@ def bench_join(n, batch, keys=1000, repeats=3):
             eps_runs.append(per_seg / (time.perf_counter() - t0))
             if s == 0:
                 seg1 = counted[0]
+        if stats_out is not None:
+            stats_out["device"] = rt.statistics().get("device", {})
         mgr.shutdown()
         return float(np.median(eps_runs)), seg1, [round(e) for e in eps_runs]
 
-    dev_eps, dev_m, dev_runs = run("", n * repeats, repeats)
+    stats = {}
+    dev_eps, dev_m, dev_runs = run("", n * repeats, repeats,
+                                   stats_out=stats)
+    # same segments + median so compile amortization matches the
+    # pipelined run — the delta is overlap, not warm-up accounting
+    unp_eps, unp_m, _ = run("", n * repeats, repeats, pipe=False)
     host_eps, host_m, _ = run("@app:deviceJoins('never')\n", n, 1)
-    assert dev_m == host_m and dev_m > 0, \
-        f"join match mismatch device={dev_m} host={host_m}"
+    assert dev_m == host_m == unp_m and dev_m > 0, \
+        f"join match mismatch device={dev_m} host={host_m} unpiped={unp_m}"
     return {"device_eps": round(dev_eps), "device_eps_runs": dev_runs,
             "host_eps": round(host_eps),
             "speedup": round(dev_eps / host_eps, 2),
+            "unpipelined_eps": round(unp_eps),
+            "overlap_speedup": round(dev_eps / unp_eps, 2),
+            **_overlap_summary(stats),
             "events": n, "batch": batch, "matches": dev_m,
             "note": "stream-stream length-window join, 1024x1024 windows, "
                     "1000 keys, equality + residual condition"}
+
+
+# ---------------------------------------------------------------------------
+# config 8: multi-plan overlap (the unified dispatch pipeline measured
+# directly — N device plans share one input stream; runtime._drain
+# dispatches all of them before materializing any)
+# ---------------------------------------------------------------------------
+
+MULTI_PLAN_APP = (STOCK +
+    "@info(name='w1') from StockStream#window.length(512) "
+    "select symbol, sum(price) as s group by symbol insert into Out;\n"
+    "@info(name='w2') from StockStream#window.length(64) "
+    "select max(price) as hi, min(price) as lo insert into Out2;\n"
+    "@info(name='w3') from StockStream#window.lengthBatch(256) "
+    "select avg(price) as m insert into Out3;\n"
+    "@info(name='f1') from StockStream[price > 120] "
+    "select symbol, price insert into Out4;\n")
+MULTI_PLAN_OUTS = ("Out", "Out2", "Out3", "Out4")
+
+
+def bench_overlap(n=1 << 16, batch=1 << 13, repeats=3, depth=3):
+    """Pipelined (depth-D deferred pulls + cross-plan dispatch rounds)
+    vs unpipelined, SAME tape and plans; asserts identical match counts
+    and reports the eps delta next to the overlap_ratio telemetry that
+    explains it."""
+    head = DEV["windows"] + DEV["filters"]
+    tape = make_tape(n * repeats + batch, batch)
+    unp_eps, unp_m, _ = run_tape(head + MULTI_PLAN_APP, STREAM, tape, 8,
+                                 MULTI_PLAN_OUTS, warm=1, repeats=repeats)
+    stats = {}
+    pip_eps, pip_m, pip_runs = run_tape(
+        f"@app:devicePipeline({depth})\n" + head + MULTI_PLAN_APP, STREAM,
+        tape, 8, MULTI_PLAN_OUTS, warm=1, repeats=repeats,
+        stats_out=stats)
+    assert pip_m == unp_m and pip_m > 0, \
+        f"overlap config match mismatch piped={pip_m} unpiped={unp_m}"
+    return {"device_eps": round(pip_eps), "device_eps_runs": pip_runs,
+            "unpipelined_eps": round(unp_eps),
+            "host_eps": round(unp_eps),
+            "speedup": round(pip_eps / unp_eps, 2),
+            "overlap_speedup": round(pip_eps / unp_eps, 2),
+            **_overlap_summary(stats),
+            "events": n, "batch": batch, "matches": pip_m,
+            "note": f"3 device windows + 1 filter on one stream, "
+                    f"devicePipeline({depth}) vs depth 0 — speedup here "
+                    f"is overlap, not kernel changes"}
 
 
 def kernel_eps(app, family, batch, keys=8, dt_ms=1, reps=6):
@@ -766,6 +847,22 @@ def native_baseline():
 
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
+    if "--smoke" in argv:
+        # CI sanity (scripts/smoke.sh): a short pipelined-vs-unpipelined
+        # run over the multi-plan config — asserts identical match
+        # counts (inside bench_overlap) and prints the eps delta, so
+        # overlap regressions surface in tier-1 time budget
+        res = bench_overlap(n=1 << 12, batch=1 << 10, repeats=1, depth=2)
+        print(json.dumps({
+            "metric": "pipelined_vs_unpipelined_smoke",
+            "value": res["overlap_speedup"],
+            "unit": "eps_ratio",
+            "eps_pipelined": res["device_eps"],
+            "eps_unpipelined": res["unpipelined_eps"],
+            "overlap_ratio": res["overlap_ratio"],
+            "matches": res["matches"],
+        }))
+        return
     if "--trace" in argv:
         # fast mode: per-stage breakdown of config 3 only (the
         # diagnosability check — where does a detect-latency millisecond
@@ -831,6 +928,8 @@ def main(argv=None):
          "3 x 2048-event segments; host = 1000 sequential matchers")
 
     configs["6_join"] = bench_join(n=1 << 15, batch=4096)
+
+    configs["8_multi_plan_overlap"] = bench_overlap()
 
     # externalTimeBatch window row (device kind added r5): same tape but
     # with an event-time column driving the tumbling buckets

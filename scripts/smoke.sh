@@ -59,4 +59,11 @@ finally:
     svc.stop()
 EOF
 
+echo "== pipelined-vs-unpipelined bench smoke =="
+# bench.py --smoke: short pipelined-vs-unpipelined run over the
+# multi-plan overlap config; asserts identical match counts and prints
+# the eps delta + overlap_ratio, so dispatch-pipeline regressions
+# surface in tier-1 time budget
+python bench.py --smoke
+
 echo "smoke: PASS"

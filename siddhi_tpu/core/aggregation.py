@@ -403,10 +403,8 @@ class AggregationRuntime(QueryPlan):
             res = fn(ts_p.reshape(D, L),
                      g_p.reshape(len(gints), D, L).swapaxes(0, 1),
                      v_p.reshape(len(vals), D, L).swapaxes(0, 1))
-        try:
-            res["i"].copy_to_host_async()
-        except Exception:
-            pass
+        from .pipeline import start_d2h
+        start_d2h(res, keys=("i",))
         ipack = np.asarray(res["i"])
         fpack = np.asarray(res["f"])
         out = []

@@ -64,6 +64,71 @@ class EventBatch:
         self.__dict__["_rows_cache"] = (strings, out)
         return out
 
+    # -- shared device-upload pads ---------------------------------------
+    #
+    # Device plans pad columns to a pow2 grid T before upload.  The pads
+    # are memoized per batch so N plans subscribed to one stream build
+    # each (column, T) pad ONCE per flush instead of N times, and the
+    # backing buffers come from a rotating PadPool (see pipeline.py) so
+    # steady-state flushes stop allocating.
+
+    def padded(self, name: str, T: int, dtype=None, pool=None,
+               min_slots: int = 2) -> np.ndarray:
+        """Zero-tail (T,) pad of a column (memoized per (name, T, dtype)).
+        Callers must treat the result as read-only — it is shared across
+        every plan subscribed to this batch."""
+        cache = self.__dict__.setdefault("_pad_cache", {})
+        dt = np.dtype(dtype) if dtype is not None else None
+        key = (name, T, dt)
+        hit = cache.get(key)
+        if hit is not None:
+            buf, poolkey = hit
+            if pool is not None and poolkey is not None:
+                # a later caller may need a deeper rotation (per-plan
+                # pipeline depths): the memo must not swallow its request
+                pool.reserve(poolkey, T, buf.dtype, min_slots)
+            return buf
+        col = self.timestamps if name == "__timestamp__" \
+            else self.columns[name]
+        if dt is not None and col.dtype != dt:
+            col = col.astype(dt)
+        poolkey = (self.schema.id, name, T, col.dtype) \
+            if pool is not None else None
+        buf = self._pad_buf(poolkey, T, col.dtype, pool, min_slots)
+        buf[:self.n] = col
+        cache[key] = (buf, poolkey)
+        return buf
+
+    def padded_ts_offsets(self, T: int, pool=None, min_slots: int = 2):
+        """(offsets, base): timestamps as a zero-tail (T,) offset array
+        from an int64 base (i32 normally, i64 for rare wide batches) —
+        the compact upload form device window plans consume.  Memoized
+        per (T,) like padded()."""
+        cache = self.__dict__.setdefault("_ts_off_cache", {})
+        hit = cache.get(T)
+        if hit is not None:
+            buf, base, poolkey = hit
+            if pool is not None and poolkey is not None:
+                pool.reserve(poolkey, T, buf.dtype, min_slots)
+            return buf, base
+        base = int(self.timestamps[0]) if self.n else 0
+        off = self.timestamps - base
+        wide = bool(self.n and (off.max() >= 2**31 or off.min() < -2**31))
+        dt = np.dtype(np.int64 if wide else np.int32)
+        poolkey = (self.schema.id, "__ts_off__", T, dt) \
+            if pool is not None else None
+        buf = self._pad_buf(poolkey, T, dt, pool, min_slots)
+        buf[:self.n] = off
+        cache[T] = (buf, base, poolkey)
+        return buf, base
+
+    def _pad_buf(self, key, T: int, dt, pool, min_slots: int) -> np.ndarray:
+        if pool is None:
+            return np.zeros(T, dtype=dt)
+        buf = pool.take(key, T, dt, min_slots)
+        buf[self.n:] = 0        # recycled buffer: stale tail from a
+        return buf              # previous (larger) flush must clear
+
     @classmethod
     def empty(cls, schema: StreamSchema) -> "EventBatch":
         cols = {a.name: np.empty(0, dtype=dtype_of(a.type)) for a in schema.attributes}
@@ -92,9 +157,14 @@ class BatchBuilder:
         self._seqs: list[int] = []
         self._cols: dict[str, list] = {a.name: [] for a in schema.attributes}
         self._nulls: dict[str, list] = {}   # name -> [row indices], lazily
+        # already-columnar segments (the send_batch fast path): ordered
+        # (ts, cols, seqs, nulls, n) tuples interleaved with row appends;
+        # freeze() concatenates in arrival order, and a single segment
+        # with no row leftovers freezes zero-copy
+        self._pieces: list = []
 
     def __len__(self) -> int:
-        return len(self._ts)
+        return len(self._ts) + sum(p[4] for p in self._pieces)
 
     @property
     def full(self) -> bool:
@@ -124,16 +194,25 @@ class BatchBuilder:
                      else None)
             self._cols[a.name].append(v)
 
-    def freeze_and_clear(self) -> EventBatch:
-        b = self.freeze()
-        self._ts = []
-        self._seqs = []
-        self._cols = {a.name: [] for a in self.schema.attributes}
-        self._nulls = {}
-        return b
+    def append_columnar(self, timestamps: np.ndarray, columns: dict,
+                        seqs: Optional[np.ndarray] = None,
+                        nulls: Optional[dict] = None) -> None:
+        """Adopt an already-columnar segment without the per-row Python
+        append: `columns` must map every schema attribute to an (n,)
+        array in its device dtype (strings pre-encoded to int32 codes)
+        — the caller (runtime.send_columnar) does the coercion.  Arrays
+        are adopted as-is (no copy); callers must not mutate them."""
+        n = int(len(timestamps))
+        if n == 0:
+            return
+        self._seal_rows()
+        self._pieces.append((timestamps, columns, seqs, nulls, n))
 
-    def freeze(self) -> EventBatch:
+    def _seal_rows(self) -> None:
+        """Convert buffered row appends into a columnar piece."""
         n = len(self._ts)
+        if not n:
+            return
         cols = {}
         for a in self.schema.attributes:
             dt = dtype_of(a.type)
@@ -148,5 +227,48 @@ class BatchBuilder:
                 m = np.zeros(n, dtype=bool)
                 m[idxs] = True
                 nulls[name] = m
-        return EventBatch(self.schema, np.asarray(self._ts, dtype=TIMESTAMP_DTYPE),
-                          cols, n, np.asarray(self._seqs, dtype=np.int64), nulls)
+        self._pieces.append((np.asarray(self._ts, dtype=TIMESTAMP_DTYPE),
+                             cols, np.asarray(self._seqs, dtype=np.int64),
+                             nulls, n))
+        self._ts = []
+        self._seqs = []
+        self._cols = {a.name: [] for a in self.schema.attributes}
+        self._nulls = {}
+
+    def freeze_and_clear(self) -> EventBatch:
+        b = self.freeze()
+        self._ts = []
+        self._seqs = []
+        self._cols = {a.name: [] for a in self.schema.attributes}
+        self._nulls = {}
+        self._pieces = []
+        return b
+
+    def freeze(self) -> EventBatch:
+        self._seal_rows()
+        pieces = self._pieces
+        if not pieces:
+            b = EventBatch.empty(self.schema)
+            b.seqs = np.empty(0, dtype=np.int64)
+            return b
+        if len(pieces) == 1:                     # fast path: zero-copy
+            ts, cols, seqs, nulls, n = pieces[0]
+            if seqs is None:
+                seqs = np.arange(n, dtype=np.int64)
+            return EventBatch(self.schema, ts, cols, n, seqs, nulls)
+        n = sum(p[4] for p in pieces)
+        ts = np.concatenate([p[0] for p in pieces])
+        seqs = np.concatenate(
+            [p[2] if p[2] is not None else np.arange(p[4], dtype=np.int64)
+             for p in pieces])
+        cols = {a.name: np.concatenate([p[1][a.name] for p in pieces])
+                for a in self.schema.attributes}
+        nulls = None
+        if any(p[3] for p in pieces):
+            nulls = {}
+            names = {nm for p in pieces if p[3] for nm in p[3]}
+            for nm in names:
+                nulls[nm] = np.concatenate(
+                    [(p[3] or {}).get(nm, np.zeros(p[4], bool))
+                     for p in pieces])
+        return EventBatch(self.schema, ts, cols, n, seqs, nulls)

@@ -205,9 +205,9 @@ class DeviceJoinPlan(QueryPlan):
 
         self.input_streams = tuple({self.left.stream_id,
                                     self.right.stream_id})
+        from .pipeline import DispatchPipeline
         self._mode = F32_MODE       # device DOUBLE policy (f32 compute)
         self._buffered: list = []
-        self._inflight: list = []
         self._fn_cache: dict = {}
         self._m_hint = 16
         # side filters force a sync per flush (the mirror update needs the
@@ -216,6 +216,8 @@ class DeviceJoinPlan(QueryPlan):
         pl_ann = ast.find_annotation(rt.app.annotations, "app:devicePipeline")
         self.pipeline_depth = int(pl_ann.element()) \
             if pl_ann is not None and self._can_pipeline else 0
+        self._pipe = DispatchPipeline(name, self._materialize,
+                                      depth=self.pipeline_depth)
         # build-time trace so unsupported expressions fail at plan time
         # (eval_shape: no compile, no device)
         self._shape_check()
@@ -479,22 +481,15 @@ class DeviceJoinPlan(QueryPlan):
                                     lseq=lseq, rseq=rseq, ln=ln, rn=rn))
         if self._can_pipeline:
             # no side filters: every valid event passes — mirrors advance
-            # host-side immediately, so the next flush needs NO sync
+            # host-side immediately, so the next flush needs NO sync.
+            # The pipeline then defers the blocking pull: depth-D across
+            # flushes, and within one dispatch round the runtime collects
+            # AFTER every other device plan has dispatched (overlap)
             self.left.update_mirror(lc, lts, lseq, np.ones(ln, bool))
             self.right.update_mirror(rc, rts, rseq, np.ones(rn, bool))
-            self._inflight.append(entry)
-            out = []
-            while len(self._inflight) > self.pipeline_depth:
-                out.extend(self._materialize(self._inflight.pop(0)))
-            return out
+            return self._pipe.push(entry)
         rows = self._materialize(entry, update_mirrors=True)
         return rows
-
-    def flush_pending(self) -> list:
-        out = []
-        while self._inflight:
-            out.extend(self._materialize(self._inflight.pop(0)))
-        return out
 
     def _dispatch(self, lev, rev, TL, TR, NL, NR, meta, M=None,
                   mirror_snap=None) -> dict:
@@ -507,12 +502,8 @@ class DeviceJoinPlan(QueryPlan):
             res = call_kernel(
                 self.rt.stats, self.name, fn, (lev, rev), cache_hit=hit,
                 nbytes=env_nbytes(lev) + env_nbytes(rev))
-        for k in ("i", "f"):
-            if k in res:
-                try:    # start the D2H pull while the device computes
-                    res[k].copy_to_host_async()
-                except Exception:
-                    pass
+        from .pipeline import start_d2h
+        start_d2h(res)      # start the D2H pull while the device computes
         # snapshot the mirrors the probe actually saw: with pipelining
         # (and overflow retries) they advance before the entry
         # materializes, so a fresh snapshot would gather wrong values
@@ -721,6 +712,7 @@ class DeviceJoinPlan(QueryPlan):
         return {"left": self.left.state(), "right": self.right.state()}
 
     def load_state_dict(self, d: dict) -> None:
+        self._pipe.take_all()       # in-flight results predate the restore
         self.left.restore(d["left"])
         self.right.restore(d["right"])
 

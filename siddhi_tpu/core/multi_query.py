@@ -261,6 +261,12 @@ class MultiQueryDevicePatternPlan:
     def flush_pending(self):
         return []
 
+    def begin_dispatch_round(self):
+        pass        # broadcast kernels have no deferred-pull pipeline
+
+    def collect_ready(self):
+        return []
+
     def process(self, stream_id, batch):
         return self.inner.process(stream_id, batch)
 

@@ -205,10 +205,13 @@ class DevicePatternPlan(QueryPlan):
                 self._chunk_E: Optional[int] = None
                 self._kern_by_p: dict = {}
                 self._of_dropped = 0
-                self._chunk_inflight: list = []
                 pl = ast.find_annotation(rt.app.annotations,
                                          "app:devicePipeline")
                 self.pipeline_depth = int(pl.element()) if pl else 0
+                from .pipeline import DispatchPipeline
+                self._pipe = DispatchPipeline(
+                    name, lambda e: [self._materialize_chunk(e)],
+                    depth=self.pipeline_depth)
         # device grids shipped per block: only attrs some predicate or
         # capture row reads, per scode
         self._grid_attrs: list = sorted(self._needed_grid_attrs())
@@ -560,10 +563,8 @@ class DevicePatternPlan(QueryPlan):
                     M = max(self._m_hint, _m_bucket(2 * T))
                 pre = st
                 st, out = self._call_block(self.kernel, T, M, pre, ev)
-                try:    # start the D2H pull while the device still computes
-                    out["i"].copy_to_host_async()
-                except Exception:
-                    pass
+                from .pipeline import start_d2h
+                start_d2h(out, keys=("i",))   # pull overlaps the compute
                 dispatched.append((j, pre, ev, T, M, out))
             restart = None
             for j, pre, ev, T, M, out in dispatched:
@@ -731,12 +732,8 @@ class DevicePatternPlan(QueryPlan):
         # is a ~10s recompile through the tunnel
         M = (self._m_hint if self._m_hint >= 16384
              else max(self._m_hint, _m_bucket_chunk(N)))
-        self._chunk_inflight.append(self._dispatch_chunk(
+        return self._pipe.push(self._dispatch_chunk(
             ev, K, T, M, ts_base, seq_base))
-        out: list = []
-        while len(self._chunk_inflight) > self.pipeline_depth:
-            out.append(self._materialize_chunk(self._chunk_inflight.pop(0)))
-        return out
 
     def _dispatch_chunk(self, ev, K, T, M, ts_base, seq_base) -> dict:
         with self.rt.stats.stage("host_build", plan=self.name):
@@ -754,12 +751,8 @@ class DevicePatternPlan(QueryPlan):
                 ev = {k: jax.device_put(v, self._part_sharding(0))
                       for k, v in ev.items()}
         _st, out = self._call_block(kern, T, M, st0, ev)
-        for key in ("i", "f"):
-            if key in out:
-                try:    # start the D2H pull while the device computes
-                    out[key].copy_to_host_async()
-                except Exception:
-                    pass
+        from .pipeline import start_d2h
+        start_d2h(out)      # start the D2H pull while the device computes
         return {"ev": ev, "K": K, "T": T, "M": M, "out": out,
                 "ts_base": ts_base, "seq_base": seq_base}
 
@@ -801,11 +794,17 @@ class DevicePatternPlan(QueryPlan):
         return self._unpack_block(ipack, fpack, n)
 
     def flush_pending(self) -> list:
-        if self._chunk_cfg is None or not getattr(self, "_chunk_inflight", None):
+        # chunk results are raw columnar match tables, not OutputBatches:
+        # wrap the base pipeline drain/collect in _rows_to_batches
+        if self._pipe is None or not len(self._pipe):
             return []
-        chunks = [self._materialize_chunk(e) for e in self._chunk_inflight]
-        self._chunk_inflight = []
-        return self._rows_to_batches(chunks)
+        return self._rows_to_batches(self._pipe.drain())
+
+    def collect_ready(self) -> list:
+        if self._pipe is None:
+            return []
+        chunks = self._pipe.collect()
+        return self._rows_to_batches(chunks) if chunks else []
 
     def _unpack_block(self, ipack, fpack, n: int):
         """Columnar match table from one block's packed output."""
@@ -996,6 +995,8 @@ class DevicePatternPlan(QueryPlan):
 
     def load_state_dict(self, d: dict) -> None:
         import jax.numpy as jnp
+        if self._pipe is not None:
+            self._pipe.take_all()   # in-flight results predate the restore
         st = d["state"]
         a, p = st["occ"].shape
         if self.mesh is not None:

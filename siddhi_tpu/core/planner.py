@@ -140,6 +140,8 @@ class QueryPlan:
     output_target: Optional[str]
     out_schema: Optional[StreamSchema]
     table_writer = None           # set when output_target is a table
+    _pipe = None                  # DispatchPipeline when the plan defers
+                                  # D2H pulls (pipeline.py)
 
     def process(self, stream_id: str, batch: EventBatch) -> list:
         raise NotImplementedError
@@ -156,6 +158,25 @@ class QueryPlan:
         """Deliver any device results still in flight (pipelined plans
         defer materialization by up to @app:devicePipeline batches); the
         runtime calls this at its flush barrier."""
+        if self._pipe is not None:
+            return self._pipe.drain()
+        return []
+
+    # -- dispatch-round overlap (runtime._drain) -------------------------
+    #
+    # The runtime opens a dispatch round over every plan touched by a
+    # batch (or finalize pass), calls process/finalize on each — which
+    # dispatch device work but defer the blocking D2H pull — then
+    # collects.  N device plans therefore overlap on device instead of
+    # running build -> compute -> readback serially per plan.
+
+    def begin_dispatch_round(self) -> None:
+        if self._pipe is not None:
+            self._pipe.hold()
+
+    def collect_ready(self) -> list:
+        if self._pipe is not None:
+            return self._pipe.collect()
         return []
 
     def finalize(self) -> list:
@@ -188,9 +209,11 @@ class FilterProjectPlan(QueryPlan):
                  limit: Optional[int] = None, offset: Optional[int] = None,
                  events_for: ast.OutputEventsFor = ast.OutputEventsFor.CURRENT,
                  pipeline_depth: int = 0):
+        from .pipeline import DispatchPipeline
         self.name = name
         self.pipeline_depth = pipeline_depth
-        self._inflight: list = []
+        self._pipe = DispatchPipeline(
+            name, lambda e: self._materialize(*e), depth=pipeline_depth)
         # a stateless query never expires events; `insert expired events into`
         # therefore emits nothing (matches reference semantics)
         self.emits_nothing = events_for == ast.OutputEventsFor.EXPIRED
@@ -272,30 +295,13 @@ class FilterProjectPlan(QueryPlan):
             # on plan shape, not on the read-set — constant filters and
             # constant columns have empty reads but still must evaluate)
             mask = np.ones(batch.n, dtype=bool)
-            self._inflight.append((None, [], host_env, batch, mask))
-            results: list = []
-            while len(self._inflight) > self.pipeline_depth:
-                results.extend(self._materialize(*self._inflight.pop(0)))
-            return results
+            return self._pipe.push((None, [], host_env, batch, mask))
         env = {k: host_env[k] for k in sorted(self._need)
                if k in host_env and host_env[k].dtype != np.dtype(object)}
         mask_w, outs = self._step(env)
-        for a in [mask_w] + list(outs):
-            try:        # start D2H pulls early; materialization may defer
-                a.copy_to_host_async()
-            except Exception:
-                pass
-        self._inflight.append((mask_w, outs, host_env, batch, None))
-        results: list = []
-        while len(self._inflight) > self.pipeline_depth:
-            results.extend(self._materialize(*self._inflight.pop(0)))
-        return results
-
-    def flush_pending(self) -> list:
-        results: list = []
-        while self._inflight:
-            results.extend(self._materialize(*self._inflight.pop(0)))
-        return results
+        from .pipeline import start_d2h
+        start_d2h([mask_w] + list(outs))    # pulls overlap device compute
+        return self._pipe.push((mask_w, outs, host_env, batch, None))
 
     def _materialize(self, mask_w, outs, host_env, batch, mask) -> list:
         if mask is None:

@@ -207,6 +207,10 @@ class SiddhiAppRuntime:
         self._builders: dict = {}
         self._pending: list = []      # FIFO of (stream_id, EventBatch) awaiting dispatch
         self._seq = 0                 # global arrival order counter
+        # rotating device-upload pad buffers shared by all plans (see
+        # pipeline.py PadPool + EventBatch.padded)
+        from .pipeline import PadPool
+        self._pad_pool = PadPool()
         self._store_cache: dict = {}  # store-query text -> StoreQueryExec
         # ingest/timer mutual exclusion (the reference's ThreadBarrier +
         # per-query locks collapse to one runtime lock: state is columnar
@@ -560,7 +564,9 @@ class SiddhiAppRuntime:
         """Columnar micro-batch ingest (see InputHandler.send_batch).
         The whole array set becomes ONE EventBatch dispatched through the
         same junction path as row-wise send; rows previously buffered via
-        `send` flush first so arrival order is preserved."""
+        `send` merge AHEAD of the columnar segment in that batch (the
+        builder adopts the arrays zero-copy — batch.py append_columnar —
+        so arrival order is preserved without a split micro-batch)."""
         from .schema import dtype_of as _dtype_of
         schema = self.schemas.get(stream_id)
         if schema is None:
@@ -580,23 +586,15 @@ class SiddhiAppRuntime:
                     arr = np.asarray(v)
                     if arr.dtype.kind in "iu":          # pre-encoded dict codes
                         arr = arr.astype(np.int32, copy=False)
-                    else:                               # str values: encode
-                        if arr.ndim != 1:
-                            raise ValueError(
-                                f"stream {stream_id!r}: column {a.name!r} must "
-                                f"be a 1-d array/list of str, got {v!r}")
-                        to_encode.append(a.name)        # ...under the lock (the
-                        arr = arr.tolist()              # StringTable is shared)
+                    else:           # str values: encode under the lock
+                        to_encode.append(a.name)  # (StringTable is shared)
                 else:
                     arr = np.asarray(v, dtype=_dtype_of(a.type))
-                if isinstance(arr, list):
-                    rows_in = len(arr)
-                elif arr.ndim != 1:
+                if arr.ndim != 1:
                     raise ValueError(
                         f"stream {stream_id!r}: column {a.name!r} must be a "
                         f"1-d array/list of values, got shape {arr.shape}")
-                else:
-                    rows_in = arr.shape[0]
+                rows_in = arr.shape[0]
                 if n is None:
                     n = rows_in
                 elif rows_in != n:
@@ -620,27 +618,28 @@ class SiddhiAppRuntime:
                         f"{n} rows")
         with self._lock:
             for name in to_encode:      # shared-table writes: locked
+                # vectorized: the dict is consulted once per DISTINCT value
                 cols[name] = self.strings.encode_many(cols[name])
             if ts is None:
                 ts = np.full(n, self.now_ms(), dtype=np.int64)
             b = self._builders.get(stream_id)
-            if b is not None and len(b):    # order vs earlier row sends
-                leftover = b.freeze_and_clear()
-                if self._async and self._ingest_q is not None:
-                    # async mode: older batches may still sit in the ingest
-                    # queue — stage through the same outbox so FIFO holds
-                    self._async_outbox.append((stream_id, leftover))
-                else:
-                    self._pending.append((stream_id, leftover))
+            if b is None:
+                b = self._builders[stream_id] = BatchBuilder(
+                    schema, self.strings, self.batch_capacity)
             seqs = np.arange(self._seq + 1, self._seq + 1 + n,
                               dtype=np.int64)
             self._seq += n
             if self._playback and timestamps is not None:
-                # advance the event-time clock (row-path advance());
-                # wall-stamped batches must NOT anchor playback time
-                self._clock_ms = int(ts[-1])
-            batch = EventBatch(schema, ts, cols, n, seqs)
+                # advance the event-time clock (row-path advance()) by the
+                # batch MAXIMUM: an unsorted timestamp array must not
+                # rewind event time (ts[-1] could).  Wall-stamped batches
+                # must NOT anchor playback time.
+                self._clock_ms = int(ts.max())
+            b.append_columnar(ts, cols, seqs)
+            batch = b.freeze_and_clear()
             if self._async and self._ingest_q is not None:
+                # async mode: older batches may still sit in the ingest
+                # queue — stage through the same outbox so FIFO holds
                 self._async_outbox.append((stream_id, batch))
             else:
                 self._pending.append((stream_id, batch))
@@ -819,10 +818,20 @@ class SiddhiAppRuntime:
                 raise RuntimeError("runaway stream recursion (insert-into cycle?)")
             if not self._pending:
                 # multi-input plans (patterns/sequences/joins) buffer events
-                # per stream and merge by global seq once the round settles
+                # per stream and merge by global seq once the round settles.
+                # The finalize pass is a dispatch round: every plan's device
+                # blocks launch before the first blocking D2H pull, so N
+                # plans overlap on device instead of serializing
+                # build -> compute -> readback per plan.
                 progressed = False
                 for plan in self._plans:
+                    plan.begin_dispatch_round()
+                for plan in self._plans:
                     for ob in plan.finalize():
+                        self._emit(plan, ob)
+                        progressed = True
+                for plan in self._plans:
+                    for ob in plan.collect_ready():
                         self._emit(plan, ob)
                         progressed = True
                 if not self._pending and not progressed:
@@ -842,7 +851,13 @@ class SiddhiAppRuntime:
                         for cb in cbs_s:    # junction callbacks: each gets
                             cb(self._decode(batch))   # its own Event list
                 fault_err = None
-                for plan in self._subscribers.get(sid, ()):
+                subs = self._subscribers.get(sid, ())
+                # dispatch round: every subscribed plan dispatches its
+                # device block for this batch before any plan blocks on a
+                # result pull (collect below) — cross-plan overlap
+                for plan in subs:
+                    plan.begin_dispatch_round()
+                for plan in subs:
                     if self._debugger is not None:
                         self._debugger.check_in(plan, batch)
                     try:
@@ -858,6 +873,24 @@ class SiddhiAppRuntime:
                         continue
                     if self._debugger is not None:
                         self._debugger.check_out(plan, obs)
+                    for ob in obs:
+                        self._emit(plan, ob)
+                for plan in subs:
+                    try:
+                        obs = plan.collect_ready()
+                    except Exception as e:
+                        # fault-route only when the plan materializes the
+                        # CURRENT batch here (depth 0); at depth > 0 the
+                        # failed entry belongs to an EARLIER batch, and
+                        # rerouting this batch's events would misattribute
+                        # the error — propagate instead (same surface as a
+                        # failure at the flush barrier)
+                        depth = getattr(getattr(plan, "_pipe", None),
+                                        "depth", 0)
+                        if depth or ("!" + sid) not in self.schemas:
+                            raise
+                        fault_err = e
+                        continue
                     for ob in obs:
                         self._emit(plan, ob)
                 if fault_err is not None:

@@ -75,7 +75,26 @@ class StringTable:
         return self._to_str[code]
 
     def encode_many(self, values) -> np.ndarray:
-        return np.asarray([self.encode(v) for v in values], dtype=STRING_CODE_DTYPE)
+        """Vectorized encode: the python dict is consulted once per
+        DISTINCT value (np.unique + a gather), so a million-row column
+        with a few thousand symbols costs thousands of dict hits, not a
+        per-row loop.  Arrays holding None (object dtype) fall back to
+        the row loop — None does not compare under np.unique."""
+        arr = np.asarray(values)
+        if arr.dtype.kind in "iu":              # pre-encoded dict codes
+            return arr.astype(STRING_CODE_DTYPE, copy=False)
+        if arr.dtype.kind == "U" and arr.ndim == 1:
+            uniq, first, inv = np.unique(arr, return_index=True,
+                                         return_inverse=True)
+            codes = np.empty(len(uniq), dtype=STRING_CODE_DTYPE)
+            # NEW values must get codes in first-appearance order (np
+            # .unique sorts) so the dictionary is identical to the
+            # per-row path's — batches byte-match across ingest paths
+            for j in np.argsort(first, kind="stable").tolist():
+                codes[j] = self.encode(uniq[j])
+            return codes[inv]
+        return np.asarray([self.encode(v) for v in values],
+                          dtype=STRING_CODE_DTYPE)
 
     def __len__(self) -> int:
         return len(self._to_str)

@@ -626,6 +626,13 @@ class StatisticsManager:
             self._rep_thread.join(timeout=2)
             self._rep_thread = None
             self._rep_stop = None
+        # drop this app's cached prometheus series: a shut-down app must
+        # not keep exporting frozen metrics through $SIDDHI_PROM_FILE /
+        # PROM_LATEST renders triggered by other apps' reporter ticks
+        app = getattr(getattr(self.rt, "app", None), "name", None)
+        if app is not None:
+            _PROM_REPORTS.pop(app, None)
+            PROM_LATEST.pop(app, None)
 
     # -- recording hooks -----------------------------------------------------
 
@@ -683,28 +690,42 @@ class StatisticsManager:
         each plan's sampled gauges (lane occupancy, frontier width,
         buffer fill) — sampled on demand, one D2H pull per stateful
         plan, so scrapes pay the cost, not the hot path."""
+        # snapshot before iterating: the dispatch thread inserts new
+        # tracker keys concurrently (first compile of a new shape, a
+        # freshly added plan) and a live dict comprehension would raise
+        # "dictionary changed size during iteration" on a /metrics scrape
         out = {name: {k: (int(v) if float(v).is_integer() else v)
-                      for k, v in ctr.items()}
-               for name, ctr in self.device.items()}
+                      for k, v in list(ctr.items())}
+               for name, ctr in list(self.device.items())}
         for p in getattr(self.rt, "_plans", ()):
             dm = getattr(p, "device_metrics", None)
-            if dm is None:
-                continue
-            try:
-                m = dm()
-            except Exception:
-                continue
-            if m:
-                out.setdefault(p.name, {}).update(m)
+            if dm is not None:
+                try:
+                    m = dm()
+                except Exception:
+                    m = None
+                if m:
+                    out.setdefault(p.name, {}).update(m)
+            # dispatch-pipeline gauges (pipeline.py): in-flight queue
+            # depth, dispatch count, and the overlap_ratio behind the
+            # async host/device decoupling story
+            pipe = getattr(p, "_pipe", None)
+            if pipe is not None:
+                try:
+                    out.setdefault(p.name, {}).update(pipe.metrics())
+                except Exception:
+                    pass
         return out
 
     def report(self) -> dict:
         up = time.perf_counter() - self._t0
         rep = {
             "uptime_s": up,
-            "streams": {k: v.as_dict() for k, v in self.stream_in.items()},
-            "queries": {k: v.as_dict() for k, v in self.query.items()},
-            "stages": {k: v.as_dict() for k, v in self.stages.items()},
+            # list() snapshots: scrapes race the dispatch thread's inserts
+            "streams": {k: v.as_dict()
+                        for k, v in list(self.stream_in.items())},
+            "queries": {k: v.as_dict() for k, v in list(self.query.items())},
+            "stages": {k: v.as_dict() for k, v in list(self.stages.items())},
         }
         dev = self.device_report()
         if dev:

@@ -456,9 +456,11 @@ class DeviceWindowAggPlan(QueryPlan):
             raise DeviceWindowUnsupported(f"unresolved columns {unknown}")
         self.cols = sorted(k for k in reads if k in schema.types)
 
+        from .pipeline import DispatchPipeline
         pl = ast.find_annotation(rt.app.annotations, "app:devicePipeline")
         self.pipeline_depth = int(pl.element()) if pl is not None else 0
-        self._inflight: list = []
+        self._pipe = DispatchPipeline(name, self._materialize,
+                                      depth=self.pipeline_depth)
 
         # multi-chip: @app:deviceMesh('always') shards the batch axis T
         # over the mesh — XLA partitions the prefix/segmented scans and
@@ -742,8 +744,14 @@ class DeviceWindowAggPlan(QueryPlan):
             ets = env_all[ext_ts].astype(jnp.int64)
             idx0 = jnp.argmax(all_valid)          # first valid entry
             first_e = ets[idx0]
-            start = jnp.where(state["start"] == SENT, first_e,
-                              state["start"])
+            # latch the bucket anchor only when the block actually holds
+            # a valid event: argmax over an all-False mask is 0, and a
+            # fully-filtered first micro-batch would otherwise latch a
+            # garbage carry-slot timestamp, permanently shifting every
+            # bucket boundary vs the host path
+            start = jnp.where((state["start"] == SENT)
+                              & jnp.any(all_valid),
+                              first_e, state["start"])
             Dj = jnp.int64(D)
             b = jnp.where(all_valid, (ets - start) // Dj, jnp.int64(-1))
             bfirst = b[idx0]
@@ -919,37 +927,33 @@ class DeviceWindowAggPlan(QueryPlan):
             if self.mesh is not None:
                 # the sharded 't' axis must divide the device count
                 T = max(T, self.mesh.devices.size)
+            # pads are memoized on the batch (N plans on one stream share
+            # ONE pad per column per flush) and backed by the runtime's
+            # rotating PadPool, so steady-state flushes stop allocating;
+            # depth + 2 slots keep envs of pipelined retries un-aliased
+            pool = getattr(self.rt, "_pad_pool", None)
+            slots = self.pipeline_depth + 2
             env = {"__nvalid__": np.int32(batch.n)}
             if self._needs_ts:
-                base = int(batch.timestamps[0])
-                off = batch.timestamps - base
-                wide = bool(batch.n and (off.max() >= 2**31
-                                         or off.min() < -2**31))
-                env["__ts_off__"] = _pad(off.astype(
-                    np.int64 if wide else np.int32), T, 0)
+                off, base = batch.padded_ts_offsets(T, pool=pool,
+                                                    min_slots=slots)
+                env["__ts_off__"] = off
                 env["__ts_base__"] = np.int64(base)
             for c in self.cols:
-                col = batch.columns[c]
-                if not self.f64 and col.dtype == np.float64:
-                    col = col.astype(np.float32)     # device DOUBLE policy
-                env[c] = _pad(col, T, 0)
-        self._inflight.append(self._dispatch(env, batch, T))
-        outs: list = []
+                dt = None
+                if not self.f64 \
+                        and batch.columns[c].dtype == np.float64:
+                    dt = np.float32              # device DOUBLE policy
+                env[c] = batch.padded(c, T, dtype=dt, pool=pool,
+                                      min_slots=slots)
         # depth-D pipeline (opt-in @app:devicePipeline): batch i's pull
         # overlaps batch i+1..i+D's upload+compute, hiding the tunnel's
         # fixed D2H latency; outputs then deliver up to D batches late
         # (the runtime flush barrier drains the tail)
-        while len(self._inflight) > self.pipeline_depth:
-            outs.extend(self._materialize(self._inflight.pop(0)))
-        return outs
-
-    def flush_pending(self) -> list:
-        outs: list = []
-        while self._inflight:
-            outs.extend(self._materialize(self._inflight.pop(0)))
-        return outs
+        return self._pipe.push(self._dispatch(env, batch, T))
 
     def _dispatch(self, env: dict, batch: EventBatch, T: int) -> dict:
+        from .pipeline import start_d2h
         pre = self.state
         if not self.rt.stats.enabled:
             res = self._step_fn(T, self.C)(self.state, env)
@@ -959,12 +963,7 @@ class DeviceWindowAggPlan(QueryPlan):
             res = call_kernel(
                 self.rt.stats, self.name, fn, (self.state, env),
                 cache_hit=hit, nbytes=env_nbytes(env))
-        for key in ("b", "i", "f"):
-            if key in res:
-                try:    # start the D2H pull while the device computes
-                    res[key].copy_to_host_async()
-                except Exception:
-                    pass
+        start_d2h(res, keys=("b", "i", "f"))
         self.state = res["nst"]
         return {"pre": pre, "env": env, "batch": batch, "T": T, "res": res}
 
@@ -983,14 +982,13 @@ class DeviceWindowAggPlan(QueryPlan):
                 break
             # carry overflow: grow C and replay this entry plus everything
             # dispatched after it (their pre-states are now invalid)
-            chain = [entry] + self._inflight
-            self._inflight = []
+            chain = [entry] + self._pipe.take_all()
             self.state = entry["pre"]
             self._grow(2 * self.C)
             redone = [self._dispatch(e["env"], e["batch"], e["T"])
                       for e in chain]
             entry = redone[0]
-            self._inflight = redone[1:]
+            self._pipe.requeue(redone[1:])
         with self.rt.stats.stage("transfer", plan=self.name):
             ipack = np.asarray(res["i"]) if "i" in res else None
             fpack = np.asarray(res["f"]) if "f" in res else None
@@ -1099,7 +1097,7 @@ class DeviceWindowAggPlan(QueryPlan):
         c = int(d.get("C", self.C))
         if c != self.C:
             self.C = c
-        self._inflight = []
+        self._pipe.take_all()       # in-flight results predate the restore
         self.state = {k: jnp.asarray(v) for k, v in d["state"].items()}
 
 
@@ -1117,12 +1115,6 @@ def _cast_site(a: jnp.ndarray, t: AttrType) -> jnp.ndarray:
     if t in (AttrType.INT, AttrType.LONG):
         return a.astype(jnp.int64)
     return a
-
-
-def _pad(a: np.ndarray, T: int, fill) -> np.ndarray:
-    out = np.full(T, fill, dtype=a.dtype)
-    out[:a.shape[0]] = a
-    return out
 
 
 def _collect_site_args(exprs, acc: list) -> None:

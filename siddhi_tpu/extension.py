@@ -16,6 +16,7 @@ test suite asserting this table covers the parser's built-in surface.
 """
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 
 
@@ -94,24 +95,33 @@ def validate_meta(meta: ExtensionMeta, kind: str = "extension") -> None:
 
 # central metadata registry: (kind, namespace, lowercase name) -> meta
 _REGISTRY: dict = {}
-# set during entry-point discovery: duplicate registrations raise
+# set during entry-point discovery: duplicate registrations raise.
+# Guarded by _REGISTRY_LOCK — a register_meta from another thread while a
+# discovery scan runs must neither see the strict flag flip mid-call nor
+# race the check-then-insert (the RLock lets the discovery thread's own
+# nested register_* calls through)
 _strict_collisions = False
+_REGISTRY_LOCK = threading.RLock()
 
 
-def register_meta(kind: str, meta) -> None:
+def register_meta(kind: str, meta, strict: bool = None) -> None:
     """Validate + index extension metadata; None is a no-op so the
-    register_* SPI can forward its optional `meta` unconditionally."""
+    register_* SPI can forward its optional `meta` unconditionally.
+    `strict` overrides the discovery-scoped collision policy explicitly
+    (None = inherit the module flag)."""
     if meta is None:
         return
     validate_meta(meta, kind)
     key = (kind, meta.namespace or "", meta.name.lower())
-    if _strict_collisions and key in _REGISTRY:
-        raise ExtensionError(
-            f"duplicate {kind} extension "
-            f"{(meta.namespace + ':') if meta.namespace else ''}"
-            f"{meta.name!r} (already registered) — entry-point extensions "
-            f"must use unique namespace:name pairs")
-    _REGISTRY[key] = meta
+    with _REGISTRY_LOCK:
+        eff_strict = _strict_collisions if strict is None else strict
+        if eff_strict and key in _REGISTRY:
+            raise ExtensionError(
+                f"duplicate {kind} extension "
+                f"{(meta.namespace + ':') if meta.namespace else ''}"
+                f"{meta.name!r} (already registered) — entry-point extensions "
+                f"must use unique namespace:name pairs")
+        _REGISTRY[key] = meta
 
 
 def meta_for(kind: str, name: str, namespace: str = ""):
@@ -369,32 +379,38 @@ def discover_extensions(force: bool = False) -> list:
     logs-and-keeps-first; we fail loud).  Runs once per process unless
     `force`; returns the entry-point names loaded this call."""
     global _discovered, _strict_collisions
-    if _discovered and not force:
-        return []
-    import importlib.metadata as md
-    try:
-        eps = md.entry_points(group=ENTRY_POINT_GROUP)
-    except TypeError:       # pre-3.10 signature
-        eps = md.entry_points().get(ENTRY_POINT_GROUP, [])
-    loaded = []
-    _strict_collisions = True
-    try:
-        for ep in eps:
-            ident = f"{ep.name}={ep.value}"
-            if ident in _loaded_eps:
-                continue          # forced rescan: only NEW entry points run
-            reg = ep.load()
-            if not callable(reg):
-                raise ExtensionError(
-                    f"entry point {ep.name!r} in group "
-                    f"{ENTRY_POINT_GROUP!r} must load to a callable "
-                    f"register function, got {type(reg).__name__}")
-            reg()
-            _loaded_eps.add(ident)
-            loaded.append(ep.name)
-        # only a FULLY successful scan latches: a failing entry point can
-        # be fixed/uninstalled and the next manager retries the rest
-        _discovered = True
-    finally:
-        _strict_collisions = False
-    return loaded
+    # the whole scan runs under the registry lock: the strict-collision
+    # flag flip is never observable to concurrent register_meta callers
+    # (which would otherwise spuriously raise on a legitimate override),
+    # and two threads creating managers at once scan serially.  The
+    # discovery thread's own nested register_* calls re-enter the RLock.
+    with _REGISTRY_LOCK:
+        if _discovered and not force:
+            return []
+        import importlib.metadata as md
+        try:
+            eps = md.entry_points(group=ENTRY_POINT_GROUP)
+        except TypeError:       # pre-3.10 signature
+            eps = md.entry_points().get(ENTRY_POINT_GROUP, [])
+        loaded = []
+        _strict_collisions = True
+        try:
+            for ep in eps:
+                ident = f"{ep.name}={ep.value}"
+                if ident in _loaded_eps:
+                    continue      # forced rescan: only NEW entry points run
+                reg = ep.load()
+                if not callable(reg):
+                    raise ExtensionError(
+                        f"entry point {ep.name!r} in group "
+                        f"{ENTRY_POINT_GROUP!r} must load to a callable "
+                        f"register function, got {type(reg).__name__}")
+                reg()
+                _loaded_eps.add(ident)
+                loaded.append(ep.name)
+            # only a FULLY successful scan latches: a failing entry point
+            # can be fixed/uninstalled and the next manager retries the rest
+            _discovered = True
+        finally:
+            _strict_collisions = False
+        return loaded

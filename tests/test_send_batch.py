@@ -155,3 +155,135 @@ def test_send_batch_scalar_column_rejected():
     with pytest.raises(ValueError, match="1-d"):
         rt.input_handler("S").send_batch({"sym": "AB", "p": 1.0, "v": 1})
     m.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# columnar fast path (zero-copy BatchBuilder segments, PR 3)
+# ---------------------------------------------------------------------------
+
+def _capture_batches(app):
+    m = SiddhiManager()
+    rt = m.create_app_runtime(app)
+    batches = []
+    rt.add_batch_callback("S", batches.append)
+    rt.start()
+    return m, rt, batches
+
+
+PASSTHRU = HEAD + "from S select sym insert into Out;"
+
+
+def test_columnar_batch_byte_identical_to_row_path():
+    """The fast path must produce an EventBatch byte-identical (dtypes
+    and values, timestamps, seqs, string codes) to the per-row append
+    path fed the same data."""
+    data = [("A", 101.5, 1), ("B", -2.0, 7), ("A", 0.25, 3)]
+    ts = [1000, 1001, 1002]
+
+    m1, rt1, via_rows = _capture_batches(PASSTHRU)
+    for (s, p, v), t in zip(data, ts):
+        rt1.input_handler("S").send((s, p, v), timestamp=t)
+    rt1.flush()
+    m1.shutdown()
+
+    m2, rt2, via_cols = _capture_batches(PASSTHRU)
+    rt2.input_handler("S").send_batch(
+        {"sym": [s for s, _p, _v in data],
+         "p": np.array([p for _s, p, _v in data]),
+         "v": [v for _s, _p, v in data]},
+        timestamps=np.array(ts, np.int64))
+    rt2.flush()
+    m2.shutdown()
+
+    assert len(via_rows) == len(via_cols) == 1
+    br, bc = via_rows[0], via_cols[0]
+    assert br.n == bc.n
+    np.testing.assert_array_equal(br.timestamps, bc.timestamps)
+    assert br.timestamps.dtype == bc.timestamps.dtype
+    np.testing.assert_array_equal(br.seqs, bc.seqs)
+    for name in ("sym", "p", "v"):
+        assert br.columns[name].dtype == bc.columns[name].dtype, name
+        np.testing.assert_array_equal(br.columns[name], bc.columns[name])
+
+
+def test_columnar_dtype_coercion():
+    """Python lists / mismatched dtypes coerce to the schema's device
+    dtypes (double -> f64 column, int -> i32, long str codes -> i32)."""
+    m, rt, batches = _capture_batches(PASSTHRU)
+    rt.input_handler("S").send_batch(
+        {"sym": np.array([3, 4], np.int64),      # pre-encoded, wide dtype
+         "p": [1, 2],                            # ints for a double column
+         "v": np.array([7.0, 8.0])},             # floats for an int column
+        timestamps=[5, 6])
+    rt.flush()
+    b = batches[0]
+    assert b.columns["sym"].dtype == np.int32
+    assert b.columns["p"].dtype == np.float64
+    assert b.columns["p"].tolist() == [1.0, 2.0]
+    assert b.columns["v"].dtype == np.int32
+    assert b.columns["v"].tolist() == [7, 8]
+    m.shutdown()
+
+
+def test_columnar_string_encoding_vectorized_matches_row_path():
+    """str columns encode through the vectorized unique-gather path with
+    codes identical to per-row encode (same fresh dictionary)."""
+    syms = ["B", "A", "B", "C", "A", "B"]
+    m1, rt1, _ = _capture_batches(PASSTHRU)
+    row_codes = [rt1.strings.encode(s) for s in syms]
+    m1.shutdown()
+
+    m2, rt2, batches = _capture_batches(PASSTHRU)
+    rt2.input_handler("S").send_batch(
+        {"sym": syms, "p": np.zeros(6), "v": np.zeros(6, np.int32)})
+    rt2.flush()
+    assert batches[0].columns["sym"].tolist() == row_codes
+    # decode round-trips
+    assert [rt2.strings.decode(c) for c in
+            batches[0].columns["sym"].tolist()] == syms
+    m2.shutdown()
+
+
+def test_columnar_merges_buffered_rows_into_one_batch():
+    """Rows buffered via send() merge AHEAD of the columnar segment in
+    ONE micro-batch (previously a split pair), preserving order/seqs."""
+    m, rt, batches = _capture_batches(PASSTHRU)
+    h = rt.input_handler("S")
+    h.send(("R1", 1.0, 1), timestamp=100)
+    h.send(("R2", 2.0, 2), timestamp=101)
+    h.send_batch({"sym": ["C1", "C2"], "p": [3.0, 4.0], "v": [3, 4]},
+                 timestamps=[102, 103])
+    rt.flush()
+    assert len(batches) == 1
+    b = batches[0]
+    assert b.n == 4
+    assert b.timestamps.tolist() == [100, 101, 102, 103]
+    assert b.seqs.tolist() == [1, 2, 3, 4]
+    dec = [rt.strings.decode(c) for c in b.columns["sym"].tolist()]
+    assert dec == ["R1", "R2", "C1", "C2"]
+    m.shutdown()
+
+
+def test_columnar_unsorted_timestamps_do_not_rewind_playback_clock():
+    """Playback clock advances by the batch MAX timestamp: an unsorted
+    array whose last element is old must not rewind event time."""
+    m, rt, _ = _capture_batches("@app:playback\n" + PASSTHRU)
+    rt.input_handler("S").send_batch(
+        {"sym": ["A", "B", "C"], "p": [0.0] * 3, "v": [0] * 3},
+        timestamps=np.array([5000, 9000, 6000], np.int64))
+    rt.flush()
+    assert rt.now_ms() == 9000
+    m.shutdown()
+
+
+def test_columnar_zero_copy_adoption():
+    """A pure columnar send adopts the arrays without copying (the
+    struct-of-arrays fast path: no per-row python, no concat)."""
+    m, rt, batches = _capture_batches(PASSTHRU)
+    p = np.array([1.0, 2.0])
+    rt.input_handler("S").send_batch(
+        {"sym": np.array([1, 2], np.int32), "p": p,
+         "v": np.array([1, 2], np.int32)}, timestamps=[10, 11])
+    rt.flush()
+    assert batches[0].columns["p"] is p
+    m.shutdown()

@@ -307,6 +307,39 @@ def test_external_time_batch_sparse_buckets():
         "insert into O;", _et_rows(80, 62, gap=1500), 62)
 
 
+def test_external_time_batch_filtered_first_batch_anchor():
+    """A fully-filtered first micro-batch must NOT latch the bucket
+    anchor: the device kernel's argmax over an all-False valid mask
+    points at carry slot 0, and latching that garbage event-time would
+    permanently shift every bucket boundary vs the host path."""
+    q = ("from S[p > 0]#window.externalTimeBatch(et, 700) "
+         "select sum(p) as s, count() as c insert into O;")
+    r = random.Random(7)
+    ts, et, rows = 1000, 50_000, []
+    for i in range(60):
+        ts += r.randint(1, 50)
+        et += r.randint(0, 300)
+        # the first 6 rows (batch 1, see batch_sizes below) all fail the
+        # filter; later rows mix pass/fail
+        p = round(r.uniform(-90.0, -1.0), 2) if i < 6 \
+            else round(r.uniform(-50.0, 90.0), 2)
+        rows.append((ts, ("s0", p, 1, et)))
+    head = ("@app:playback define stream S (sym string, p double, "
+            "v long, et long);\n")
+    dev = run_app("@app:deviceWindows('always')\n" + head + q, rows,
+                  batch_sizes=[6] + [5] * 100)
+    host = run_app("@app:deviceWindows('never')\n" + head + q, rows,
+                   batch_sizes=[6] + [5] * 100)
+    assert len(dev) == len(host) and dev, (len(dev), len(host))
+    for d, h in zip(dev, host):
+        assert d[0] == h[0], (d, h)
+        for a, b in zip(d[1], h[1]):
+            if isinstance(a, float):
+                assert b == pytest.approx(a, rel=2e-5, abs=2e-4), (d, h)
+            else:
+                assert a == b, (d, h)
+
+
 def test_external_time_batch_device_engaged():
     m = SiddhiManager()
     rt = m.create_app_runtime(
