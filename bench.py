@@ -845,8 +845,153 @@ def native_baseline():
     return out
 
 
+def chaos_bench(seed: int = 7) -> dict:
+    """Seeded chaos harness (`--chaos [--seed N]`): runs the pattern,
+    window, and join configs clean and then under injected faults
+    (core/faults.py FaultInjector), asserting ZERO event loss and full
+    recovery:
+
+      * transient dispatch resource faults  -> ladder halves the work and
+        retries; outputs byte-identical to the clean run
+      * persistent dispatch resource faults -> plan quarantined onto the
+        interpreter path; outputs byte-identical to the clean run
+      * sink publish faults -> retried with backoff; payloads that
+        exhaust retries are captured in the ErrorStore and REPLAYED once
+        the transport recovers — every payload delivered exactly once
+
+    Deterministic under a fixed seed: the injector's schedule and the
+    backoff jitter both derive from it."""
+    import warnings
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.faults import FaultInjector
+    from siddhi_tpu.core.io import InMemoryBroker
+
+    PATTERN = """
+        @app:devicePatterns('prefer')
+        @OnError(action='store')
+        define stream S (sym string, p double);
+        from every a=S[p > 120] -> b=S[p < 80] within 1 sec
+        select a.sym as s1, b.sym as s2 insert into Out;
+    """
+    WINDOW = """
+        @OnError(action='store')
+        define stream S (sym string, p double);
+        from S#window.length(64) select sym, sum(p) as s, count() as c
+            group by sym insert into Out;
+    """
+    JOIN = """
+        @OnError(action='store')
+        define stream S (sym string, p double);
+        define stream T (sym string, p double);
+        from S#window.length(32) as a join T#window.length(32) as b
+            on a.sym == b.sym
+        select a.sym as sym, a.p as pa, b.p as pb insert into Out;
+    """
+
+    def feed(rt, streams, n_batches=8, batch=256, keys=8):
+        rng = np.random.default_rng(seed)
+        ts0 = 1_700_000_000_000
+        rows = []
+        rt.add_callback("Out", lambda evs: rows.extend(e.data for e in evs))
+        handlers = [rt.input_handler(s) for s in streams]
+        for k in range(n_batches):
+            for h in handlers:
+                h.send_batch(
+                    {"sym": [f"K{i % keys}" for i in range(batch)],
+                     "p": q4(rng.uniform(60.0, 140.0, batch))},
+                    ts0 + np.arange(k * batch, (k + 1) * batch,
+                                    dtype=np.int64) * 2)
+            rt.flush()
+        return sorted(map(tuple, rows))
+
+    def run(app, streams, injector=None):
+        mgr = SiddhiManager()
+        rt = mgr.create_app_runtime(app)
+        rt.fault_injector = injector
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                rows = feed(rt, streams)
+            lad = next(iter(rt._ladders.values()), None)
+            return rows, {
+                "halvings": lad.halvings if lad else 0,
+                "quarantined": bool(rt.statistics().get("degraded_plans")),
+                "injected": (rt.fault_injector.stats()["fired"]
+                             if rt.fault_injector else {})}
+        finally:
+            mgr.shutdown()
+
+    out = {"seed": seed, "configs": {}, "pass": True}
+    for name, app, streams in (("pattern", PATTERN, ["S"]),
+                               ("window", WINDOW, ["S"]),
+                               ("join", JOIN, ["S", "T"])):
+        clean, _ = run(app, streams)
+        halved, info_h = run(app, streams,
+                             FaultInjector(seed=seed,
+                                           counts={"dispatch": 2}))
+        quar, info_q = run(app, streams,
+                           FaultInjector(seed=seed,
+                                         counts={"dispatch": 10 ** 6}))
+        cfg = {"matches": len(clean),
+               "halving": {"identical": halved == clean, **info_h},
+               "quarantine": {"identical": quar == clean, **info_q}}
+        ok = (halved == clean and quar == clean and len(clean) > 0
+              and info_h["halvings"] >= 1 and not info_h["quarantined"]
+              and info_q["quarantined"])
+        cfg["pass"] = ok
+        out["configs"][name] = cfg
+        out["pass"] = out["pass"] and ok
+
+    # sink delivery under publish faults: retry, capture, replay
+    SINK = """
+        define stream S (x int);
+        @sink(type='inMemory', topic='chaos_out', on.error='store',
+              max.retries='2', retry.interval='1 ms',
+              breaker.threshold='4', breaker.reset='50 ms')
+        define stream Out (x int);
+        from S select x insert into Out;
+    """
+    got = []
+    InMemoryBroker.reset()
+    InMemoryBroker.subscribe("chaos_out", lambda m: got.append(m[0]))
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(SINK)
+    rt.fault_injector = FaultInjector(seed=seed,
+                                      rates={"sink.publish": 0.4})
+    rt.start()
+    h = rt.input_handler("S")
+    n_sink = 64
+    for i in range(n_sink):
+        h.send((i,))
+        rt.flush()
+    stored = len(rt.error_store)
+    rt.fault_injector = None            # transport recovers
+    replay = rt.error_store.replay(rt)
+    sink = rt.sinks[0]
+    sink_ok = (sorted(got) == list(range(n_sink))
+               and replay["remaining"] == 0)
+    out["sink"] = {"delivered": len(got), "expected": n_sink,
+                   "retries": sink.retries, "stored_then_replayed": stored,
+                   "breaker_opens": sink.metrics().get("circuit_opens", 0),
+                   "pass": sink_ok}
+    out["pass"] = out["pass"] and sink_ok
+    mgr.shutdown()
+    return out
+
+
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
+    if "--chaos" in argv:
+        seed = 7
+        if "--seed" in argv:
+            seed = int(argv[argv.index("--seed") + 1])
+        res = chaos_bench(seed)
+        print(json.dumps({"metric": "chaos_recovery",
+                          "value": 1 if res["pass"] else 0,
+                          "unit": "all_recovery_paths_lossless", **res}))
+        if not res["pass"]:
+            sys.exit(1)
+        return
     if "--smoke" in argv:
         # CI sanity (scripts/smoke.sh): a short pipelined-vs-unpipelined
         # run over the multi-plan config — asserts identical match

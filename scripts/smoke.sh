@@ -59,6 +59,13 @@ finally:
     svc.stop()
 EOF
 
+echo "== seeded chaos smoke =="
+# bench.py --chaos: injected dispatch + sink faults under a fixed seed;
+# asserts zero event loss and full recovery (ladder halving, interpreter
+# quarantine with byte-identical matches, sink retry/ErrorStore replay).
+# Exits nonzero if any recovery path loses or duplicates an event.
+python bench.py --chaos --seed 7
+
 echo "== pipelined-vs-unpipelined bench smoke =="
 # bench.py --smoke: short pipelined-vs-unpipelined run over the
 # multi-plan overlap config; asserts identical match counts and prints

@@ -138,6 +138,10 @@ def attach_table_writer(rt, plan, q: ast.Query, name: str):
                 q.output, rt.tables[target], plan.out_schema)
         except TableError as e:
             raise PlanError(f"query {name!r}: {e}") from None
+    # keep the (normalized) source AST: the fault layer rebuilds the plan
+    # on the interpreter path from it when a device plan is quarantined
+    # (runtime._build_twin)
+    plan._q_ast = q
     return plan
 
 

@@ -1,0 +1,474 @@
+"""Fault-tolerance layer: backoff, circuit breaking, the ErrorStore,
+graceful-degradation bookkeeping, and the seeded fault-injection harness.
+
+Reference surface: core:util/error/handler/* (ErrorHandlerUtils + the
+ErrorStore behind `@OnError(action='STORE')`), core:util/transport/
+BackoffRetryCounter.java:24 (the exponential ladder behind
+Source.connectWithRetry and sink publish retries), and the
+`on.error=...` sink option (SinkMapper/Sink error callbacks).
+
+TPU-framework twist: the unit of failure is a dispatched micro-batch or
+an in-flight device entry, not a single event — so recovery operates on
+EventBatches (split, requeue, replay) and on whole plans (degrade the
+device geometry, then quarantine the plan onto the `siddhi_tpu/interp/`
+host path).  Everything here is deterministic by construction: backoff
+jitter and the fault injector are seeded, so a chaos run replays
+identically under the same seed (`bench.py --chaos --seed N`).
+"""
+from __future__ import annotations
+
+import random
+import re
+import threading
+import time
+import zlib
+from collections import defaultdict
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+
+# ---------------------------------------------------------------------------
+# fault classification
+# ---------------------------------------------------------------------------
+
+class InjectedFault(RuntimeError):
+    """Raised by FaultInjector at an armed injection point.  `kind` is
+    "resource" (classified like a device OOM — drives the degradation
+    ladder) or "fault" (a generic processing error — drives @OnError)."""
+
+    def __init__(self, point: str, detail: str = "", kind: str = "fault"):
+        self.point = point
+        self.detail = detail
+        self.kind = kind
+        tag = "RESOURCE_EXHAUSTED: " if kind == "resource" else ""
+        super().__init__(f"{tag}injected fault at {point}"
+                         + (f" ({detail})" if detail else ""))
+
+
+_RESOURCE_RE = re.compile(
+    r"resource[ _]exhausted|out of memory|\boom\b|failed to allocate|"
+    r"allocation failure|memory exhausted")
+
+
+def is_resource_error(e: BaseException) -> bool:
+    """Does this look like device resource exhaustion (XLA OOM / HBM
+    pressure)?  Classification is by message: jax surfaces these as
+    XlaRuntimeError/RuntimeError with a RESOURCE_EXHAUSTED status or an
+    allocator message, and the exact exception type varies by backend
+    and jaxlib version.  ("oom" matches on word boundaries only — an
+    app-level "kaboom" must not read as an OOM.)"""
+    if isinstance(e, InjectedFault):
+        return e.kind == "resource"
+    msg = f"{type(e).__name__}: {e}".lower()
+    return _RESOURCE_RE.search(msg) is not None
+
+
+# ---------------------------------------------------------------------------
+# backoff (reference: BackoffRetryCounter.java:24)
+# ---------------------------------------------------------------------------
+
+class BackoffPolicy:
+    """Exponential backoff with seeded jitter; the ONE retry schedule
+    shared by sink publishes, source connects, and @OnError WAIT.
+
+    `delays()` yields the sleep before each RETRY (attempt 2..max_tries);
+    jitter multiplies each delay by a seeded uniform in
+    [1-jitter, 1+jitter] so retries de-synchronize across sinks while a
+    fixed seed keeps a chaos run reproducible.  `deadline_s` bounds the
+    total schedule (the WAIT semantics): delays stop once the cumulative
+    sleep would pass the deadline."""
+
+    def __init__(self, max_tries: int = 5, base_delay_s: float = 0.05,
+                 multiplier: float = 2.0, max_delay_s: float = 5.0,
+                 jitter: float = 0.25, seed: Optional[int] = None,
+                 deadline_s: Optional[float] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.max_tries = max(1, int(max_tries))
+        self.base_delay_s = float(base_delay_s)
+        self.multiplier = float(multiplier)
+        self.max_delay_s = float(max_delay_s)
+        self.jitter = float(jitter)
+        self.seed = seed
+        self.deadline_s = deadline_s
+        self.sleep = sleep
+
+    def delays(self):
+        rng = random.Random(self.seed)
+        d = self.base_delay_s
+        total = 0.0
+        for _ in range(self.max_tries - 1):
+            j = 1.0 + self.jitter * (2.0 * rng.random() - 1.0) \
+                if self.jitter else 1.0
+            delay = min(d * j, self.max_delay_s)
+            total += delay
+            if self.deadline_s is not None and total > self.deadline_s:
+                return
+            yield delay
+            d = min(d * self.multiplier, self.max_delay_s)
+
+    def run(self, fn: Callable, on_retry: Optional[Callable] = None):
+        """Call fn() up to max_tries times, sleeping the schedule between
+        attempts; `on_retry(attempt_index, error, delay)` fires before
+        each sleep.  Raises the last error when the schedule exhausts."""
+        delays = self.delays()
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except Exception as e:
+                delay = next(delays, None)
+                if delay is None:
+                    raise
+                if on_retry is not None:
+                    on_retry(attempt, e, delay)
+                self.sleep(delay)
+                attempt += 1
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker (per sink)
+# ---------------------------------------------------------------------------
+
+class CircuitBreaker:
+    """closed -> (threshold consecutive failures) -> open -> (reset
+    timeout) -> half-open -> one trial: success re-closes, failure
+    re-opens.  `allow()` gates attempts; an open breaker sheds load off
+    a dead transport instead of paying the full retry schedule per
+    payload."""
+
+    CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
+    _STATE_GAUGE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+    def __init__(self, failure_threshold: int = 5,
+                 reset_timeout_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.reset_timeout_s = float(reset_timeout_s)
+        self.clock = clock
+        self.state = self.CLOSED
+        self.consecutive_failures = 0
+        self.failures = 0
+        self.opens = 0
+        self._opened_at = 0.0
+
+    def allow(self) -> bool:
+        if self.state == self.OPEN:
+            if self.clock() - self._opened_at >= self.reset_timeout_s:
+                self.state = self.HALF_OPEN     # one probe may pass
+                return True
+            return False
+        return True
+
+    def on_success(self) -> None:
+        self.state = self.CLOSED
+        self.consecutive_failures = 0
+
+    def on_failure(self) -> None:
+        self.failures += 1
+        self.consecutive_failures += 1
+        if self.state == self.HALF_OPEN \
+                or self.consecutive_failures >= self.failure_threshold:
+            if self.state != self.OPEN:
+                self.opens += 1
+            self.state = self.OPEN
+            self._opened_at = self.clock()
+
+    def metrics(self) -> dict:
+        return {"circuit_state": self._STATE_GAUGE[self.state],
+                "circuit_opens": self.opens,
+                "circuit_failures": self.failures}
+
+
+# ---------------------------------------------------------------------------
+# error store (reference: @OnError(action='STORE') ErrorStore + replay)
+# ---------------------------------------------------------------------------
+
+def _py(v):
+    """numpy scalar -> plain python for JSON-safe entry dicts."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if hasattr(v, "item"):
+        try:
+            return v.item()
+        except Exception:
+            pass
+    return str(v)
+
+
+@dataclass
+class ErrorEntry:
+    """One captured failure: the events (or sink payloads) it cost, the
+    cause, and where it happened — enough to replay."""
+    id: int
+    stream_id: str
+    point: str                    # dispatch | sink.publish | source.map | ...
+    message: str
+    timestamp_ms: int
+    events: Optional[list] = None         # [(ts_ms, row_tuple), ...]
+    payloads: Optional[list] = None       # mapped sink payloads
+    sink: object = None                   # live Sink ref (in-memory store)
+    attempts: int = 0
+    replayed: bool = False
+
+    def to_dict(self) -> dict:
+        d = {"id": self.id, "stream": self.stream_id, "point": self.point,
+             "error": self.message, "timestamp": int(self.timestamp_ms),
+             "attempts": self.attempts, "replayed": self.replayed}
+        if self.events is not None:
+            d["events"] = [[int(ts), [_py(v) for v in row]]
+                           for ts, row in self.events]
+        if self.payloads is not None:
+            d["payloads"] = [_py(p) for p in self.payloads]
+        if self.sink is not None:
+            d["sink"] = type(self.sink).__name__
+        return d
+
+
+class ErrorStore:
+    """Bounded in-memory store of failed work.  `replay(rt)` re-sends
+    captured events into their origin stream (and re-publishes captured
+    sink payloads); replay failures re-capture, so nothing is silently
+    lost.  Served by `GET/POST /siddhi/errors` (service.py)."""
+
+    def __init__(self, capacity: int = 10_000):
+        self.capacity = int(capacity)
+        self.evicted = 0
+        self._entries: list = []
+        self._next_id = 1
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def add(self, stream_id: str, point: str, error, timestamp_ms: int,
+            events: Optional[list] = None, payloads: Optional[list] = None,
+            sink=None) -> ErrorEntry:
+        with self._lock:
+            ent = ErrorEntry(self._next_id, stream_id, point,
+                             f"{type(error).__name__}: {error}"
+                             if isinstance(error, BaseException) else str(error),
+                             int(timestamp_ms), events=events,
+                             payloads=payloads, sink=sink)
+            self._next_id += 1
+            self._entries.append(ent)
+            while len(self._entries) > self.capacity:
+                self._entries.pop(0)
+                self.evicted += 1
+            return ent
+
+    def entries(self, stream_id: Optional[str] = None) -> list:
+        with self._lock:
+            return [e for e in self._entries
+                    if stream_id is None or e.stream_id == stream_id]
+
+    def take(self, ids: Optional[list] = None) -> list:
+        """Remove and return entries (all, or just `ids`)."""
+        with self._lock:
+            if ids is None:
+                taken, self._entries = self._entries, []
+                return taken
+            want = set(ids)
+            taken = [e for e in self._entries if e.id in want]
+            self._entries = [e for e in self._entries if e.id not in want]
+            return taken
+
+    def _readd(self, ent: ErrorEntry) -> None:
+        with self._lock:
+            self._entries.append(ent)
+            while len(self._entries) > self.capacity:
+                self._entries.pop(0)
+                self.evicted += 1
+
+    def replay(self, rt, ids: Optional[list] = None) -> dict:
+        """Re-deliver captured work through the live runtime.  Event
+        entries re-enter their origin stream via the normal ingest path
+        (so a still-broken pipeline re-captures them); sink payload
+        entries re-publish through the sink's guarded path."""
+        from .runtime import Event
+        taken = self.take(ids)
+        replayed = failed = 0
+        for ent in taken:
+            try:
+                if ent.events:
+                    rt.send(ent.stream_id,
+                            [Event(int(ts), tuple(row))
+                             for ts, row in ent.events])
+                if ent.payloads:
+                    tgt = ent.sink
+                    if tgt is None:
+                        raise RuntimeError("transport no longer available")
+                    for p in ent.payloads:
+                        if hasattr(tgt, "publish_attempt"):   # sink payload
+                            tgt.publish_attempt(p)
+                        else:            # source.map capture: re-ingest
+                            tgt.deliver(p)
+                ent.replayed = True
+                replayed += 1
+            except Exception:
+                ent.attempts += 1
+                failed += 1
+                self._readd(ent)
+        rt.flush()
+        return {"replayed": replayed, "failed": failed,
+                "remaining": len(self)}
+
+
+# ---------------------------------------------------------------------------
+# graceful-degradation ladder bookkeeping (per plan)
+# ---------------------------------------------------------------------------
+
+class FaultLadder:
+    """Consecutive-failure counter behind the dispatch degradation
+    ladder: resource failure -> halve the work (batch/flush split, which
+    halves the device pad/chunk geometry) -> after K consecutive
+    failures, quarantine the plan onto the interpreter path."""
+
+    def __init__(self):
+        self.consecutive = 0
+        self.failures = 0
+        self.halvings = 0
+        self.quarantined = False
+        self.last_error = ""
+
+    def fail(self, e: BaseException) -> None:
+        self.consecutive += 1
+        self.failures += 1
+        self.last_error = f"{type(e).__name__}: {e}"
+
+    def ok(self) -> None:
+        self.consecutive = 0
+
+    def metrics(self) -> dict:
+        return {"dispatch_failures": self.failures,
+                "dispatch_halvings": self.halvings,
+                "dispatch_consecutive_failures": self.consecutive,
+                "quarantined": self.quarantined}
+
+
+def slice_batch(b, lo: int, hi: int):
+    """View-slice an EventBatch (numpy slices are views — no copy)."""
+    from .batch import EventBatch
+    return EventBatch(
+        b.schema, b.timestamps[lo:hi],
+        {k: v[lo:hi] for k, v in b.columns.items()}, hi - lo,
+        seqs=None if b.seqs is None else b.seqs[lo:hi],
+        nulls=None if b.nulls is None
+        else {k: v[lo:hi] for k, v in b.nulls.items()})
+
+
+def split_batch(b) -> list:
+    """Halve one EventBatch (the pad/chunk geometry of a re-dispatch is
+    derived from batch.n, so halving the batch halves the device
+    footprint)."""
+    mid = b.n // 2
+    return [slice_batch(b, 0, mid), slice_batch(b, mid, b.n)]
+
+
+def split_buffered(bufs: list) -> Optional[list]:
+    """Halve a finalize flush: [(sid, batch), ...] -> [first, second]
+    buffered lists ordered by global seq, or None when nothing is left
+    to split.  Feeding the halves through two finalize rounds is
+    equivalent to the events arriving in two flushes — which the plans
+    already handle (batch-size invariance)."""
+    def first_seq(sb):
+        b = sb[1]
+        return int(b.seqs[0]) if b.seqs is not None and len(b.seqs) else 0
+    bufs = sorted(bufs, key=first_seq)
+    if len(bufs) >= 2:
+        mid = len(bufs) // 2
+        return [bufs[:mid], bufs[mid:]]
+    if bufs and bufs[0][1].n >= 2:
+        sid, b = bufs[0]
+        b1, b2 = split_batch(b)
+        return [[(sid, b1)], [(sid, b2)]]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# seeded fault injection
+# ---------------------------------------------------------------------------
+
+class FaultInjector:
+    """Deterministic fault injection at the five recovery boundaries:
+
+      dispatch        device kernel dispatch (plans' jitted calls)
+      d2h             device->host materialization (DispatchPipeline)
+      sink.publish    Sink.publish attempts
+      source.connect  Source.connect attempts
+      persist.save    persistence store writes
+
+    `counts` arms a burst: the first N checks at a point fail.  `rates`
+    arms a per-check probability drawn from a per-point rng seeded from
+    (seed, point) — the same seed replays the same fault schedule.
+    Keys are "point" or "point@detail-substring" (target one plan/sink).
+    `kinds` overrides the raised fault's classification per key; by
+    default `dispatch` faults are "resource" (they exercise the
+    degradation ladder) and everything else is "fault" (@OnError /
+    retry paths)."""
+
+    POINTS = ("dispatch", "d2h", "sink.publish", "source.connect",
+              "persist.save")
+
+    def __init__(self, seed: int = 0, counts: Optional[dict] = None,
+                 rates: Optional[dict] = None, kinds: Optional[dict] = None):
+        self.seed = int(seed)
+        self.counts = dict(counts or {})
+        self.rates = dict(rates or {})
+        self.kinds = dict(kinds or {})
+        self.fired: dict = defaultdict(int)
+        self.checked: dict = defaultdict(int)
+        self._rngs: dict = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, spec: str, seed: int = 0) -> "FaultInjector":
+        """'dispatch=3,sink.publish=0.5,d2h@plan=2' — integers arm
+        bursts (counts), floats in (0,1) arm rates."""
+        counts: dict = {}
+        rates: dict = {}
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, _, val = part.partition("=")
+            v = float(val)
+            if v < 1.0 and "." in val:
+                rates[key] = v
+            else:
+                counts[key] = int(v)
+        return cls(seed=seed, counts=counts, rates=rates)
+
+    def _match(self, table: dict, point: str, detail: str):
+        for key, val in table.items():
+            p, _, d = key.partition("@")
+            if p == point and (not d or d in (detail or "")):
+                return key, val
+        return None, None
+
+    def _kind(self, key: str, point: str) -> str:
+        k = self.kinds.get(key) or self.kinds.get(point)
+        if k is not None:
+            return k
+        return "resource" if point == "dispatch" else "fault"
+
+    def check(self, point: str, detail: str = "") -> None:
+        """Raise InjectedFault when this point is armed; no-op otherwise."""
+        with self._lock:
+            self.checked[point] += 1
+            key, n = self._match(self.counts, point, detail)
+            if key is not None and self.fired[key] < n:
+                self.fired[key] += 1
+                raise InjectedFault(point, detail, self._kind(key, point))
+            key, r = self._match(self.rates, point, detail)
+            if key is not None:
+                rng = self._rngs.get(key)
+                if rng is None:
+                    rng = self._rngs[key] = random.Random(
+                        self.seed ^ zlib.crc32(key.encode()))
+                if rng.random() < r:
+                    self.fired[key] += 1
+                    raise InjectedFault(point, detail, self._kind(key, point))
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"fired": dict(self.fired), "checked": dict(self.checked)}

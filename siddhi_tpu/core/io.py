@@ -359,6 +359,11 @@ class Source:
         self.mapper = mapper
         self.connected = False
         self.handler: Optional[SourceHandler] = None
+        # telemetry: malformed messages silently dropped (logged-only)
+        # vs captured into the ErrorStore — surfaced in statistics()
+        # and the Prometheus exposition
+        self.dropped_events = 0
+        self.stored_events = 0
 
     # -- SPI -----------------------------------------------------------------
 
@@ -375,17 +380,40 @@ class Source:
         try:
             rows = self.mapper.map(message)
         except Exception as e:
-            if ("!" + self.stream_id) in self.rt.schemas:
+            action = self.rt.fault_action(self.stream_id)
+            # log/wait (and no action) all DROP a map error — a malformed
+            # payload is deterministic, there is nothing to wait out —
+            # so telemetry records the true disposition, not the action
+            self.rt.stats.on_fault(
+                self.stream_id,
+                f"source.{action}" if action in ("stream", "store")
+                else "source.drop")
+            if action == "stream":
                 self.rt._route_fault_rows(self.stream_id, [],
                                           f"map error: {e}", raw=message)
+            elif action == "store":
+                # capture the raw payload for replay through this source
+                # (ErrorStore.replay sees .deliver and re-feeds the
+                # mapper; a still-broken payload re-captures)
+                self.rt.error_store.add(
+                    self.stream_id, "source.map", e, self.rt.now_ms(),
+                    payloads=[message], sink=self)
+                self.stored_events += 1
             else:
-                # no @OnError fault stream: log and drop the malformed
+                # no routing configured: log and drop the malformed
                 # message (reference SourceMapper does the same) instead of
-                # raising into the transport and starving co-subscribers
+                # raising into the transport and starving co-subscribers —
+                # but COUNT it (dropped_events rides statistics() and
+                # /metrics, so the drop is no longer invisible)
+                self.dropped_events += 1
+                hint = ("@OnError(action={a!r}) applies to processing "
+                        "faults; map errors drop".format(a=action)
+                        if action else
+                        "add @OnError(action='stream') to route to a fault "
+                        "stream (or 'store' to capture for replay)")
                 warnings.warn(
                     f"source on {self.stream_id!r}: dropping unmappable "
-                    f"message ({e}); add @OnError(action='stream') to route "
-                    f"to a fault stream", RuntimeWarning)
+                    f"message ({e}); {hint}", RuntimeWarning)
             return
         if self.handler is not None:
             rows = self.handler.on_rows(rows)
@@ -400,21 +428,25 @@ class Source:
     def connect_with_retry(self, max_tries: int = 5,
                            base_delay_s: float = 0.05) -> None:
         """Exponential-backoff connect (reference:
-        Source.connectWithRetry + BackoffRetryCounter)."""
-        delay = base_delay_s
-        for attempt in range(max_tries):
-            try:
-                self.connect()
-                self.connected = True
-                return
-            except Exception as e:
-                if attempt == max_tries - 1:
-                    raise
-                warnings.warn(f"source {type(self).__name__} on "
-                              f"{self.stream_id!r}: connect failed ({e}); "
-                              f"retrying in {delay:.2f}s", RuntimeWarning)
-                time.sleep(delay)
-                delay *= 2
+        Source.connectWithRetry + BackoffRetryCounter) — unified on the
+        faults.BackoffPolicy schedule shared with sink publishes."""
+        import zlib
+        from .faults import BackoffPolicy
+        policy = BackoffPolicy(max_tries=max_tries,
+                               base_delay_s=base_delay_s,
+                               seed=zlib.crc32(self.stream_id.encode()))
+
+        def attempt():
+            self.rt.inject("source.connect", self.stream_id)
+            self.connect()
+
+        def on_retry(i, e, delay):
+            warnings.warn(f"source {type(self).__name__} on "
+                          f"{self.stream_id!r}: connect failed ({e}); "
+                          f"retrying in {delay:.2f}s", RuntimeWarning)
+
+        policy.run(attempt, on_retry=on_retry)
+        self.connected = True
 
 
 class InMemorySource(Source):
@@ -446,6 +478,25 @@ class CallbackSource(Source):
 # ---------------------------------------------------------------------------
 
 class Sink:
+    """Publish-side transport with optional fault tolerance:
+    `@sink(..., on.error='log'|'store'|'stream'|'wait')` arms a
+    per-payload retry with exponential backoff + seeded jitter
+    (faults.BackoffPolicy — the same schedule as connect_with_retry) and
+    a per-sink circuit breaker.  `on.error` names the disposition once
+    retries exhaust (or the breaker is open):
+
+      log    - log and drop the payload (reference default)
+      store  - capture into the runtime ErrorStore for replay
+      stream - route into the "!<stream>" fault stream (falls back to
+               the ErrorStore when none is defined)
+      wait   - extend retries to a deadline (`retry.timeout`, default
+               10 sec), then store
+
+    Knobs: max.retries (3), retry.interval ('50 ms'), retry.max.interval
+    ('5 sec'), breaker.threshold (5), breaker.reset ('5 sec').  Without
+    on.error the legacy fail-fast path is kept: publish errors propagate
+    to the caller."""
+
     def __init__(self, rt, stream_id: str, options: dict, mapper: SinkMapper):
         self.rt = rt
         self.stream_id = stream_id
@@ -453,6 +504,38 @@ class Sink:
         self.mapper = mapper
         self.connected = False
         self.handler: Optional[SinkHandler] = None
+        self.published = 0
+        self.retries = 0
+        self.failures = 0
+        self.stored = 0
+        self.on_error = (options.get("on.error") or "").lower() or None
+        self.breaker = None
+        self.backoff = None
+        if self.on_error is not None:
+            if self.on_error not in ("log", "store", "stream", "wait"):
+                raise PlanError(
+                    f"sink on {stream_id!r}: unknown on.error "
+                    f"{self.on_error!r} (have: log | store | stream | wait)")
+            import zlib
+            from .faults import BackoffPolicy, CircuitBreaker
+            from .runtime import _parse_interval_s
+
+            def _iv(key, default):
+                v = options.get(key)
+                return _parse_interval_s(v) if v is not None else default
+            deadline = _iv("retry.timeout", 10.0) \
+                if self.on_error == "wait" else None
+            self.backoff = BackoffPolicy(
+                max_tries=(1_000_000 if self.on_error == "wait"
+                           else int(options.get("max.retries", 3)) + 1),
+                base_delay_s=_iv("retry.interval", 0.05),
+                max_delay_s=_iv("retry.max.interval", 5.0),
+                deadline_s=deadline,
+                seed=zlib.crc32(f"{stream_id}/{options.get('topic', '')}"
+                                .encode()))
+            self.breaker = CircuitBreaker(
+                failure_threshold=int(options.get("breaker.threshold", 5)),
+                reset_timeout_s=_iv("breaker.reset", 5.0))
 
     def connect(self) -> None:
         raise NotImplementedError
@@ -468,8 +551,77 @@ class Sink:
             events = self.handler.on_events(events)
             if not events:
                 return
-        for payload in self.mapper.map(events):
-            self.publish(payload)
+        payloads = self.mapper.map(events)
+        if self.on_error is None:       # legacy fail-fast path
+            for payload in payloads:
+                self.publish_attempt(payload)
+                self.published += 1
+            return
+        for payload in payloads:
+            self._publish_guarded(payload)
+
+    # -- guarded publish (retry + breaker + on.error) -----------------------
+
+    def publish_attempt(self, payload) -> None:
+        """One raw publish attempt through the fault-injection point
+        (also the replay entry used by ErrorStore.replay)."""
+        self.rt.inject("sink.publish", self.stream_id)
+        self.publish(payload)
+
+    def _publish_guarded(self, payload) -> None:
+        if not self.breaker.allow():
+            # open breaker: shed straight to the disposition instead of
+            # hammering a dead transport per payload
+            self._exhausted(payload, RuntimeError(
+                f"circuit breaker open for sink on {self.stream_id!r}"))
+            return
+        err = None
+        delays = self.backoff.delays()
+        while True:
+            try:
+                self.publish_attempt(payload)
+            except Exception as e:
+                err = e
+                self.failures += 1
+                self.breaker.on_failure()
+                if self.breaker.state == self.breaker.OPEN:
+                    break
+                delay = next(delays, None)
+                if delay is None:
+                    break
+                self.retries += 1
+                time.sleep(delay)
+                continue
+            self.breaker.on_success()
+            self.published += 1
+            return
+        self._exhausted(payload, err)
+
+    def _exhausted(self, payload, err) -> None:
+        rt = self.rt
+        act = self.on_error
+        rt.stats.on_fault(self.stream_id, f"sink.{act}")
+        if act == "stream" and ("!" + self.stream_id) in rt.schemas:
+            rt._route_fault_rows(self.stream_id, [],
+                                 f"sink publish failed: {err}", raw=payload)
+            return
+        if act in ("store", "stream", "wait"):
+            rt.error_store.add(self.stream_id, "sink.publish", err,
+                               rt.now_ms(), payloads=[payload], sink=self)
+            self.stored += 1
+            return
+        import logging
+        logging.getLogger("siddhi_tpu.faults").error(
+            "sink on %r: dropping payload after retries "
+            "(@sink on.error='log'): %s: %s",
+            self.stream_id, type(err).__name__, err)
+
+    def metrics(self) -> dict:
+        m = {"published": self.published, "retries": self.retries,
+             "failures": self.failures, "stored": self.stored}
+        if self.breaker is not None:
+            m.update(self.breaker.metrics())
+        return m
 
 
 class DistributedSink(Sink):

@@ -442,9 +442,26 @@ class DeviceJoinPlan(QueryPlan):
         return ({k: v[order] for k, v in cols.items()}, ts[order],
                 seq[order], n)
 
+    # degradation-ladder contract: finalize restores its input buffer on
+    # a dispatch failure (so the runtime may retry with a halved flush);
+    # once mirrors advance the flush passed its point of no return and
+    # _finalize_retry_ok drops, forcing propagation instead of a retry
+    # that would double-advance window mirrors
+    retryable_finalize = True
+
     def finalize(self) -> list:
         if not self._buffered:
             return []
+        snapshot = list(self._buffered)
+        self._finalize_retry_ok = True
+        try:
+            return self._finalize_impl()
+        except Exception:
+            if self._finalize_retry_ok:
+                self._buffered = snapshot
+            raise
+
+    def _finalize_impl(self) -> list:
         bufs, self._buffered = self._buffered, []
         with self.rt.stats.stage("host_build", plan=self.name):
             lc, lts, lseq, ln = self._side_arrays(self.left, bufs)
@@ -485,6 +502,7 @@ class DeviceJoinPlan(QueryPlan):
             # The pipeline then defers the blocking pull: depth-D across
             # flushes, and within one dispatch round the runtime collects
             # AFTER every other device plan has dispatched (overlap)
+            self._finalize_retry_ok = False     # mirrors advance now
             self.left.update_mirror(lc, lts, lseq, np.ones(ln, bool))
             self.right.update_mirror(rc, rts, rseq, np.ones(rn, bool))
             return self._pipe.push(entry)
@@ -493,6 +511,9 @@ class DeviceJoinPlan(QueryPlan):
 
     def _dispatch(self, lev, rev, TL, TR, NL, NR, meta, M=None,
                   mirror_snap=None) -> dict:
+        # dispatch-boundary fault injection: raising here (before any
+        # mirror advance) keeps the flush retryable
+        self.rt.inject("dispatch", self.name)
         M = M if M is not None else max(self._m_hint, 16)
         if not self.rt.stats.enabled:
             res = self._block_fn(TL, TR, NL, NR, M)(lev, rev)
@@ -567,6 +588,7 @@ class DeviceJoinPlan(QueryPlan):
                     comp_cols[sk][nm] = take(M)
         if update_mirrors:
             # entry mirrors were pre-advance: the probe saw the old ones
+            self._finalize_retry_ok = False
             self.left.update_mirror(me["lc"], me["lts"], me["lseq"], pl)
             self.right.update_mirror(me["rc"], me["rts"], me["rseq"], pr)
         return self._assemble(entry, nL, nR, aL, bL, aR, bR, comp_cols,

@@ -212,6 +212,10 @@ class DevicePatternPlan(QueryPlan):
                 self._pipe = DispatchPipeline(
                     name, lambda e: [self._materialize_chunk(e)],
                     depth=self.pipeline_depth)
+                # chunked blocks are stateless on device and finalize
+                # rolls its host bookkeeping back on failure — the
+                # degradation ladder may halve and retry the flush
+                self.retryable_finalize = True
         # device grids shipped per block: only attrs some predicate or
         # capture row reads, per scode
         self._grid_attrs: list = sorted(self._needed_grid_attrs())
@@ -389,6 +393,7 @@ class DevicePatternPlan(QueryPlan):
     def _call_block(self, kern: NFAKernel, T: int, M: int, st, ev):
         """Invoke one jitted NFA block recording compile/kernel stage,
         block-cache hit/miss, and the H2D payload size."""
+        self.rt.inject("dispatch", self.name)   # fault-injection boundary
         stats = self.rt.stats
         if not stats.enabled:
             return kern.block_fn(T, M)(st, ev)
@@ -418,7 +423,19 @@ class DevicePatternPlan(QueryPlan):
         return []
 
     def finalize(self) -> list:
-        return self._rows_to_batches(self._finalize_chunks())
+        if self._chunk_cfg is None or not self._buffered:
+            return self._rows_to_batches(self._finalize_chunks())
+        # chunked mode is retryable (degradation ladder): blocks carry no
+        # device state, and _run_chunked_flat rolls back its host-side
+        # tail/seq bookkeeping on a dispatch failure — so restoring the
+        # input buffer makes a failed flush fully re-runnable (possibly
+        # split in half by the runtime)
+        snapshot = list(self._buffered)
+        try:
+            return self._rows_to_batches(self._finalize_chunks())
+        except Exception:
+            self._buffered = snapshot
+            raise
 
     def _finalize_chunks(self) -> list:
         if not self._buffered:
@@ -630,7 +647,19 @@ class DevicePatternPlan(QueryPlan):
         """One stateless flat block per flush: [replayed tail | new events]
         split into K own-chunks, gathered into lanes on device.  Blocks
         carry no device state, so flushes pipeline independently
-        (@app:devicePipeline) and retries are self-contained."""
+        (@app:devicePipeline) and retries are self-contained.  A dispatch
+        failure rolls the host-side tail/seq bookkeeping back so the
+        runtime's degradation ladder can re-run the flush."""
+        saved = (self._tail, self._prev_last_seq, self._last_seq,
+                 getattr(self, "_chunk_F", 0))
+        try:
+            return self._run_chunked_flat_inner(ts, seq, scode, cols)
+        except Exception:
+            (self._tail, self._prev_last_seq, self._last_seq,
+             self._chunk_F) = saved
+            raise
+
+    def _run_chunked_flat_inner(self, ts, seq, scode, cols) -> list:
         with self.rt.stats.stage("host_build", plan=self.name):
             cfg = self._chunk_cfg
             W = int(cfg["W"])

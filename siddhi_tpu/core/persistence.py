@@ -30,6 +30,7 @@ class FileSystemPersistenceStore:
 
     def __init__(self, directory: str):
         self.dir = directory
+        self.corrupt_skipped = 0    # unpicklable revisions skipped on restore
         os.makedirs(directory, exist_ok=True)
 
     def _app_dir(self, app: str) -> str:
@@ -82,20 +83,48 @@ class IncrementalFileSystemPersistenceStore(FileSystemPersistenceStore):
         prefix = "F-" if is_full else "I-"
         self.save(app, prefix + revision, blob)
 
+    def _load_checked(self, app: str, rev: str) -> Optional[bytes]:
+        """The revision's blob, or None when it is unpicklable/truncated.
+        Corruption must not brick recovery: a bad blob is skipped
+        (counted + warned) and restore falls back to older revisions."""
+        import warnings
+        try:
+            blob = self.load(app, rev)
+            pickle.loads(blob)
+            return blob
+        except Exception as e:
+            self.corrupt_skipped += 1
+            warnings.warn(
+                f"persistence: skipping corrupt revision {rev!r} "
+                f"({type(e).__name__}: {e})", RuntimeWarning)
+            return None
+
     def restore_chain(self, app: str) -> Optional[tuple]:
-        """(full_blob, [delta_blobs...], newest_time) for the newest full
-        revision; deltas are selected by their embedded timestamp, NOT by
-        string order (the 'I-'/'F-' prefixes don't sort together)."""
+        """(full_blob, [delta_blobs...], newest_time) for the newest
+        LOADABLE full revision; deltas are selected by their embedded
+        timestamp, NOT by string order (the 'I-'/'F-' prefixes don't sort
+        together).  Corrupt/truncated blobs — a crash mid-write of the
+        newest revision — are skipped: a corrupt full falls back to the
+        previous full, a corrupt delta is dropped from the chain."""
         revs = self.revisions(app)
         fulls = [r for r in revs if r.startswith("F-")]
+        base_blob = None
+        while fulls:
+            base_blob = self._load_checked(app, fulls[-1])
+            if base_blob is not None:
+                break
+            fulls.pop()
         if not fulls:
             return None
         base = fulls[-1]
-        deltas = [r for r in revs
-                  if r.startswith("I-") and _rev_time(r) > _rev_time(base)]
-        newest = _rev_time(deltas[-1] if deltas else base)
-        return (self.load(app, base), [self.load(app, d) for d in deltas],
-                newest)
+        deltas = []     # [(rev, blob)] — validated once, blob reused
+        for r in revs:
+            if r.startswith("I-") and _rev_time(r) > _rev_time(base):
+                blob = self._load_checked(app, r)
+                if blob is not None:
+                    deltas.append((r, blob))
+        newest = _rev_time(deltas[-1][0] if deltas else base)
+        return (base_blob, [b for _r, b in deltas], newest)
 
 
 class AsyncSnapshotPersistor:
@@ -107,6 +136,10 @@ class AsyncSnapshotPersistor:
         self._threads: list = []
 
     def persist(self, fn, *args) -> threading.Thread:
+        # prune finished writers: a caller that never wait()s must not
+        # accumulate one dead Thread object per persist() forever
+        self._threads = [t for t in self._threads if t.is_alive()]
+
         def run():
             try:
                 fn(*args)

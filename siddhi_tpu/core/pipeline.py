@@ -66,7 +66,8 @@ class DispatchPipeline:
     """
 
     __slots__ = ("plan", "depth", "entries", "_materialize", "_t_disp",
-                 "_held", "dispatches", "max_depth", "overlap_s", "wait_s")
+                 "_held", "dispatches", "max_depth", "overlap_s", "wait_s",
+                 "origin", "_origins", "inject", "_ready")
 
     def __init__(self, plan_name: str, materialize: Callable,
                  depth: int = 0):
@@ -80,9 +81,23 @@ class DispatchPipeline:
         self.max_depth = 0
         self.overlap_s = 0.0
         self.wait_s = 0.0
+        # fault attribution + injection (core/faults.py): the runtime
+        # sets `origin` to the (stream_id, batch) a dispatch round is
+        # processing; push() snapshots it per entry so a materialization
+        # failure D batches later still names the batch it belongs to
+        # (@OnError routing stays exact under pipelining).  `inject` is
+        # the "d2h" fault-injection hook, wired by _register_plan.
+        self.origin = None
+        self._origins: list = []
+        self.inject: Optional[Callable] = None
+        # results materialized but not yet handed to the caller: a later
+        # entry failing mid-drain must not discard an earlier entry's
+        # already-materialized outputs — they survive here and return on
+        # the next collect/drain (zero silent loss under @OnError)
+        self._ready: list = []
 
     def __len__(self) -> int:
-        return len(self.entries)
+        return len(self.entries) + len(self._ready)
 
     # -- dispatch side ---------------------------------------------------
 
@@ -91,6 +106,7 @@ class DispatchPipeline:
         entries beyond the configured depth — unless a dispatch round is
         held open, in which case they wait for collect()."""
         self.entries.append(entry)
+        self._origins.append(self.origin)
         self._t_disp.append(time.perf_counter())
         self.dispatches += 1
         if len(self.entries) > self.max_depth:
@@ -116,14 +132,30 @@ class DispatchPipeline:
         return self._drain_to(0)
 
     def _drain_to(self, target: int) -> list:
-        out: list = []
         while len(self.entries) > target:
             entry = self.entries.pop(0)
+            origin = self._origins.pop(0)
             t_disp = self._t_disp.pop(0)
             t0 = time.perf_counter()
             self.overlap_s += t0 - t_disp
-            out.extend(self._materialize(entry))
+            try:
+                if self.inject is not None:
+                    self.inject()       # "d2h" fault-injection point
+                self._ready.extend(self._materialize(entry))
+            except Exception as e:
+                # attribute the failure to the batch this entry was
+                # dispatched for; the entry is consumed — later entries
+                # stay queued and earlier entries' materialized results
+                # stay in _ready, so subsequent collects keep flowing
+                if origin is not None \
+                        and getattr(e, "fault_origin", None) is None:
+                    try:
+                        e.fault_origin = origin
+                    except Exception:
+                        pass
+                raise
             self.wait_s += time.perf_counter() - t0
+        out, self._ready = self._ready, []
         return out
 
     # -- retry support (plans that must replay the in-flight chain) ------
@@ -133,11 +165,16 @@ class DispatchPipeline:
         the pre-states of everything dispatched after the failed entry
         are invalid and the whole chain re-dispatches)."""
         entries, self.entries, self._t_disp = self.entries, [], []
+        self._origins = []
         return entries
 
     def requeue(self, entries: list) -> None:
         now = time.perf_counter()
         self.entries.extend(entries)
+        # re-dispatched replay entries: origin attribution is lost (they
+        # aggregate a replayed chain) — fault routing falls back to
+        # propagation for these
+        self._origins.extend([None] * len(entries))
         self._t_disp.extend([now] * len(entries))
 
     # -- telemetry -------------------------------------------------------

@@ -142,6 +142,21 @@ class QueryPlan:
     table_writer = None           # set when output_target is a table
     _pipe = None                  # DispatchPipeline when the plan defers
                                   # D2H pulls (pipeline.py)
+    rt = None                     # owning runtime (set by _register_plan
+                                  # when the plan doesn't hold it already)
+    _q_ast = None                 # normalized source Query AST (set by
+                                  # build.attach_table_writer; enables the
+                                  # interpreter-quarantine twin)
+    # graceful-degradation contract (core/faults.py ladder):
+    # retryable_process: process() leaves plan state untouched when the
+    # device dispatch raises, so the runtime may retry with a split batch.
+    # retryable_finalize: finalize() restores its input buffer
+    # (self._buffered) when the dispatch raises, so the runtime may retry
+    # with a halved flush; _finalize_retry_ok goes False once a flush
+    # passed its point of no return (e.g. join mirrors advanced).
+    retryable_process = False
+    retryable_finalize = False
+    _finalize_retry_ok = True
 
     def process(self, stream_id: str, batch: EventBatch) -> list:
         raise NotImplementedError
@@ -202,6 +217,8 @@ class FilterProjectPlan(QueryPlan):
     Reference equivalents: FilterProcessor.java:55 loop + QuerySelector
     projection; here: one fused jit over whole columns.
     """
+
+    retryable_process = True        # stateless: safe to re-dispatch splits
 
     def __init__(self, name: str, in_schema: StreamSchema, alias: str,
                  filters: list, selector: ast.Selector,
@@ -298,6 +315,8 @@ class FilterProjectPlan(QueryPlan):
             return self._pipe.push((None, [], host_env, batch, mask))
         env = {k: host_env[k] for k in sorted(self._need)
                if k in host_env and host_env[k].dtype != np.dtype(object)}
+        if self.rt is not None:
+            self.rt.inject("dispatch", self.name)
         mask_w, outs = self._step(env)
         from .pipeline import start_d2h
         start_d2h([mask_w] + list(outs))    # pulls overlap device compute

@@ -186,15 +186,51 @@ class SiddhiAppRuntime:
         self.sources: list = []
         self.sinks: list = []
 
-        # @OnError(action='stream') fault streams: schema = original attrs +
-        # _error string, registered under "!<id>" (reference:
-        # StreamJunction.java:77-103 fault-stream routing)
+        # @OnError handling per stream (reference: StreamJunction.java:77-139
+        # OnErrorAction LOG/STREAM/STORE/WAIT):
+        #   log    - log the failure, drop the failing batch's results
+        #   stream - reroute the batch into the "!<id>" fault stream
+        #            (schema = original attrs + _error string)
+        #   store  - capture events + cause into the runtime's ErrorStore
+        #            (replayable; GET/POST /siddhi/errors)
+        #   wait   - block ingest, retrying the failed work with backoff
+        #            until a deadline (@OnError(action='wait',
+        #            timeout='10 sec'))
+        self._onerror: dict = {}
+        self._onerror_wait: dict = {}
         for sid, sd in list(app.stream_definitions.items()):
             oe = qast.find_annotation(sd.annotations, "onerror")
-            if oe is not None and (oe.element("action") or "stream").lower() == "stream":
+            if oe is None:
+                continue
+            action = (oe.element("action") or "stream").lower()
+            if action not in ("log", "stream", "store", "wait"):
+                raise PlanError(
+                    f"stream {sid!r}: unknown @OnError action {action!r} "
+                    f"(have: log | stream | store | wait)")
+            self._onerror[sid] = action
+            if action == "stream":
                 self.schemas["!" + sid] = StreamSchema(
                     "!" + sid, tuple(sd.attributes) + (
                         qast.Attribute("_error", qast.AttrType.STRING),))
+            elif action == "wait":
+                to = next((v for k, v in oe.elements
+                           if k and k.lower() in ("timeout", "wait.timeout")),
+                          None)
+                self._onerror_wait[sid] = \
+                    _parse_interval_s(to) if to else 10.0
+
+        # fault-tolerance state: the replayable ErrorStore behind
+        # @OnError(action='store') and sink on.error, the per-plan
+        # degradation ladders, and the (optional) seeded fault injector
+        from .faults import ErrorStore
+        self.error_store = ErrorStore()
+        self.fault_injector = None      # set a faults.FaultInjector to arm
+        self._ladders: dict = {}        # plan name -> FaultLadder
+        self._degraded: list = []       # quarantined-plan records
+        qa = qast.find_annotation(app.annotations, "app:quarantineAfter")
+        # consecutive resource failures before a device plan is
+        # quarantined onto the interpreter path
+        self.quarantine_after = int(qa.element()) if qa is not None else 3
 
         self._plans: list[QueryPlan] = []
         self._subscribers: dict = defaultdict(list)   # stream_id -> [plan]
@@ -258,6 +294,12 @@ class SiddhiAppRuntime:
     def _register_plan(self, plan: QueryPlan) -> None:
         self._plans.append(plan)
         self._plan_by_name[plan.name] = plan
+        if getattr(plan, "rt", None) is None:
+            plan.rt = self      # fault-injection + recovery back-ref
+        pipe = getattr(plan, "_pipe", None)
+        if pipe is not None:
+            # D2H-readback injection point (faults.FaultInjector "d2h")
+            pipe.inject = (lambda p=plan: self.inject("d2h", p.name))
         self._known_query_names.add(getattr(plan, "callback_name", plan.name))
         for sid in plan.input_streams:
             self._subscribers[sid].append(plan)
@@ -742,7 +784,7 @@ class SiddhiAppRuntime:
                     "runaway stream recursion (insert-into cycle?)")
             progressed = False
             for plan in self._plans:
-                for ob in plan.flush_pending():
+                for ob in self._guarded_collect(plan, "flush_pending"):
                     self._emit(plan, ob)
                     progressed = True
             if not progressed and not self._pending:
@@ -826,12 +868,23 @@ class SiddhiAppRuntime:
                 progressed = False
                 for plan in self._plans:
                     plan.begin_dispatch_round()
+                    pipe = getattr(plan, "_pipe", None)
+                    if pipe is not None:
+                        # finalize-round entries merge several batches:
+                        # no single origin to attribute faults to
+                        pipe.origin = None
                 for plan in self._plans:
-                    for ob in plan.finalize():
+                    try:
+                        obs = plan.finalize()
+                    except Exception as e:
+                        obs = self._recover_finalize(plan, e)
+                        if obs is None:
+                            raise
+                    for ob in obs:
                         self._emit(plan, ob)
                         progressed = True
                 for plan in self._plans:
-                    for ob in plan.collect_ready():
+                    for ob in self._guarded_collect(plan):
                         self._emit(plan, ob)
                         progressed = True
                 if not self._pending and not progressed:
@@ -857,6 +910,11 @@ class SiddhiAppRuntime:
                 # result pull (collect below) — cross-plan overlap
                 for plan in subs:
                     plan.begin_dispatch_round()
+                    pipe = getattr(plan, "_pipe", None)
+                    if pipe is not None:
+                        # entries pushed while this batch is processed
+                        # belong to it: fault attribution under pipelining
+                        pipe.origin = (sid, batch)
                 for plan in subs:
                     if self._debugger is not None:
                         self._debugger.check_in(plan, batch)
@@ -867,10 +925,12 @@ class SiddhiAppRuntime:
                         else:
                             obs = plan.process(sid, batch)
                     except Exception as e:
-                        if ("!" + sid) not in self.schemas:
-                            raise
-                        fault_err = e        # route once per batch, below
-                        continue
+                        obs = self._recover_process(plan, sid, batch, e)
+                        if obs is None:
+                            if self.fault_action(sid) is None:
+                                raise
+                            fault_err = e    # route once per batch, below
+                            continue
                     if self._debugger is not None:
                         self._debugger.check_out(plan, obs)
                     for ob in obs:
@@ -879,22 +939,318 @@ class SiddhiAppRuntime:
                     try:
                         obs = plan.collect_ready()
                     except Exception as e:
-                        # fault-route only when the plan materializes the
-                        # CURRENT batch here (depth 0); at depth > 0 the
-                        # failed entry belongs to an EARLIER batch, and
-                        # rerouting this batch's events would misattribute
-                        # the error — propagate instead (same surface as a
-                        # failure at the flush barrier)
+                        # pipelined entries carry their origin batch: a
+                        # depth-D materialization failure routes the batch
+                        # it BELONGS to (which may be D batches old), so
+                        # @OnError stays exact under @app:devicePipeline
+                        origin = getattr(e, "fault_origin", None)
+                        if origin is not None:
+                            osid, obatch = origin
+                            if obatch is batch:
+                                if self.fault_action(sid) is None:
+                                    raise
+                                fault_err = fault_err or e
+                                continue
+                            if not self._handle_batch_fault(osid, obatch, e):
+                                raise
+                            continue
                         depth = getattr(getattr(plan, "_pipe", None),
                                         "depth", 0)
-                        if depth or ("!" + sid) not in self.schemas:
+                        if depth or self.fault_action(sid) is None:
                             raise
-                        fault_err = e
+                        fault_err = fault_err or e
                         continue
                     for ob in obs:
                         self._emit(plan, ob)
                 if fault_err is not None:
-                    self._route_fault_batch(sid, batch, fault_err)
+                    if not self._handle_batch_fault(sid, batch, fault_err):
+                        raise fault_err
+
+    # -- fault handling ------------------------------------------------------
+
+    def fault_action(self, sid: str) -> Optional[str]:
+        """The @OnError action configured for a stream (None = fail-fast)."""
+        return self._onerror.get(sid)
+
+    def inject(self, point: str, detail: str = "") -> None:
+        """Fault-injection check (no-op unless a faults.FaultInjector is
+        armed on `rt.fault_injector`)."""
+        inj = self.fault_injector
+        if inj is not None:
+            inj.check(point, detail)
+
+    def _ladder(self, plan) -> "FaultLadder":
+        from .faults import FaultLadder
+        lad = self._ladders.get(plan.name)
+        if lad is None:
+            lad = self._ladders[plan.name] = FaultLadder()
+        return lad
+
+    def _guarded_collect(self, plan, fn_name: str = "collect_ready") -> list:
+        """collect_ready/flush_pending with origin-attributed fault
+        routing: a pipelined entry that fails to materialize routes the
+        batch it was dispatched for (per its stream's @OnError action)
+        while later entries keep flowing."""
+        try:
+            return getattr(plan, fn_name)()
+        except Exception as e:
+            origin = getattr(e, "fault_origin", None)
+            if origin is None or not self._handle_batch_fault(
+                    origin[0], origin[1], e):
+                raise
+            return []
+
+    def _handle_batch_fault(self, sid: str, batch: EventBatch, err) -> bool:
+        """Dispose of one failed batch per the stream's @OnError action.
+        Returns False when the error must propagate (no action, or
+        action 'wait' — which is handled at the retry site)."""
+        action = self.fault_action(sid)
+        if action is None or action == "wait":
+            return False
+        self.stats.on_fault(sid, action)
+        if action == "log":
+            import logging
+            logging.getLogger("siddhi_tpu.faults").error(
+                "stream %r: dropping results of a %d-event batch per "
+                "@OnError(action='log'): %s: %s",
+                sid, batch.n, type(err).__name__, err)
+            return True
+        if action == "store":
+            rows = [(int(ts), row) for ts, row in
+                    zip(batch.timestamps, batch.rows(self.strings))]
+            self.error_store.add(sid, "dispatch", err, self.now_ms(),
+                                 events=rows)
+            return True
+        return self._route_fault_batch(sid, batch, err)
+
+    def _recover_process(self, plan, sid: str, batch: EventBatch, err):
+        """Recovery for a plan.process failure: the degradation ladder
+        for resource exhaustion on retryable device plans, blocking
+        retry for @OnError(action='wait').  Returns the recovered
+        OutputBatches, or None when unrecovered (caller falls back to
+        @OnError disposition / raise)."""
+        from .faults import is_resource_error
+        if is_resource_error(err) and getattr(plan, "retryable_process",
+                                              False):
+            return self._ladder_process(plan, sid, batch, err)
+        if self.fault_action(sid) == "wait":
+            return self._wait_retry(plan, sid, batch, err)
+        return None
+
+    def _ladder_process(self, plan, sid: str, batch: EventBatch, err):
+        """Degradation ladder, process-dispatching plans: halve the batch
+        (the device pad geometry derives from batch.n, so a retry runs at
+        half the footprint); after `quarantine_after` CONSECUTIVE
+        failures, quarantine the plan onto the interpreter path and feed
+        it the still-unprocessed pieces — no event is lost or doubled."""
+        from .faults import is_resource_error, split_batch
+        lad = self._ladder(plan)
+        lad.fail(err)
+        if batch.n >= 2:
+            lad.halvings += 1
+            stack = split_batch(batch)
+        else:
+            stack = [batch]
+        out: list = []
+        while stack:
+            if lad.consecutive >= self.quarantine_after:
+                twin = self._try_quarantine(plan, err)
+                if twin is None:
+                    return None
+                for b in stack:
+                    out.extend(twin.process(sid, b))
+                return out
+            b = stack.pop(0)
+            try:
+                obs = plan.process(sid, b)
+            except Exception as e:
+                if not is_resource_error(e):
+                    raise
+                err = e
+                lad.fail(e)
+                if b.n >= 2:
+                    lad.halvings += 1
+                    stack[:0] = split_batch(b)
+                else:
+                    stack.insert(0, b)
+                continue
+            lad.ok()
+            out.extend(obs)
+            # materialize before the next retry dispatch: recovery can
+            # re-dispatch several times inside ONE held dispatch round,
+            # and stacking those in flight would exceed the PadPool's
+            # rotation guarantee (an in-flight entry's upload pad must
+            # not be refilled before the device consumed it)
+            pipe = getattr(plan, "_pipe", None)
+            if pipe is not None and len(pipe):
+                out.extend(plan.flush_pending())
+        return out
+
+    def _recover_finalize(self, plan, err):
+        """Degradation ladder, finalize-dispatching plans (patterns,
+        joins — they buffer per stream and dispatch the merged flush):
+        halve the flush (two finalize rounds are equivalent to the events
+        arriving in two flushes), then quarantine.  Requires the plan to
+        restore its input buffer on a finalize failure
+        (retryable_finalize contract)."""
+        from .faults import is_resource_error, split_buffered
+        if not is_resource_error(err) \
+                or not getattr(plan, "retryable_finalize", False) \
+                or not getattr(plan, "_finalize_retry_ok", True):
+            return None
+        lad = self._ladder(plan)
+        lad.fail(err)
+        bufs = list(getattr(plan, "_buffered", ()))
+        plan._buffered = []
+        halves = split_buffered(bufs)
+        if halves:
+            lad.halvings += 1
+            work = halves
+        else:
+            work = [bufs] if bufs else []
+        out: list = []
+        while work:
+            if lad.consecutive >= self.quarantine_after:
+                twin = self._try_quarantine(plan, err)
+                if twin is None:
+                    # hand the events back so nothing is silently lost
+                    plan._buffered = [sb for chunk in work for sb in chunk]
+                    return None
+                for chunk in work:
+                    for s, b in chunk:
+                        out.extend(twin.process(s, b))
+                out.extend(twin.finalize())
+                return out
+            chunk = work.pop(0)
+            plan._buffered = chunk
+            try:
+                obs = plan.finalize()
+            except Exception as e:
+                if not is_resource_error(e) \
+                        or not getattr(plan, "_finalize_retry_ok", True):
+                    raise
+                err = e
+                lad.fail(e)
+                chunk = list(plan._buffered)    # restored by the plan
+                plan._buffered = []
+                halves = split_buffered(chunk)
+                if halves:
+                    lad.halvings += 1
+                    work[:0] = halves
+                else:
+                    work.insert(0, chunk)
+                continue
+            lad.ok()
+            out.extend(obs)
+            # same in-flight bound as _ladder_process: one recovery
+            # dispatch at a time, materialized before the next retry
+            pipe = getattr(plan, "_pipe", None)
+            if pipe is not None and len(pipe):
+                out.extend(plan.flush_pending())
+        return out
+
+    def _wait_retry(self, plan, sid: str, batch: EventBatch, err):
+        """@OnError(action='wait'): block ingest (we hold the runtime
+        lock) retrying the failed work with backoff until the configured
+        deadline, then give up loudly."""
+        from .faults import BackoffPolicy
+        timeout = self._onerror_wait.get(sid, 10.0)
+        deadline = time.monotonic() + timeout
+        self.stats.on_fault(sid, "wait")
+        policy = BackoffPolicy(max_tries=1_000_000,
+                               base_delay_s=min(0.02, timeout / 16),
+                               max_delay_s=max(timeout / 8, 0.02), seed=0)
+        for delay in policy.delays():
+            if time.monotonic() + delay > deadline:
+                break
+            time.sleep(delay)
+            try:
+                return plan.process(sid, batch)
+            except Exception as e:
+                err = e
+        raise RuntimeError(
+            f"{sid}: @OnError(action='wait') gave up after {timeout:.3g}s: "
+            f"{type(err).__name__}: {err}") from err
+
+    def _try_quarantine(self, plan, err):
+        """Swap a failing device plan for its interpreter twin
+        (byte-identical semantics — the parity suites assert it).  The
+        twin takes over from the CURRENT point in the stream: results
+        already delivered stay delivered; retained device window/tail
+        contents from before the quarantine are sacrificed for forward
+        progress (documented in docs/RELIABILITY.md).  Returns None when
+        no interpreter twin exists for this plan shape."""
+        import warnings
+        try:
+            twin = self._build_twin(plan)
+        except Exception as e:
+            warnings.warn(
+                f"plan {plan.name!r}: interpreter quarantine unavailable "
+                f"({type(e).__name__}: {e}); propagating the device error",
+                RuntimeWarning)
+            return None
+        # deliver what's still materializable in flight, then discard
+        pipe = getattr(plan, "_pipe", None)
+        if pipe is not None:
+            try:
+                for ob in plan.flush_pending():
+                    self._emit(plan, ob)
+            except Exception as e2:
+                origin = getattr(e2, "fault_origin", None)
+                if origin is None or not self._handle_batch_fault(
+                        origin[0], origin[1], e2):
+                    self.error_store.add(
+                        plan.name, "quarantine.flush", e2, self.now_ms())
+            pipe.take_all()
+        self._swap_plan(plan, twin)
+        lad = self._ladder(plan)
+        lad.quarantined = True
+        self._degraded.append({
+            "plan": plan.name, "at_ms": self.now_ms(),
+            "after_failures": lad.failures,
+            "error": f"{type(err).__name__}: {err}"})
+        warnings.warn(
+            f"plan {plan.name!r} quarantined onto the interpreter path "
+            f"after {lad.consecutive} consecutive device dispatch "
+            f"failures ({type(err).__name__}: {err})", RuntimeWarning)
+        return twin
+
+    def _swap_plan(self, plan, twin) -> None:
+        """Replace `plan` with `twin` everywhere the runtime holds it
+        (plan list, name index, stream subscriptions), preserving the
+        callback identity and table writer."""
+        twin.callback_name = getattr(plan, "callback_name", plan.name)
+        twin.table_writer = plan.table_writer
+        self._plans[self._plans.index(plan)] = twin
+        self._plan_by_name[plan.name] = twin
+        for lst in self._subscribers.values():
+            for j, p in enumerate(lst):
+                if p is plan:
+                    lst[j] = twin
+        for s in twin.input_streams:
+            if twin not in self._subscribers[s]:
+                self._subscribers[s].append(twin)
+
+    def _build_twin(self, plan):
+        """Construct the interpreter-path twin of a device plan from the
+        (normalized) query AST it was planned from."""
+        q = plan._q_ast
+        if q is None:
+            raise PlanError(f"plan {plan.name!r} has no source query AST")
+        inp = q.input
+        from ..interp.expr import udf_scope
+        with udf_scope(getattr(self, "udfs", None)):
+            if isinstance(inp, qast.JoinInputStream):
+                from ..interp.joins import InterpJoinQueryPlan
+                return InterpJoinQueryPlan(plan.name, self, q, inp,
+                                           plan.output_target)
+            if isinstance(inp, qast.StateInputStream):
+                from ..interp.engine import InterpPatternQueryPlan
+                return InterpPatternQueryPlan(plan.name, self, q, inp,
+                                              plan.output_target)
+            from ..interp.engine import InterpSingleQueryPlan
+            return InterpSingleQueryPlan(plan.name, self, q, inp,
+                                         plan.output_target)
 
     def _route_fault_batch(self, sid: str, batch: EventBatch, err) -> bool:
         """@OnError(action='stream'): reroute a failing batch's events into
@@ -919,7 +1275,11 @@ class SiddhiAppRuntime:
         fault_id = "!" + sid
         fs = self.schemas.get(fault_id)
         if fs is None:
-            raise RuntimeError(f"{sid}: {msg} (no @OnError fault stream)")
+            raise RuntimeError(
+                f"{sid}: {msg} (no @OnError fault stream; annotate the "
+                f"stream with @OnError(action='stream') — or use "
+                f"action='store' to capture into the replayable ErrorStore, "
+                f"'log' to log-and-drop, 'wait' to block-and-retry)")
         with self._lock:
             bb = BatchBuilder(fs, self.strings)
             n_attrs = len(fs.attributes) - 1
@@ -998,10 +1358,37 @@ class SiddhiAppRuntime:
             # dedup by seq (chunked replay compares against the last
             # emitted completion seq — a restarted counter re-suppresses)
             "seq": self._seq,
+            # quarantined plans: their state above is in the interpreter
+            # twin's format — restore must re-quarantine before loading
+            "degraded": list(self._degraded),
         }
 
     def restore(self, snap: dict) -> None:
         self.strings.restore(snap["strings"])
+        # a snapshot taken AFTER a quarantine carries that plan's state in
+        # the interpreter twin's format: swap the live device plan for a
+        # fresh twin first, so load_state_dict meets matching state
+        for rec in snap.get("degraded", ()):
+            plan = self._plan_by_name.get(rec.get("plan"))
+            if plan is None or type(plan).__name__.startswith("Interp"):
+                if rec not in self._degraded:
+                    self._degraded.append(rec)
+                continue
+            try:
+                twin = self._build_twin(plan)
+            except Exception as e:
+                import warnings
+                warnings.warn(
+                    f"restore: plan {rec.get('plan')!r} was quarantined in "
+                    f"this snapshot but no interpreter twin could be built "
+                    f"({e}); its state is skipped", RuntimeWarning)
+                snap = {**snap, "plans": {k: v for k, v in
+                                          snap["plans"].items()
+                                          if k != rec.get("plan")}}
+                continue
+            self._swap_plan(plan, twin)
+            self._ladder(plan).quarantined = True
+            self._degraded.append(rec)
         # partition groups first: they re-create lazily-cloned instance plans
         # that later entries of the snapshot refer to
         items = sorted(snap["plans"].items(),
@@ -1026,6 +1413,7 @@ class SiddhiAppRuntime:
             raise RuntimeError("no persistence store configured")
         import pickle
         store = self.manager.persistence_store
+        self.inject("persist.save", self.app.name)
         rev = f"{self.app.name}-{time.time_ns()}"
         if incremental and hasattr(store, "save_incremental"):
             with self._lock:
@@ -1095,10 +1483,12 @@ class SiddhiAppRuntime:
         store = self.manager.persistence_store
         chain = store.restore_chain(self.app.name) \
             if hasattr(store, "restore_chain") else None
-        rev = store.last_revision(self.app.name)
+        candidates = None
         if chain is not None:
             # prefer whichever is NEWER: the incremental chain or a plain
-            # full snapshot written later in the same store
+            # full snapshot written later in the same store (the chain is
+            # already corruption-filtered — restore_chain skips
+            # unpicklable blobs and falls back to an earlier full)
             from .persistence import _rev_time
             base, deltas, chain_time = chain
             plain = [r for r in getattr(store, "revisions")(self.app.name)
@@ -1108,9 +1498,30 @@ class SiddhiAppRuntime:
                 for d in deltas:
                     self._apply_incremental_blob(pickle.loads(d))
                 return
-            rev = plain[-1]
-        if rev is not None:
-            self.restore_revision(rev)
+            candidates = plain
+        if candidates is None:
+            if hasattr(store, "revisions"):
+                # an 'I-' delta is never standalone-restorable (its table
+                # op-logs assume the base full's state) — the walk-back
+                # considers only plain and 'F-' full revisions
+                candidates = [r for r in store.revisions(self.app.name)
+                              if not r.startswith("I-")]
+            else:
+                rev = store.last_revision(self.app.name)
+                candidates = [rev] if rev is not None else []
+        # a corrupt/truncated newest revision must not brick recovery:
+        # walk back to the newest LOADABLE revision, counting skips
+        for rev in reversed(candidates):
+            try:
+                self.restore_revision(rev)
+                return
+            except (pickle.PickleError, EOFError, ValueError) as e:
+                import warnings
+                self.restore_skipped = getattr(self, "restore_skipped", 0) + 1
+                warnings.warn(
+                    f"persistence: revision {rev!r} is corrupt "
+                    f"({type(e).__name__}: {e}); falling back to the "
+                    f"previous revision", RuntimeWarning)
 
 
 class InMemoryPersistenceStore:

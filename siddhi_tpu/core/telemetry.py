@@ -555,6 +555,53 @@ def render_prometheus(reports: dict) -> str:
                             "device-side gauges (lane occupancy, frontier "
                             "width, buffer fill, drops)",
                             {**pl, "metric": key}, v)
+        # fault-tolerance series (core/faults.py)
+        for scope, fd in rep.get("faults", {}).items():
+            for action, n in fd.items():
+                doc.add("siddhi_tpu_faults_total", "counter",
+                        "fault dispositions per stream and action",
+                        {**al, "stream": scope, "action": action}, n)
+        if "degraded_plans" in rep:
+            doc.add("siddhi_tpu_degraded_plans", "gauge",
+                    "device plans quarantined onto the interpreter path",
+                    al, len(rep["degraded_plans"]))
+        es = rep.get("error_store")
+        if es:
+            doc.add("siddhi_tpu_error_store_entries", "gauge",
+                    "replayable entries captured in the ErrorStore", al,
+                    es.get("entries", 0))
+            doc.add("siddhi_tpu_error_store_evicted_total", "counter",
+                    "ErrorStore entries evicted by the capacity bound", al,
+                    es.get("evicted", 0))
+        for sid, sd in rep.get("sources", {}).items():
+            sl = {**al, "stream": sid}
+            doc.add("siddhi_tpu_source_dropped_events_total", "counter",
+                    "malformed source messages logged and dropped", sl,
+                    sd.get("dropped_events", 0))
+            doc.add("siddhi_tpu_source_stored_events_total", "counter",
+                    "malformed source messages captured in the ErrorStore",
+                    sl, sd.get("stored_events", 0))
+        _SINK_COUNTERS = (("published", "siddhi_tpu_sink_published_total",
+                           "payloads delivered per sink"),
+                          ("retries", "siddhi_tpu_sink_retries_total",
+                           "publish retries per sink"),
+                          ("failures", "siddhi_tpu_sink_failures_total",
+                           "publish attempt failures per sink"),
+                          ("stored", "siddhi_tpu_sink_stored_total",
+                           "payloads captured in the ErrorStore per sink"))
+        for label, m in rep.get("sinks", {}).items():
+            kl = {**al, "sink": label}
+            for key, name, help_ in _SINK_COUNTERS:
+                if m.get(key):
+                    doc.add(name, "counter", help_, kl, m[key])
+            if "circuit_state" in m:
+                doc.add("siddhi_tpu_sink_circuit_state", "gauge",
+                        "per-sink circuit breaker state "
+                        "(0=closed 1=half-open 2=open)", kl,
+                        m["circuit_state"])
+                doc.add("siddhi_tpu_sink_circuit_opens_total", "counter",
+                        "times the per-sink circuit breaker opened", kl,
+                        m.get("circuit_opens", 0))
     # process-wide (not per-app): emitted ONCE, unlabeled — an app label
     # would duplicate the same counter N times across a multi-app scrape
     # and N-fold overcount any PromQL sum()
@@ -588,6 +635,9 @@ class StatisticsManager:
         self.query: dict = defaultdict(Tracker)
         self.stages: dict = defaultdict(Tracker)
         self.device: dict = defaultdict(lambda: defaultdict(float))
+        # fault dispositions per stream/scope (ALWAYS counted — faults
+        # are rare and must be visible even with statistics off)
+        self.faults: dict = defaultdict(lambda: defaultdict(int))
         self.tracer = PipelineTracer()
         self._t0 = time.perf_counter()
         self.reporter = None
@@ -660,6 +710,12 @@ class StatisticsManager:
             return
         self.stages[name].observe(seconds, events)
 
+    def on_fault(self, scope: str, action: str) -> None:
+        """One fault disposition (scope = stream or sink label, action =
+        the @OnError / on.error disposition taken).  Not gated on
+        `enabled`: a dropped batch must never be invisible."""
+        self.faults[scope][action] += 1
+
     def on_kernel_cache(self, plan: str, hit: bool) -> None:
         if self.enabled:
             self.device[plan]["cache_hits" if hit else "cache_misses"] += 1
@@ -715,6 +771,11 @@ class StatisticsManager:
                     out.setdefault(p.name, {}).update(pipe.metrics())
                 except Exception:
                     pass
+        # degradation-ladder gauges (consecutive dispatch failures,
+        # halvings, quarantine flag) — keyed by the original plan name,
+        # which survives the interpreter swap
+        for name, lad in list(getattr(self.rt, "_ladders", {}).items()):
+            out.setdefault(name, {}).update(lad.metrics())
         return out
 
     def report(self) -> dict:
@@ -732,6 +793,39 @@ class StatisticsManager:
             rep["device"] = dev
         if XLA_CACHE["hits"] or XLA_CACHE["misses"]:
             rep["xla_cache"] = dict(XLA_CACHE)
+        # fault-tolerance surface (core/faults.py): dispositions taken,
+        # quarantined plans, source drop counters, sink retry/breaker
+        # gauges, ErrorStore fill — all additive keys, present only when
+        # non-empty so fault-free reports keep their shape
+        faults = {k: dict(v) for k, v in list(self.faults.items())}
+        if faults:
+            rep["faults"] = faults
+        degraded = list(getattr(self.rt, "_degraded", ()))
+        if degraded:
+            rep["degraded_plans"] = [d["plan"] for d in degraded]
+            rep["degraded_detail"] = degraded
+        es = getattr(self.rt, "error_store", None)
+        if es is not None and (len(es) or es.evicted):
+            rep["error_store"] = {"entries": len(es), "evicted": es.evicted}
+        sources: dict = {}
+        for s in getattr(self.rt, "sources", ()):
+            if s.dropped_events or s.stored_events:
+                d = sources.setdefault(s.stream_id, {"dropped_events": 0,
+                                                     "stored_events": 0})
+                d["dropped_events"] += s.dropped_events
+                d["stored_events"] += s.stored_events
+        if sources:
+            rep["sources"] = sources
+        sinks: dict = {}
+        for i, s in enumerate(getattr(self.rt, "sinks", ())):
+            try:
+                m = s.metrics()
+            except Exception:
+                continue
+            if any(m.values()):
+                sinks[f"{s.stream_id}[{i}]"] = m
+        if sinks:
+            rep["sinks"] = sinks
         return rep
 
     def prometheus(self) -> str:

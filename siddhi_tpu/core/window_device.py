@@ -218,6 +218,9 @@ class DeviceWindowAggPlan(QueryPlan):
 
     C_START = 1024          # initial carry capacity for time windows
     L_CAP = 1 << 16         # larger length windows stay on host
+    # device state commits only after a successful dispatch, so process()
+    # is safe to retry with split batches (degradation ladder)
+    retryable_process = True
 
     def __init__(self, name: str, rt, q: ast.Query,
                  inp: ast.SingleInputStream, target: Optional[str]):
@@ -954,6 +957,11 @@ class DeviceWindowAggPlan(QueryPlan):
 
     def _dispatch(self, env: dict, batch: EventBatch, T: int) -> dict:
         from .pipeline import start_d2h
+        # dispatch-boundary fault injection (core/faults.py); state
+        # commits only after the call returns, so a raise here leaves the
+        # plan retryable (the runtime's degradation ladder re-dispatches
+        # with a split batch — half the pad footprint)
+        self.rt.inject("dispatch", self.name)
         pre = self.state
         if not self.rt.stats.enabled:
             res = self._step_fn(T, self.C)(self.state, env)

@@ -14,6 +14,14 @@ Endpoints (JSON unless noted):
   GET  /siddhi/artifact/stats?siddhiApp=<name>
   GET  /metrics[?siddhiApp=<name>]  Prometheus text exposition (0.0.4) over
                                     every deployed app (or just <name>)
+  GET  /siddhi/errors?siddhiApp=<name>[&stream=<id>]
+                                    list the app's ErrorStore entries
+                                    (@OnError(action='store') captures,
+                                    exhausted sink publishes)
+  POST /siddhi/errors               {"app": ..., "action": "replay"|
+                                     "discard", "ids": optional [int]}
+                                    replay captured events/payloads through
+                                    the live runtime, or drop them
 
 Deployed runtimes run with statistics ENABLED (a served engine is meant
 to be scraped; one clock read per micro-batch) unless the app itself
@@ -83,6 +91,16 @@ class SiddhiService:
                         req = json.loads(self._body())
                         rows = service.store_query(req["app"], req["query"])
                         self._reply(200, {"rows": rows})
+                    elif path == "/siddhi/errors":
+                        req = json.loads(self._body())
+                        app = req.get("app")
+                        if app not in service.runtimes:
+                            self._reply(404, {"error":
+                                              f"no deployed app {app!r}"})
+                        else:
+                            self._reply(200, service.errors_action(
+                                app, req.get("action", "replay"),
+                                req.get("ids")))
                     else:
                         self._reply(404, {"error": f"no route {path}"})
                 except Exception as e:
@@ -105,6 +123,14 @@ class SiddhiService:
                                               f"no deployed app {app!r}"})
                         else:
                             self._reply(200, service.stats(app))
+                    elif u.path == "/siddhi/errors":
+                        app = q.get("siddhiApp", [None])[0]
+                        if app not in service.runtimes:
+                            self._reply(404, {"error":
+                                              f"no deployed app {app!r}"})
+                        else:
+                            self._reply(200, service.errors(
+                                app, q.get("stream", [None])[0]))
                     elif u.path == "/metrics":
                         app = q.get("siddhiApp", [None])[0]
                         if app is not None and app not in service.runtimes:
@@ -153,6 +179,24 @@ class SiddhiService:
 
     def stats(self, app: str) -> dict:
         return self.runtimes[app].stats.report()
+
+    def errors(self, app: str, stream: Optional[str] = None) -> dict:
+        """The app's ErrorStore entries (JSON-safe dicts)."""
+        store = self.runtimes[app].error_store
+        return {"errors": [e.to_dict() for e in store.entries(stream)],
+                "evicted": store.evicted}
+
+    def errors_action(self, app: str, action: str, ids=None) -> dict:
+        """Replay (re-ingest events / re-publish payloads) or discard
+        captured failures."""
+        rt = self.runtimes[app]
+        if action == "replay":
+            return rt.error_store.replay(rt, ids)
+        if action == "discard":
+            return {"discarded": len(rt.error_store.take(ids)),
+                    "remaining": len(rt.error_store)}
+        raise ValueError(f"unknown errors action {action!r} "
+                         f"(replay | discard)")
 
     def metrics(self, app: Optional[str] = None) -> str:
         """Prometheus text exposition rendered LIVE from every deployed

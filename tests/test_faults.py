@@ -1,0 +1,709 @@
+"""Fault-tolerance layer (core/faults.py + runtime/io/persistence
+surgery): the full @OnError action set, ErrorStore + replay, sink
+retry/backoff + circuit breaker, device-dispatch graceful degradation
+(batch halving -> interpreter quarantine with byte-identical outputs),
+and the seeded fault-injection harness that drives it all."""
+import warnings
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.faults import (BackoffPolicy, CircuitBreaker,
+                                    ErrorStore, FaultInjector,
+                                    InjectedFault, is_resource_error)
+from siddhi_tpu.core.io import InMemoryBroker
+
+
+@pytest.fixture
+def mgr():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+    InMemoryBroker.reset()
+
+
+def collect(rt, stream):
+    rows = []
+    rt.add_callback(stream, lambda evs: rows.extend(e.data for e in evs))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+def test_backoff_schedule_deterministic():
+    a = list(BackoffPolicy(max_tries=5, base_delay_s=0.1, seed=42).delays())
+    b = list(BackoffPolicy(max_tries=5, base_delay_s=0.1, seed=42).delays())
+    assert a == b and len(a) == 4
+    # exponential envelope with +/-25% jitter
+    for i, d in enumerate(a):
+        nominal = 0.1 * 2 ** i
+        assert 0.74 * nominal <= d <= 1.26 * nominal
+    # deadline bounds the cumulative schedule
+    short = list(BackoffPolicy(max_tries=100, base_delay_s=0.1, jitter=0.0,
+                               deadline_s=0.35).delays())
+    assert sum(short) <= 0.35 and len(short) == 2
+
+
+def test_backoff_run_retries_then_raises():
+    calls = []
+    pol = BackoffPolicy(max_tries=3, base_delay_s=0.001, seed=0,
+                        sleep=lambda s: calls.append(s))
+    tries = []
+
+    def fn():
+        tries.append(1)
+        raise ValueError("nope")
+    with pytest.raises(ValueError):
+        pol.run(fn)
+    assert len(tries) == 3 and len(calls) == 2
+
+
+def test_circuit_breaker_transitions():
+    t = [0.0]
+    br = CircuitBreaker(failure_threshold=2, reset_timeout_s=10.0,
+                        clock=lambda: t[0])
+    assert br.allow() and br.state == br.CLOSED
+    br.on_failure()
+    assert br.state == br.CLOSED and br.allow()
+    br.on_failure()
+    assert br.state == br.OPEN and not br.allow()
+    t[0] = 11.0                     # reset timeout elapses -> half-open probe
+    assert br.allow() and br.state == br.HALF_OPEN
+    br.on_failure()                 # probe fails -> re-open immediately
+    assert br.state == br.OPEN
+    t[0] = 22.0
+    assert br.allow()
+    br.on_success()                 # probe succeeds -> close
+    assert br.state == br.CLOSED and br.allow()
+    assert br.metrics()["circuit_opens"] == 2
+
+
+def test_error_store_bound_and_eviction():
+    es = ErrorStore(capacity=3)
+    for i in range(5):
+        es.add("S", "dispatch", ValueError(f"e{i}"), i)
+    assert len(es) == 3 and es.evicted == 2
+    ids = [e.id for e in es.entries()]
+    assert ids == [3, 4, 5]         # oldest evicted first
+    taken = es.take([4])
+    assert len(taken) == 1 and len(es) == 2
+    d = es.entries()[0].to_dict()
+    assert d["point"] == "dispatch" and "e2" in d["error"]
+
+
+def test_fault_injector_deterministic_and_targeted():
+    a = FaultInjector(seed=9, rates={"dispatch": 0.5})
+    b = FaultInjector(seed=9, rates={"dispatch": 0.5})
+    seq_a, seq_b = [], []
+    for seq, inj in ((seq_a, a), (seq_b, b)):
+        for _ in range(50):
+            try:
+                inj.check("dispatch", "p")
+                seq.append(0)
+            except InjectedFault:
+                seq.append(1)
+    assert seq_a == seq_b and 0 < sum(seq_a) < 50
+    # burst counts + @detail targeting
+    inj = FaultInjector(seed=0, counts={"d2h@planA": 2})
+    inj.check("d2h", "planB")       # other plan: untouched
+    for _ in range(2):
+        with pytest.raises(InjectedFault):
+            inj.check("d2h", "planA")
+    inj.check("d2h", "planA")       # burst exhausted
+    assert inj.stats()["fired"]["d2h@planA"] == 2
+
+
+def test_resource_classification_word_boundaries():
+    assert is_resource_error(RuntimeError("RESOURCE_EXHAUSTED: thing"))
+    assert is_resource_error(RuntimeError("Out of memory allocating"))
+    assert not is_resource_error(RuntimeError("kaboom on worker"))
+    assert is_resource_error(InjectedFault("dispatch", kind="resource"))
+    assert not is_resource_error(InjectedFault("d2h", kind="fault"))
+    assert FaultInjector.parse("dispatch=3,sink.publish=0.5").counts == \
+        {"dispatch": 3}
+
+
+# ---------------------------------------------------------------------------
+# @OnError action set
+# ---------------------------------------------------------------------------
+
+WIN_APP = """
+@OnError(action='{action}'{extra})
+define stream S (sym string, p double);
+from S#window.length(4) select sym, sum(p) as s group by sym insert into Out;
+"""
+
+
+def test_onerror_unknown_action_rejected(mgr):
+    with pytest.raises(Exception, match="unknown @OnError action"):
+        mgr.create_app_runtime(WIN_APP.format(action="explode", extra=""))
+
+
+def test_onerror_log_drops_and_counts(mgr):
+    rt = mgr.create_app_runtime(WIN_APP.format(action="log", extra=""))
+    rows = collect(rt, "Out")
+    rt.fault_injector = FaultInjector(
+        seed=1, counts={"dispatch": 1}, kinds={"dispatch": "fault"})
+    h = rt.input_handler("S")
+    h.send([("K0", 1.0), ("K1", 2.0)])
+    rt.flush()
+    h.send([("K0", 3.0)])
+    rt.flush()
+    assert len(rows) == 1           # first batch dropped, second flowed
+    assert rt.statistics()["faults"]["S"]["log"] == 1
+
+
+def test_onerror_store_captures_and_replays(mgr):
+    rt = mgr.create_app_runtime(WIN_APP.format(action="store", extra=""))
+    rows = collect(rt, "Out")
+    rt.fault_injector = FaultInjector(
+        seed=1, counts={"dispatch": 1}, kinds={"dispatch": "fault"})
+    h = rt.input_handler("S")
+    h.send([("K0", 1.0), ("K1", 2.0)])
+    rt.flush()
+    assert rows == []
+    ents = rt.error_store.entries("S")
+    assert len(ents) == 1 and len(ents[0].events) == 2
+    assert "injected fault" in ents[0].message
+    # injector exhausted -> replay re-ingests the captured events
+    res = rt.error_store.replay(rt)
+    assert res == {"replayed": 1, "failed": 0, "remaining": 0}
+    assert sorted(rows) == [("K0", 1.0), ("K1", 2.0)]
+
+
+def test_onerror_wait_blocks_then_recovers(mgr):
+    rt = mgr.create_app_runtime(
+        WIN_APP.format(action="wait", extra=", timeout='2 sec'"))
+    rows = collect(rt, "Out")
+    rt.fault_injector = FaultInjector(
+        seed=1, counts={"dispatch": 2}, kinds={"dispatch": "fault"})
+    h = rt.input_handler("S")
+    h.send([("K0", 1.0), ("K1", 2.0)])
+    rt.flush()
+    assert len(rows) == 2           # retried through the transient fault
+    assert rt.statistics()["faults"]["S"]["wait"] == 1
+
+
+def test_onerror_wait_deadline_raises(mgr):
+    rt = mgr.create_app_runtime(
+        WIN_APP.format(action="wait", extra=", timeout='50 ms'"))
+    rt.fault_injector = FaultInjector(
+        seed=1, counts={"dispatch": 10_000}, kinds={"dispatch": "fault"})
+    h = rt.input_handler("S")
+    with pytest.raises(RuntimeError, match="gave up"):
+        h.send([("K0", 1.0)])
+        rt.flush()
+
+
+def test_onerror_stream_depth_gt0_routes_origin_batch_once(mgr):
+    """@OnError(action='stream') under pipelined dispatch (depth > 0): a
+    batch failing mid-pipeline reroutes to the fault stream EXACTLY once
+    — the batch the in-flight entry belongs to, not the batch being
+    processed when the failure materializes — and later batches flow."""
+    rt = mgr.create_app_runtime("""
+        @app:devicePipeline('2')
+        @OnError(action='stream')
+        define stream S (sym string, p double);
+        from S#window.length(4) select sym, sum(p) as s group by sym
+            insert into Out;
+        from !S select sym, _error insert into F;
+    """)
+    assert rt._plans[0]._pipe.depth == 2
+    rows, faults = collect(rt, "Out"), collect(rt, "F")
+    rt.fault_injector = FaultInjector(seed=1, counts={"d2h": 1})
+    h = rt.input_handler("S")
+    for k in range(6):              # one micro-batch per send_batch call
+        h.send_batch({"sym": [f"B{k}_{i}" for i in range(3)],
+                      "p": np.arange(3, dtype=float)})
+    rt.flush()
+    # batch 0's entry fails at materialization (while later batches are
+    # in flight); its 3 events route to !S once, the other 5 batches
+    # deliver normally
+    assert len(faults) == 3
+    assert all(sym.startswith("B0_") for sym, _err in faults)
+    assert all("d2h" in err for _sym, err in faults)
+    assert len(rows) == 5 * 3
+
+
+# ---------------------------------------------------------------------------
+# graceful degradation: halving -> interpreter quarantine, byte-identical
+# ---------------------------------------------------------------------------
+
+PATTERN_APP = """
+@app:devicePatterns('prefer')
+@OnError(action='store')
+define stream S (sym string, p double);
+from every a=S[p > 120] -> b=S[p < 80] within 1 sec
+select a.sym as s1, b.sym as s2 insert into Out;
+"""
+
+JOIN_APP = """
+@OnError(action='store')
+define stream S (sym string, p double);
+define stream T (sym string, v int);
+from S#window.length(8) as a join T#window.length(8) as b on a.sym == b.sym
+select a.sym as sym, a.p as p, b.v as v insert into Out;
+"""
+
+
+def _run_window(mgr, injector=None):
+    rt = mgr.create_app_runtime(WIN_APP.format(action="store", extra=""))
+    rt.fault_injector = injector
+    rows = collect(rt, "Out")
+    h = rt.input_handler("S")
+    for k in range(4):
+        h.send([(f"K{j % 3}", float(j + k)) for j in range(8)])
+        rt.flush()
+    return rt, rows
+
+
+def _run_pattern(mgr, injector=None):
+    rt = mgr.create_app_runtime(PATTERN_APP)
+    rt.fault_injector = injector
+    rows = collect(rt, "Out")
+    h = rt.input_handler("S")
+    rng = np.random.default_rng(0)
+    ts0 = 1_700_000_000_000
+    for k in range(4):
+        n = 64
+        h.send_batch({"sym": [f"K{i % 4}" for i in range(n)],
+                      "p": rng.uniform(60, 140, n).round(1)},
+                     np.arange(ts0 + k * n * 10, ts0 + (k + 1) * n * 10, 10))
+        rt.flush()
+    return rt, rows
+
+
+def _run_join(mgr, injector=None):
+    rt = mgr.create_app_runtime(JOIN_APP)
+    rt.fault_injector = injector
+    rows = collect(rt, "Out")
+    hs, ht = rt.input_handler("S"), rt.input_handler("T")
+    for k in range(4):
+        hs.send([(f"K{i % 3}", float(i + k)) for i in range(6)])
+        ht.send([(f"K{i % 3}", i * 10 + k) for i in range(6)])
+        rt.flush()
+    return rt, sorted(rows)
+
+
+@pytest.mark.parametrize("runner,plan_cls", [
+    (_run_window, "DeviceWindowAggPlan"),
+    (_run_pattern, "DevicePatternPlan"),
+    (_run_join, "DeviceJoinPlan"),
+])
+def test_degradation_halving_is_lossless(mgr, runner, plan_cls):
+    """Transient resource exhaustion at dispatch: the ladder halves the
+    work and retries — outputs byte-identical to a fault-free run, no
+    quarantine."""
+    rt0, clean = runner(mgr)
+    assert type(rt0._plans[0]).__name__ == plan_cls
+    rt, chaos = runner(mgr, FaultInjector(seed=3, counts={"dispatch": 2}))
+    assert chaos == clean and len(clean) > 0
+    lad = rt._ladders[rt0._plans[0].name]
+    assert lad.halvings >= 1 and not lad.quarantined
+    assert "degraded_plans" not in rt.statistics()
+
+
+@pytest.mark.parametrize("runner", [_run_window, _run_pattern, _run_join])
+def test_degradation_quarantine_byte_identical(mgr, runner):
+    """Persistent resource exhaustion: after K consecutive failures the
+    plan is quarantined onto the interpreter path — match output
+    byte-identical to a fault-free (device) run, surfaced in
+    statistics()."""
+    _rt0, clean = runner(mgr)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        rt, chaos = runner(mgr, FaultInjector(seed=3,
+                                              counts={"dispatch": 100_000}))
+    assert chaos == clean and len(clean) > 0
+    rep = rt.statistics()
+    assert rep["degraded_plans"] == [rt._plans[0].name]
+    name = rep["degraded_plans"][0]
+    assert rep["device"][name]["quarantined"] is True
+    # quarantined plan is the interpreter twin now
+    assert type(rt._plan_by_name[name]).__name__.startswith("Interp")
+    # prometheus carries the gauge
+    assert "siddhi_tpu_degraded_plans" in rt.stats.prometheus()
+
+
+def test_snapshot_after_quarantine_restores(mgr):
+    """A snapshot taken after a quarantine carries interp-format plan
+    state; restore must re-quarantine the fresh runtime's device plan
+    before loading it (not crash with a state-shape mismatch)."""
+    app = WIN_APP.format(action="store", extra="")
+    rt = mgr.create_app_runtime(app)
+    rows = collect(rt, "Out")
+    rt.fault_injector = FaultInjector(seed=7, counts={"dispatch": 10 ** 6})
+    h = rt.input_handler("S")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        for k in range(3):
+            h.send([(f"K{j % 2}", float(j + k)) for j in range(4)])
+            rt.flush()
+    assert rt.statistics()["degraded_plans"] == ["query_0"]
+    snap = rt.snapshot()
+    rt2 = mgr.create_app_runtime(app)
+    rows2 = collect(rt2, "Out")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        rt2.restore(snap)
+    assert rt2.statistics()["degraded_plans"] == ["query_0"]
+    h2 = rt2.input_handler("S")
+    h2.send([("K0", 100.0)])
+    rt2.flush()
+    # window continuity across the restore: the restored (interp) window
+    # still holds the pre-snapshot K0 events
+    assert rows2 == [("K0", 104.0)]
+
+
+def test_quarantine_counts_as_consecutive_not_total(mgr):
+    """Non-consecutive resource faults (success in between) never reach
+    the quarantine threshold."""
+    rt = mgr.create_app_runtime(WIN_APP.format(action="store", extra=""))
+    rows = collect(rt, "Out")
+    # one fault roughly every other dispatch: consecutive counter resets
+    rt.fault_injector = FaultInjector(seed=5, rates={"dispatch": 0.3})
+    h = rt.input_handler("S")
+    for k in range(12):
+        h.send([(f"K{j % 3}", float(j + k)) for j in range(4)])
+        rt.flush()
+    assert "degraded_plans" not in rt.statistics()
+    assert len(rows) == 12 * 4      # one output row per input event
+
+
+# ---------------------------------------------------------------------------
+# sink retry / circuit breaker / replay
+# ---------------------------------------------------------------------------
+
+SINK_APP = """
+define stream S (x int);
+@sink(type='inMemory', topic='{topic}', on.error='{action}',
+      max.retries='2', retry.interval='1 ms', breaker.threshold='3',
+      breaker.reset='50 ms')
+define stream Out (x int);
+from S select x insert into Out;
+"""
+
+
+def test_sink_transient_faults_retried_with_backoff(mgr):
+    got = []
+    InMemoryBroker.subscribe("t_sink1", lambda m: got.append(m))
+    rt = mgr.create_app_runtime(SINK_APP.format(topic="t_sink1",
+                                                action="store"))
+    rt.fault_injector = FaultInjector(seed=1, counts={"sink.publish": 2})
+    rt.start()
+    h = rt.input_handler("S")
+    h.send((1,))
+    rt.flush()
+    sink = rt.sinks[0]
+    assert got == [(1,)] and sink.retries == 2 and sink.stored == 0
+    assert sink.breaker.state == sink.breaker.CLOSED
+
+
+def test_sink_persistent_faults_stored_breaker_opens_then_replay(mgr):
+    got = []
+    InMemoryBroker.subscribe("t_sink2", lambda m: got.append(m))
+    rt = mgr.create_app_runtime(SINK_APP.format(topic="t_sink2",
+                                                action="store"))
+    rt.fault_injector = FaultInjector(seed=1,
+                                      counts={"sink.publish": 10_000})
+    rt.start()
+    h = rt.input_handler("S")
+    for i in range(6):
+        h.send((i,))
+        rt.flush()
+    sink = rt.sinks[0]
+    assert got == [] and sink.stored == 6
+    assert sink.breaker.state == sink.breaker.OPEN
+    assert len(rt.error_store) == 6
+    m = sink.metrics()
+    assert m["circuit_state"] == 2 and m["circuit_opens"] >= 1
+    # transport recovers: replay delivers everything — zero event loss
+    rt.fault_injector = None
+    res = rt.error_store.replay(rt)
+    assert res["replayed"] == 6 and res["remaining"] == 0
+    assert sorted(p[0] for p in got) == list(range(6))
+    rep = rt.statistics()
+    assert rep["sinks"]["Out[0]"]["stored"] == 6
+    assert "siddhi_tpu_sink_circuit_state" in rt.stats.prometheus()
+
+
+def test_sink_without_onerror_keeps_failfast(mgr):
+    rt = mgr.create_app_runtime("""
+        define stream S (x int);
+        @sink(type='inMemory', topic='t_sink3')
+        define stream Out (x int);
+        from S select x insert into Out;
+    """)
+    rt.fault_injector = FaultInjector(seed=1, counts={"sink.publish": 1})
+    rt.start()
+    h = rt.input_handler("S")
+    with pytest.raises(InjectedFault):
+        h.send((1,))
+        rt.flush()
+
+
+def test_source_connect_retry_backoff(mgr):
+    rt = mgr.create_app_runtime("""
+        @source(type='inMemory', topic='t_conn')
+        define stream S (x int);
+        from S select x insert into O;
+    """)
+    rt.fault_injector = FaultInjector(seed=1, counts={"source.connect": 2})
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        rt.start()                  # 2 failures, then connects
+    assert rt.sources[0].connected
+    assert sum("retrying in" in str(x.message) for x in w) == 2
+    got = collect(rt, "O")
+    InMemoryBroker.publish("t_conn", (7,))
+    assert got == [(7,)]
+
+
+def test_source_dropped_events_counter(mgr):
+    rt = mgr.create_app_runtime("""
+        @source(type='inMemory', topic='t_drop', @map(type='json'))
+        define stream S (x int);
+        from S select x insert into O;
+    """)
+    rt.start()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        InMemoryBroker.publish("t_drop", "{not json")
+        InMemoryBroker.publish("t_drop", "also bad")
+    rep = rt.statistics()
+    assert rep["sources"]["S"]["dropped_events"] == 2
+    assert rep["faults"]["S"]["source.drop"] == 2
+    assert 'siddhi_tpu_source_dropped_events_total{app="test",stream="S"} 2' \
+        in rt.stats.prometheus().replace(f'app="{rt.app.name}"', 'app="test"')
+
+
+# ---------------------------------------------------------------------------
+# /siddhi/errors service endpoints
+# ---------------------------------------------------------------------------
+
+def test_service_errors_endpoints():
+    import json
+    import urllib.request
+    from siddhi_tpu.service import SiddhiService
+    svc = SiddhiService(port=0).start()
+    base = f"http://127.0.0.1:{svc.port}"
+    try:
+        app = ("@app:name('E')\n"
+               "@OnError(action='store')\n"
+               "define stream S (x int);\n"
+               "from S#window.length(2) select sum(x) as s insert into Out;\n")
+        req = urllib.request.Request(f"{base}/siddhi/artifact/deploy",
+                                     data=app.encode(), method="POST")
+        urllib.request.urlopen(req).read()
+        rt = svc.runtimes["E"]
+        rt.fault_injector = FaultInjector(
+            seed=1, counts={"dispatch": 1}, kinds={"dispatch": "fault"})
+        rt.send("S", (5,))
+        rt.flush()
+        with urllib.request.urlopen(
+                f"{base}/siddhi/errors?siddhiApp=E") as r:
+            body = json.loads(r.read())
+        assert len(body["errors"]) == 1
+        ent = body["errors"][0]
+        assert ent["stream"] == "S" and ent["events"] == [[ent["events"][0][0],
+                                                           [5]]]
+        # replay through POST (injector burst exhausted -> succeeds)
+        req = urllib.request.Request(
+            f"{base}/siddhi/errors",
+            data=json.dumps({"app": "E", "action": "replay"}).encode(),
+            method="POST")
+        res = json.loads(urllib.request.urlopen(req).read())
+        assert res["replayed"] == 1 and res["remaining"] == 0
+        # 404 on unknown app
+        try:
+            urllib.request.urlopen(f"{base}/siddhi/errors?siddhiApp=nope")
+            assert False
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        svc.stop()
+
+
+def test_pipeline_keeps_ready_results_on_later_failure():
+    """A later in-flight entry failing to materialize must not discard
+    an earlier entry's already-materialized results (zero silent loss)."""
+    from siddhi_tpu.core.pipeline import DispatchPipeline
+
+    def mat(e):
+        if e == "bad":
+            raise RuntimeError("boom-mat")
+        return [e]
+
+    p = DispatchPipeline("t", mat, depth=0)
+    p.hold()
+    p.origin = ("S", "b1")
+    p.push("ok1")
+    p.origin = ("S", "b2")
+    p.push("bad")
+    p.origin = ("S", "b3")
+    p.push("ok3")
+    with pytest.raises(RuntimeError) as ei:
+        p.collect()
+    assert ei.value.fault_origin == ("S", "b2")
+    # ok1 materialized before the failure and ok3 was still queued:
+    # both deliver on the next drain
+    assert p.drain() == ["ok1", "ok3"]
+
+
+def test_source_map_store_capture_is_replayable(mgr):
+    """@OnError(action='store') on a source map error captures the raw
+    payload with the SOURCE as replay target — replay re-feeds the
+    mapper (and a still-broken payload re-captures, never loops as a
+    permanent replay failure)."""
+    rt2 = mgr.create_app_runtime("""
+        @OnError(action='store')
+        @source(type='inMemory', topic='t_map_replay2', @map(type='json'))
+        define stream S (x int);
+        from S select x insert into O;
+    """)
+    got = collect(rt2, "O")
+    rt2.start()
+    InMemoryBroker.publish("t_map_replay2", "{broken json")
+    assert len(rt2.error_store) == 1
+    ent = rt2.error_store.entries("S")[0]
+    assert ent.point == "source.map" and ent.payloads == ["{broken json"]
+    # still broken: replay re-captures instead of failing forever
+    res = rt2.error_store.replay(rt2)
+    assert res["replayed"] == 1 and res["failed"] == 0 \
+        and res["remaining"] == 1
+    # upstream fixed (mapper stub): replay now delivers
+    rt2.sources[0].mapper.map = lambda m: [(None, (42,))]
+    res = rt2.error_store.replay(rt2)
+    assert res["replayed"] == 1 and res["remaining"] == 0
+    assert got == [(42,)]
+
+
+def test_restore_never_applies_standalone_delta(tmp_path):
+    """When the only full revision is corrupt, later I- deltas must NOT
+    be restored standalone (their op-logs assume the base's state) —
+    restore ends with a clean slate, not silent partial state."""
+    from siddhi_tpu.core.persistence import \
+        IncrementalFileSystemPersistenceStore
+    mgr = SiddhiManager()
+    store = IncrementalFileSystemPersistenceStore(str(tmp_path))
+    mgr.set_persistence_store(store)
+    rt = mgr.create_app_runtime(PERSIST_APP)
+    h = rt.input_handler("S")
+    h.send((1,))
+    rt.flush()
+    rev_full = rt.persist(incremental=True)
+    assert rev_full.startswith("F-")
+    h.send((2,))
+    rt.flush()
+    rev_delta = rt.persist(incremental=True)
+    assert rev_delta.startswith("I-")
+    import os
+    with open(os.path.join(str(tmp_path), "P", f"{rev_full}.snapshot"),
+              "wb") as f:
+        f.write(b"corrupt full")
+    rt2 = mgr.create_app_runtime(PERSIST_APP)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        rt2.restore_last_state()
+    assert _table_rows(rt2) == []       # nothing restorable — not [2]
+    assert store.corrupt_skipped >= 1
+    mgr.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# persistence satellites
+# ---------------------------------------------------------------------------
+
+PERSIST_APP = """
+@app:name('P')
+define stream S (x int);
+define table T (x int);
+from S select x insert into T;
+"""
+
+
+def _table_rows(rt):
+    return sorted(row[0] for _ts, row in rt.query("from T select x"))
+
+
+def test_corrupt_incremental_revision_falls_back(tmp_path):
+    from siddhi_tpu.core.persistence import \
+        IncrementalFileSystemPersistenceStore
+    mgr = SiddhiManager()
+    store = IncrementalFileSystemPersistenceStore(str(tmp_path))
+    mgr.set_persistence_store(store)
+    rt = mgr.create_app_runtime(PERSIST_APP)
+    h = rt.input_handler("S")
+    h.send((1,))
+    rt.flush()
+    rt.persist(incremental=True)
+    h.send((2,))
+    rt.flush()
+    rev2 = rt.persist(incremental=True)
+    # truncate/corrupt the newest revision (crash mid-write)
+    import os
+    path = os.path.join(str(tmp_path), "P", f"{rev2}.snapshot")
+    with open(path, "wb") as f:
+        f.write(b"\x80corrupt")
+    rt2 = mgr.create_app_runtime(PERSIST_APP)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        rt2.restore_last_state()
+    assert _table_rows(rt2) == [1]          # previous revision restored
+    assert store.corrupt_skipped >= 1
+    assert any("corrupt" in str(x.message) for x in w)
+    mgr.shutdown()
+
+
+def test_corrupt_plain_revision_falls_back(tmp_path):
+    from siddhi_tpu.core.persistence import FileSystemPersistenceStore
+    mgr = SiddhiManager()
+    store = FileSystemPersistenceStore(str(tmp_path))
+    mgr.set_persistence_store(store)
+    rt = mgr.create_app_runtime(PERSIST_APP)
+    h = rt.input_handler("S")
+    h.send((1,))
+    rt.flush()
+    rt.persist()
+    h.send((2,))
+    rt.flush()
+    rev2 = rt.persist()
+    import os
+    with open(os.path.join(str(tmp_path), "P", f"{rev2}.snapshot"),
+              "wb") as f:
+        f.write(b"not a pickle")
+    rt2 = mgr.create_app_runtime(PERSIST_APP)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        rt2.restore_last_state()
+    assert _table_rows(rt2) == [1]
+    assert rt2.restore_skipped == 1
+    assert any("corrupt" in str(x.message) for x in w)
+    mgr.shutdown()
+
+
+def test_async_persistor_prunes_finished_threads():
+    from siddhi_tpu.core.persistence import AsyncSnapshotPersistor
+    p = AsyncSnapshotPersistor()
+    done = []
+    for i in range(20):
+        t = p.persist(done.append, i)
+        t.join(2)
+    # persist() prunes dead threads even though wait() was never called
+    assert len(p._threads) <= 1
+    assert sorted(done) == list(range(20))
+
+
+def test_persist_save_injection_point(tmp_path):
+    from siddhi_tpu.core.persistence import FileSystemPersistenceStore
+    mgr = SiddhiManager()
+    mgr.set_persistence_store(FileSystemPersistenceStore(str(tmp_path)))
+    rt = mgr.create_app_runtime(PERSIST_APP)
+    rt.fault_injector = FaultInjector(seed=1, counts={"persist.save": 1})
+    with pytest.raises(InjectedFault):
+        rt.persist()
+    rt.persist()                    # burst exhausted: succeeds
+    mgr.shutdown()
