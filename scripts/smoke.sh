@@ -7,6 +7,17 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
+echo "== compileall =="
+# every module must at least parse/compile — a syntax error in a rarely
+# imported module must not wait for a request to surface
+python -m compileall -q siddhi_tpu
+
+echo "== tuning-cache schema lint =="
+# a malformed persisted tuning cache must never brick a deploy: the
+# loader quarantines corrupt files (core/autotune.py TuningCache), and
+# this lint step catches schema drift before it ships
+python -m siddhi_tpu.core.autotune --lint
+
 echo "== tier-1 tests =="
 python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider
@@ -65,6 +76,14 @@ echo "== seeded chaos smoke =="
 # quarantine with byte-identical matches, sink retry/ErrorStore replay).
 # Exits nonzero if any recovery path loses or duplicates an event.
 python bench.py --chaos --seed 7
+
+echo "== autotune smoke =="
+# bench.py --autotune --smoke: one-config tuner sweep (output-invariance
+# asserted per candidate) + the @app:latencySLO AIMD controller under
+# paced load; the tuning cache is scoped to a throwaway path so CI never
+# pollutes (or trusts) the developer's persisted winners
+SIDDHI_TUNE_CACHE="$(mktemp -u /tmp/siddhi_tune_smoke.XXXXXX.json)" \
+    python bench.py --autotune --smoke
 
 echo "== pipelined-vs-unpipelined bench smoke =="
 # bench.py --smoke: short pipelined-vs-unpipelined run over the

@@ -92,20 +92,37 @@ def _build_app_scoped(rt) -> None:
                 sig = query_signature(elem)
                 if sig is not None:
                     groups.setdefault(sig, []).append(i)
+        from .autotune import fused_lane_pack_for
         from .multi_query import plan_query_group
         from .nfa_device import DeviceNFAUnsupported
         for sig, idxs in groups.items():
             if len(idxs) < MIN_GROUP:
                 continue
-            qs = [app.execution_elements[i] for i in idxs]
-            names = [q.name(f"query_{i}") for q, i in zip(qs, idxs)]
-            try:
-                plan = plan_query_group(rt, qs, names)
-            except DeviceNFAUnsupported:
-                continue
-            rt._register_plan(plan)
-            for i in idxs:
-                fused[i] = plan
+            # fused-lane packing (@app:fusedLanes / tuning cache): cap the
+            # lane count per fused kernel — a group larger than the pack
+            # splits into several kernels (0 = unbounded, one kernel)
+            pack = fused_lane_pack_for(rt, sig)
+            if pack and pack >= MIN_GROUP:
+                slices = [idxs[j:j + pack]
+                          for j in range(0, len(idxs), pack)]
+                if len(slices) > 1 and len(slices[-1]) < MIN_GROUP:
+                    slices[-2].extend(slices.pop())   # tail too small to
+            else:                                     # fuse on its own
+                slices = [idxs]
+            for sub in slices:
+                qs = [app.execution_elements[i] for i in sub]
+                names = [q.name(f"query_{i}") for q, i in zip(qs, sub)]
+                try:
+                    plan = plan_query_group(rt, qs, names)
+                except DeviceNFAUnsupported:
+                    break
+                # the tuning cache keys fused plans by the GROUP shape
+                # signature (autotune.plan_signature) — the fused query
+                # AST never flows through attach_table_writer
+                plan._group_sig = sig
+                rt._register_plan(plan)
+                for i in sub:
+                    fused[i] = plan
 
     for i, elem in enumerate(app.execution_elements):
         if i in fused:
@@ -235,13 +252,13 @@ def _plan_query_scoped(rt, q: ast.Query, default_name: str):
                 and not any(isinstance(h, ast.StreamFunction) for h in inp.handlers)):
             try:
                 filters = [f.expr for f in inp.filters]
-                pl = ast.find_annotation(rt.app.annotations,
-                                         "app:devicePipeline")
+                from .autotune import pipeline_depth_for
                 return attach_table_writer(rt, FilterProjectPlan(
                     name, schema, inp.alias, filters, q.selector, rt.strings,
                     target, q.selector.limit, q.selector.offset,
                     events_for=q.output.events_for,
-                    pipeline_depth=int(pl.element()) if pl else 0), q, name)
+                    pipeline_depth=pipeline_depth_for(rt, "filter", q)),
+                    q, name)
             except PlanError:
                 raise
             except Exception:

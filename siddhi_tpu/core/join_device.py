@@ -213,9 +213,9 @@ class DeviceJoinPlan(QueryPlan):
         # side filters force a sync per flush (the mirror update needs the
         # device-evaluated pass masks); filter-less joins pipeline
         self._can_pipeline = not (self.left.filters or self.right.filters)
-        pl_ann = ast.find_annotation(rt.app.annotations, "app:devicePipeline")
-        self.pipeline_depth = int(pl_ann.element()) \
-            if pl_ann is not None and self._can_pipeline else 0
+        from .autotune import pipeline_depth_for
+        self.pipeline_depth = pipeline_depth_for(rt, "join", q) \
+            if self._can_pipeline else 0
         self._pipe = DispatchPipeline(name, self._materialize,
                                       depth=self.pipeline_depth)
         # build-time trace so unsupported expressions fail at plan time

@@ -192,9 +192,8 @@ class DevicePatternPlan(QueryPlan):
                 and self.spec.every_head and not self.kernel.has_absent
                 and not self.spec.needs_init_slot
                 and all(p.within_ms is not None for p in self.spec.positions)):
-            lanes_ann = ast.find_annotation(rt.app.annotations,
-                                            "app:deviceChunkLanes")
-            lanes = int(lanes_ann.element()) if lanes_ann is not None else 64
+            from .autotune import chunk_lanes_for, pipeline_depth_for
+            lanes = chunk_lanes_for(rt, q)
             if lanes > 1:
                 self._chunk_cfg = {
                     "W": max(p.within_ms for p in self.spec.positions),
@@ -205,9 +204,7 @@ class DevicePatternPlan(QueryPlan):
                 self._chunk_E: Optional[int] = None
                 self._kern_by_p: dict = {}
                 self._of_dropped = 0
-                pl = ast.find_annotation(rt.app.annotations,
-                                         "app:devicePipeline")
-                self.pipeline_depth = int(pl.element()) if pl else 0
+                self.pipeline_depth = pipeline_depth_for(rt, "pattern", q)
                 from .pipeline import DispatchPipeline
                 self._pipe = DispatchPipeline(
                     name, lambda e: [self._materialize_chunk(e)],
@@ -821,6 +818,16 @@ class DevicePatternPlan(QueryPlan):
         # bases are per-flush: _unpack_block must see THIS entry's
         self._ts_base, self._seq_base = e["ts_base"], e["seq_base"]
         return self._unpack_block(ipack, fpack, n)
+
+    def regeometry(self, batch_hint=None, depth=None, chunk_lanes=None,
+                   **knobs) -> None:
+        """Pattern-family geometry: base knobs plus the chunked-halo lane
+        count K.  A lane-count change only affects how FUTURE flushes
+        split into own-chunks (heads arm on owned events regardless of
+        K), so it is output-invariant like every other geometry move."""
+        super().regeometry(batch_hint=batch_hint, depth=depth, **knobs)
+        if chunk_lanes is not None and self._chunk_cfg is not None:
+            self._chunk_cfg["lanes"] = max(2, int(chunk_lanes))
 
     def flush_pending(self) -> list:
         # chunk results are raw columnar match tables, not OutputBatches:

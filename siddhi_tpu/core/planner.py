@@ -74,6 +74,10 @@ class CompiledSelector:
     # env key when the output is a plain variable — read host column directly,
     # skipping the device round-trip (zero-copy passthrough)
     passthrough: list = None
+    # per-output read-sets, parallel to fns (the bare fns carry no
+    # metadata; reading .reads off them silently demoted every computed
+    # column to the interpreter path)
+    reads: list = None
 
     def out_schema(self, stream_id: str) -> StreamSchema:
         return StreamSchema(stream_id, tuple(
@@ -83,7 +87,7 @@ class CompiledSelector:
 def compile_selector(selector: ast.Selector, ctx, in_schema: Optional[StreamSchema],
                      extra_names: Optional[dict] = None) -> CompiledSelector:
     """Compile projection expressions. select * requires in_schema."""
-    names, types, fns, passthrough = [], [], [], []
+    names, types, fns, passthrough, reads = [], [], [], [], []
     if selector.select_all:
         if in_schema is None:
             raise PlanError("select * not supported for this input type")
@@ -95,6 +99,7 @@ def compile_selector(selector: ast.Selector, ctx, in_schema: Optional[StreamSche
         names.append(nm)
         types.append(ce.type)
         fns.append(ce.fn)
+        reads.append(frozenset(ce.reads))
         if isinstance(expr, ast.Variable):
             key, _ = ctx.resolve(expr)
             passthrough.append(key)
@@ -108,7 +113,7 @@ def compile_selector(selector: ast.Selector, ctx, in_schema: Optional[StreamSche
         having = compile_expression(selector.having, hctx)
         if having.type != AttrType.BOOL:
             raise PlanError("having must be boolean")
-    return CompiledSelector(names, types, fns, having, passthrough)
+    return CompiledSelector(names, types, fns, having, passthrough, reads)
 
 
 def _with_extra(ctx, extra: dict):
@@ -157,9 +162,30 @@ class QueryPlan:
     retryable_process = False
     retryable_finalize = False
     _finalize_retry_ok = True
+    batch_hint = None             # SLO controller's current batch target
+    pipeline_depth = 0
 
     def process(self, stream_id: str, batch: EventBatch) -> list:
         raise NotImplementedError
+
+    def regeometry(self, batch_hint=None, depth=None, **knobs) -> None:
+        """Adaptive-geometry hook (core/autotune.py): the tuner applies a
+        cached winner here after build, and the SLO controller applies
+        batch decisions at flush boundaries.  Every plan family derives
+        its device geometry (pad grids, chunk sizes) from batch.n at
+        dispatch, so a new hint only changes FUTURE dispatch shapes —
+        batches already in flight are untouched, and batch-boundary moves
+        are output-invariant (the PR-4 halving machinery's parity
+        argument; asserted by the geometry differentials)."""
+        if batch_hint is not None:
+            self.batch_hint = int(batch_hint)
+        if depth is not None and self._pipe is not None \
+                and getattr(self, "_can_pipeline", True):
+            # _can_pipeline: a plan that must sync per flush (join side
+            # filters feed the mirror update) pins depth 0 — geometry
+            # hints never override a correctness constraint
+            self.pipeline_depth = int(depth)
+            self._pipe.set_depth(int(depth))
 
     def on_timer(self, now_ms: int) -> list:
         """Called by the scheduler tick (time windows, absent patterns...)."""
@@ -255,9 +281,9 @@ class FilterProjectPlan(QueryPlan):
         need: set = set()
         if self._filter is not None:
             need |= set(self._filter.reads)
-        for fn, pt in zip(self._sel.fns, self._sel.passthrough):
+        for rd, pt in zip(self._sel.reads, self._sel.passthrough):
             if pt is None:
-                need |= set(fn.reads)
+                need |= set(rd)
         if self._sel.having is not None:
             h_reads = set(self._sel.having.reads)
             need |= h_reads - set(self._sel.names)
@@ -337,7 +363,10 @@ class FilterProjectPlan(QueryPlan):
             if pt is not None:
                 cols[nm] = host_env[pt][mask]
             else:
-                cols[nm] = np.asarray(next(outs))[mask].astype(dtype_of(t))
+                arr = np.asarray(next(outs))
+                if arr.ndim == 0:       # constant column: 0-d on device
+                    arr = np.broadcast_to(arr, (batch.n,))
+                cols[nm] = arr[mask].astype(dtype_of(t))
         if self.offset:
             ts = ts[self.offset:]
             cols = {k: v[self.offset:] for k, v in cols.items()}

@@ -175,6 +175,37 @@ class SiddhiAppRuntime:
                                     if mbl is not None else None)
         self._builder_t0: dict = {}     # stream -> first-append wall time
 
+        # adaptive execution geometry (core/autotune.py): the tuning-cache
+        # facade plan constructors consult at build time, and the AIMD
+        # batching controller behind @app:latencySLO.  @app:maxBatchLatency
+        # rides the SAME controller in cadence-only (non-adaptive) mode —
+        # its one-shot flush-when-aged heuristic is unchanged.
+        from .autotune import SLOController, TunerRuntime
+        self.tuner = TunerRuntime(self)
+        slo_ann = qast.find_annotation(app.annotations, "app:latencySLO")
+        if slo_ann is not None:
+            # an explicit @app:maxBatchLatency alongside the SLO pins the
+            # flush cadence; otherwise it defaults to target / 2
+            self.slo = SLOController(
+                target_s=_parse_interval_s(slo_ann.element()),
+                flush_after_s=self.max_batch_latency_s,
+                initial_batch=self.batch_capacity)
+        elif self.max_batch_latency_s is not None:
+            self.slo = SLOController(
+                flush_after_s=self.max_batch_latency_s, adaptive=False)
+        else:
+            self.slo = None
+        if self.slo is not None:
+            self.max_batch_latency_s = self.slo.flush_after_s
+        # tuned app-level micro-batch capacity (cache warm + no explicit
+        # @app:async(batch.size.max) override)
+        if asy is None or _el("batch.size.max") is None:
+            hint = self.tuner.batch_hint()
+            if hint:
+                self.batch_capacity = hint
+                if self.slo is not None and self.slo.adaptive:
+                    self.slo.batch_target = hint
+
         # stream schemas: defined + inferred from query outputs
         self.schemas: dict = {}
         for sid, sd in app.stream_definitions.items():
@@ -442,13 +473,22 @@ class SiddhiAppRuntime:
                         for sid, b in self._builders.items():
                             if len(b) and now_w - self._builder_t0.get(
                                     sid, 0.0) >= self.max_batch_latency_s:
-                                frozen = b.freeze_and_clear()
+                                frozen = self._freeze(sid, b)
                                 if self._async and self._ingest_q is not None:
                                     self._async_outbox.append((sid, frozen))
                                 else:
                                     self._pending.append((sid, frozen))
                         if self._pending:
                             self._drain()
+                        # bounded delivery under a latency target: a
+                        # depth-D pipeline may still hold the aged
+                        # batch's results in flight — they must not
+                        # outlive the flush cadence waiting for an
+                        # explicit flush() (tuned depth + latency
+                        # cadence compose)
+                        if any(len(getattr(p, "_pipe", None) or ())
+                               for p in self._plans):
+                            self._flush_plan_pipelines()
                     if virtual:
                         continue            # virtual clock took over
                     due = [w for p in self._plans
@@ -678,7 +718,7 @@ class SiddhiAppRuntime:
                 # must NOT anchor playback time.
                 self._clock_ms = int(ts.max())
             b.append_columnar(ts, cols, seqs)
-            batch = b.freeze_and_clear()
+            batch = self._freeze(stream_id, b)
             if self._async and self._ingest_q is not None:
                 # async mode: older batches may still sit in the ingest
                 # queue — stage through the same outbox so FIFO holds
@@ -745,11 +785,39 @@ class SiddhiAppRuntime:
                 # stage; the public entry enqueues AFTER releasing the lock
                 # (a blocking put under the lock would deadlock against the
                 # worker, which needs the lock to process)
-                self._async_outbox.append((stream_id, b.freeze_and_clear()))
+                self._async_outbox.append((stream_id,
+                                           self._freeze(stream_id, b)))
             else:
                 self.flush()
 
     # -- dispatch ------------------------------------------------------------
+
+    def _freeze(self, stream_id: str, b: BatchBuilder) -> EventBatch:
+        """Freeze one builder; under an SLO controller the frozen batch is
+        stamped with its first-append wall time so _drain can feed the
+        controller an end-to-end (wait + processing) latency sample."""
+        batch = b.freeze_and_clear()
+        if self.slo is not None:
+            t0 = self._builder_t0.pop(stream_id, None)
+            batch.__dict__["_slo_t0"] = \
+                t0 if t0 is not None else time.perf_counter()
+        return batch
+
+    def _apply_batch_target(self, n: int) -> None:
+        """Apply an SLO-controller batch decision AT A FLUSH BOUNDARY:
+        future builders freeze at the new capacity and plans learn the
+        hint through their regeometry() hook.  Batches already frozen or
+        in flight are untouched — only where future batch boundaries
+        fall changes, which the geometry-invariance differentials prove
+        is output-invariant (faults.split_batch parity, PR 4)."""
+        n = max(1, int(n))
+        self.batch_capacity = n
+        for b in self._builders.values():
+            b.capacity = n
+        for p in self._plans:
+            rg = getattr(p, "regeometry", None)
+            if rg is not None:
+                rg(batch_hint=n)
 
     def flush(self) -> None:
         """Drain all pending builders through the compiled plans.  In
@@ -767,7 +835,7 @@ class SiddhiAppRuntime:
         with self._lock:
             for sid, b in self._builders.items():
                 if len(b):
-                    self._pending.append((sid, b.freeze_and_clear()))
+                    self._pending.append((sid, self._freeze(sid, b)))
             self._drain()
             self._flush_plan_pipelines()
         self._flush_sink_outbox()
@@ -825,14 +893,14 @@ class SiddhiAppRuntime:
                     self._ingest_q.task_done()
             for sid, b in self._builders.items():
                 if len(b):
-                    self._pending.append((sid, b.freeze_and_clear()))
+                    self._pending.append((sid, self._freeze(sid, b)))
             self._drain()
             if self._ingest_err is not None:
                 err, self._ingest_err = self._ingest_err, None
                 raise err
             return
         with self._lock:
-            leftovers = [(sid, b.freeze_and_clear())
+            leftovers = [(sid, self._freeze(sid, b))
                          for sid, b in self._builders.items() if len(b)]
         self._async_outbox.extend(leftovers)
         self._drain_async_outbox()
@@ -892,6 +960,21 @@ class SiddhiAppRuntime:
                 if not self._pending:
                     continue
             sid, batch = self._pending.pop(0)
+            if self.slo is not None and self.slo.adaptive and batch.n >= 2 \
+                    and batch.n > 2 * self.batch_capacity:
+                # oversized ingest (a columnar send bigger than the SLO
+                # controller's current target): split with the PR-4
+                # halving machinery — output-invariant by the same parity
+                # argument as the degradation ladder — so one giant batch
+                # can't blow the latency target
+                from .faults import split_batch
+                t0b = batch.__dict__.get("_slo_t0")
+                halves = split_batch(batch)
+                for h in halves:
+                    if t0b is not None:
+                        h.__dict__["_slo_t0"] = t0b
+                self._pending[:0] = [(sid, h) for h in halves]
+                continue
             # the stream timer opens a batch-trace scope and feeds the
             # per-stream latency histogram (one clock read per batch)
             with self.stats.time_stream(sid, batch.n):
@@ -960,11 +1043,28 @@ class SiddhiAppRuntime:
                             raise
                         fault_err = fault_err or e
                         continue
+                    if self._debugger is not None and obs:
+                        # pipelined plans deliver through the dispatch
+                        # round's collect, not process(): the OUT
+                        # breakpoint must see these too
+                        self._debugger.check_out(plan, obs)
                     for ob in obs:
                         self._emit(plan, ob)
                 if fault_err is not None:
                     if not self._handle_batch_fault(sid, batch, fault_err):
                         raise fault_err
+            if self.slo is not None:
+                # one end-to-end latency sample per dispatched batch; AIMD
+                # decisions land between batches — a flush boundary — so
+                # geometry never changes under a batch in flight
+                now = time.perf_counter()
+                t0b = batch.__dict__.get("_slo_t0")
+                if t0b is not None:
+                    self.slo.observe(now - t0b)
+                dec = self.slo.maybe_decide(now)
+                if dec is not None \
+                        and int(dec["batch"]) != self.batch_capacity:
+                    self._apply_batch_target(int(dec["batch"]))
 
     # -- fault handling ------------------------------------------------------
 

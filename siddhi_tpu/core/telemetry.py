@@ -602,6 +602,35 @@ def render_prometheus(reports: dict) -> str:
                 doc.add("siddhi_tpu_sink_circuit_opens_total", "counter",
                         "times the per-sink circuit breaker opened", kl,
                         m.get("circuit_opens", 0))
+        # adaptive-geometry series (core/autotune.py)
+        tun = rep.get("tuning")
+        if tun:
+            doc.add("siddhi_tpu_tuning_cache_hits_total", "counter",
+                    "tuning-cache lookups that found a persisted geometry",
+                    al, tun.get("cache_hits", 0))
+            doc.add("siddhi_tpu_tuning_cache_misses_total", "counter",
+                    "tuning-cache lookups that fell back to defaults",
+                    al, tun.get("cache_misses", 0))
+            doc.add("siddhi_tpu_tuning_cache_entries", "gauge",
+                    "persisted geometry winners in the tuning cache",
+                    al, tun.get("tuning_cache_entries"))
+        slo = rep.get("slo")
+        if slo:
+            doc.add("siddhi_tpu_slo_target_seconds", "gauge",
+                    "@app:latencySLO p99 target", al,
+                    (slo["target_ms"] / 1e3) if "target_ms" in slo
+                    else None)
+            doc.add("siddhi_tpu_slo_window_p99_seconds", "gauge",
+                    "SLO controller's last decision-window p99", al,
+                    (slo["window_p99_ms"] / 1e3)
+                    if "window_p99_ms" in slo else None)
+            doc.add("siddhi_tpu_slo_batch_target", "gauge",
+                    "SLO controller's current micro-batch target", al,
+                    slo.get("batch_target"))
+            for action, n in slo.get("decisions", {}).items():
+                doc.add("siddhi_tpu_slo_decisions_total", "counter",
+                        "AIMD controller decisions by action",
+                        {**al, "action": action}, n)
     # process-wide (not per-app): emitted ONCE, unlabeled — an app label
     # would duplicate the same counter N times across a multi-app scrape
     # and N-fold overcount any PromQL sum()
@@ -826,6 +855,14 @@ class StatisticsManager:
                 sinks[f"{s.stream_id}[{i}]"] = m
         if sinks:
             rep["sinks"] = sinks
+        # adaptive execution geometry (core/autotune.py): tuning-cache
+        # hit/miss gauges + the SLO controller's state and decision log
+        tn = getattr(self.rt, "tuner", None)
+        if tn is not None and tn.enabled:
+            rep["tuning"] = tn.metrics()
+        slo = getattr(self.rt, "slo", None)
+        if slo is not None:
+            rep["slo"] = slo.metrics()
         return rep
 
     def prometheus(self) -> str:

@@ -459,9 +459,9 @@ class DeviceWindowAggPlan(QueryPlan):
             raise DeviceWindowUnsupported(f"unresolved columns {unknown}")
         self.cols = sorted(k for k in reads if k in schema.types)
 
+        from .autotune import pipeline_depth_for
         from .pipeline import DispatchPipeline
-        pl = ast.find_annotation(rt.app.annotations, "app:devicePipeline")
-        self.pipeline_depth = int(pl.element()) if pl is not None else 0
+        self.pipeline_depth = pipeline_depth_for(rt, "window", q)
         self._pipe = DispatchPipeline(name, self._materialize,
                                       depth=self.pipeline_depth)
 

@@ -14,6 +14,10 @@ Endpoints (JSON unless noted):
   GET  /siddhi/artifact/stats?siddhiApp=<name>
   GET  /metrics[?siddhiApp=<name>]  Prometheus text exposition (0.0.4) over
                                     every deployed app (or just <name>)
+  GET  /siddhi/artifact/tuning[?siddhiApp=<name>]
+                                    the persisted execution-geometry tuning
+                                    cache (docs/AUTOTUNING.md): entries +
+                                    hit/miss gauges, or one app's view
   GET  /siddhi/errors?siddhiApp=<name>[&stream=<id>]
                                     list the app's ErrorStore entries
                                     (@OnError(action='store') captures,
@@ -131,6 +135,13 @@ class SiddhiService:
                         else:
                             self._reply(200, service.errors(
                                 app, q.get("stream", [None])[0]))
+                    elif u.path == "/siddhi/artifact/tuning":
+                        app = q.get("siddhiApp", [None])[0]
+                        if app is not None and app not in service.runtimes:
+                            self._reply(404, {"error":
+                                              f"no deployed app {app!r}"})
+                        else:
+                            self._reply(200, service.tuning(app))
                     elif u.path == "/metrics":
                         app = q.get("siddhiApp", [None])[0]
                         if app is not None and app not in service.runtimes:
@@ -197,6 +208,19 @@ class SiddhiService:
                     "remaining": len(rt.error_store)}
         raise ValueError(f"unknown errors action {action!r} "
                          f"(replay | discard)")
+
+    def tuning(self, app: Optional[str] = None) -> dict:
+        """The persisted execution-geometry tuning cache (autotune.py):
+        globally, or one deployed app's view of it (its hit/miss gauges
+        and the geometries its build resolved)."""
+        from .core.autotune import device_kind, jax_version, shared_cache
+        if app is not None:
+            rt = self.runtimes[app]
+            return {"app": app, **rt.tuner.metrics()}
+        c = shared_cache()
+        return {"path": c.path, "device": device_kind(),
+                "jax": jax_version(), "hits": c.hits, "misses": c.misses,
+                "corrupt": c.corrupt, "entries": c.entries()}
 
     def metrics(self, app: Optional[str] = None) -> str:
         """Prometheus text exposition rendered LIVE from every deployed

@@ -1,0 +1,522 @@
+"""Adaptive execution geometry (core/autotune.py): tuning-cache
+persistence, planner consult, the AIMD SLO controller, geometry-
+invariance differentials per device plan family, and the service/
+telemetry surfacing."""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.autotune import (Autotuner, Geometry, SLOController,
+                                      TuningCache, lint_path,
+                                      plan_signature, shared_cache,
+                                      signature_of, validate_cache_data)
+
+
+def q4(x):
+    return np.round(np.asarray(x) * 4) / 4
+
+
+def tape(n, keys=8, seed=0, dt_ms=25):
+    rng = np.random.default_rng(seed)
+    return ({"sym": np.asarray([f"K{i}" for i in
+                                rng.integers(0, keys, n)]),
+             "p": q4(rng.uniform(90.0, 130.0, n)),
+             "v": rng.integers(1, 100, n).astype(np.int32)},
+            1_700_000_000_000 + np.arange(n, dtype=np.int64) * dt_ms)
+
+
+def run_geometry(app, feeds, batch, depth=None, chunk_lanes=None,
+                 capacity_switch=None):
+    """Feed `feeds` ({stream: (cols, ts)}) in fixed cross-stream quanta,
+    sub-chunked at `batch`, applying depth/chunk_lanes via the
+    regeometry hook; returns the full decoded output row/ts sequence.
+    `capacity_switch=(at_quantum, new_batch)` exercises a mid-stream
+    SLO-controller decision (_apply_batch_target)."""
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(app)
+    for p in rt._plans:
+        rg = getattr(p, "regeometry", None)
+        if rg is not None:
+            rg(batch_hint=batch, depth=depth, chunk_lanes=chunk_lanes)
+    out = []
+    rt.add_batch_callback("Out", lambda b: out.extend(
+        (int(ts), row) for ts, row in zip(b.timestamps,
+                                          b.rows(rt.strings))))
+    rt.start()
+    handlers = {s: rt.input_handler(s) for s in feeds}
+    Q = 128                     # fixed cross-stream interleave quantum
+    n = min(len(ts) for _c, ts in feeds.values())
+    for qi, q0 in enumerate(range(0, n, Q)):
+        if capacity_switch is not None and qi == capacity_switch[0]:
+            rt._apply_batch_target(capacity_switch[1])
+            batch = capacity_switch[1]
+        for s, (cols, ts) in feeds.items():
+            hi_q = min(q0 + Q, n)
+            for lo in range(q0, hi_q, batch):
+                hi = min(lo + batch, hi_q)
+                handlers[s].send_batch(
+                    {k: v[lo:hi] for k, v in cols.items()}, ts[lo:hi])
+    rt.flush()
+    mgr.shutdown()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# geometry-invariance differentials: same tape, >= 3 geometries per plan
+# family -> byte-identical outputs
+# ---------------------------------------------------------------------------
+
+FILTER_APP = """
+define stream S (sym string, p double, v int);
+@info(name='q') from S[p > 100] select sym, p, v * 2 as v2 insert into Out;
+"""
+
+WINDOW_APP = """
+@app:deviceWindows('auto')
+define stream S (sym string, p double, v int);
+@info(name='q') from S#window.length(64)
+select sym, sum(p) as sp, count() as c group by sym insert into Out;
+"""
+
+PATTERN_APP = """
+@app:devicePatterns('prefer')
+define stream S (sym string, p double, v int);
+@info(name='q') from every e1=S[p > 100] -> e2=S[p > e1.p] within 1 sec
+select e1.p as p1, e2.p as p2 insert into Out;
+"""
+
+JOIN_APP = """
+define stream S (sym string, p double, v int);
+define stream T (sym string, p double, v int);
+@info(name='q') from S#window.length(32) as a join T#window.length(32) as b
+on a.sym == b.sym and a.p > b.p
+select a.sym as s, a.p as lp, b.p as rp insert into Out;
+"""
+
+
+@pytest.mark.parametrize("app,two_streams,geos", [
+    (FILTER_APP, False, [(64, 0, None), (256, 2, None), (1024, 3, None)]),
+    (WINDOW_APP, False, [(64, 0, None), (256, 2, None), (512, 3, None)]),
+    (PATTERN_APP, False, [(128, 0, 8), (512, 2, 16), (1024, 3, 64)]),
+    (JOIN_APP, True, [(32, 0, None), (64, 2, None), (128, 3, None)]),
+], ids=["filter", "window", "pattern", "join"])
+def test_geometry_invariance(app, two_streams, geos):
+    n = 1024 if not two_streams else 512
+    feeds = {"S": tape(n, seed=0)}
+    if two_streams:
+        feeds["T"] = tape(n, seed=1)
+    ref = None
+    for batch, depth, lanes in geos:
+        out = run_geometry(app, feeds, batch, depth=depth,
+                           chunk_lanes=lanes)
+        assert out, f"geometry ({batch},{depth},{lanes}): no outputs"
+        if ref is None:
+            ref = out
+        else:
+            assert out == ref, (
+                f"geometry ({batch},{depth},{lanes}) diverged: "
+                f"{len(out)} vs {len(ref)} rows")
+
+
+def test_regeometry_respects_can_pipeline():
+    """A join with side filters must sync per flush (_can_pipeline is
+    False): a tuner/controller depth hint never overrides that."""
+    app = """
+    define stream S (sym string, p double, v int);
+    define stream T (sym string, p double, v int);
+    from S[p > 100]#window.length(8) as a join T#window.length(8) as b
+    on a.sym == b.sym select a.sym as s, b.p as bp insert into Out;
+    """
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(app)
+    plan = next(p for p in rt._plans
+                if type(p).__name__ == "DeviceJoinPlan")
+    assert not plan._can_pipeline and plan.pipeline_depth == 0
+    plan.regeometry(batch_hint=512, depth=3)
+    assert plan.pipeline_depth == 0 and plan._pipe.depth == 0
+    assert plan.batch_hint == 512      # the safe knob still lands
+    mgr.shutdown()
+
+
+def test_controller_decision_is_output_invariant():
+    """A mid-stream _apply_batch_target (what an SLO decision does at a
+    flush boundary) must not change outputs."""
+    feeds = {"S": tape(1024, seed=2)}
+    ref = run_geometry(FILTER_APP, feeds, 128)
+    switched = run_geometry(FILTER_APP, feeds, 128,
+                            capacity_switch=(4, 512))
+    assert switched == ref
+
+
+# ---------------------------------------------------------------------------
+# tuning cache: persistence round-trip, corruption fallback, lint
+# ---------------------------------------------------------------------------
+
+def test_cache_round_trip(tmp_path):
+    path = str(tmp_path / "tuning.json")
+    c1 = TuningCache(path)
+    sig = signature_of("filter", "some-query-shape")
+    assert c1.get(sig) is None and c1.misses == 1
+    key = c1.put(sig, {"batch": 4096, "pipeline_depth": 2},
+                 family="filter", score={"eps": 1000, "p99_ms": 3.2})
+    assert "|" in key and os.path.exists(path)
+    # a FRESH instance (new process analog) reads the same winner back
+    c2 = TuningCache(path)
+    ent = c2.get(sig)
+    assert ent["geometry"] == {"batch": 4096, "pipeline_depth": 2}
+    assert ent["family"] == "filter" and c2.hits == 1
+    ok, msgs = lint_path(path)
+    assert ok, msgs
+
+
+def test_cache_corruption_falls_back(tmp_path):
+    path = str(tmp_path / "tuning.json")
+    with open(path, "w") as f:
+        f.write("{ not json at all")
+    with pytest.warns(RuntimeWarning, match="corrupt"):
+        c = TuningCache(path)
+        assert c.get(signature_of("filter", "x")) is None
+    assert c.corrupt
+    assert os.path.exists(path + ".corrupt")   # quarantined, not trusted
+    # the cache still WORKS after corruption: a put() re-creates a valid
+    # file (deploy is never bricked)
+    sig = signature_of("window", "y")
+    c.put(sig, {"batch": 1024})
+    ok, msgs = lint_path(path)
+    assert ok, msgs
+    assert TuningCache(path).get(sig)["geometry"] == {"batch": 1024}
+
+
+def test_cache_schema_lint_catches_malformed(tmp_path):
+    bad = {"version": 1, "entries": {
+        "sig|cpu|jax1": {"geometry": {"batch": "huge"}},
+        "sig2|cpu|jax1": {"geometry": {"warp_factor": 9}},
+        "sig3|cpu|jax1": {"geometry": {}}}}
+    assert len(validate_cache_data(bad)) == 3
+    assert validate_cache_data({"version": 99, "entries": {}})
+    assert validate_cache_data([1, 2, 3])
+    path = str(tmp_path / "t.json")
+    with open(path, "w") as f:
+        json.dump(bad, f)
+    ok, msgs = lint_path(path)
+    assert not ok and len(msgs) == 3
+    # missing file = cold cache = fine
+    ok, _ = lint_path(str(tmp_path / "nope.json"))
+    assert ok
+
+
+# ---------------------------------------------------------------------------
+# the AIMD SLO controller
+# ---------------------------------------------------------------------------
+
+def drive(c, rate_eps, seconds, clock):
+    """Virtual-clock closed loop: per-batch latency = fixed floor + time
+    to fill the controller's batch target at the offered rate."""
+    end = clock + seconds
+    while clock < end:
+        latency = 0.002 + c.batch_target / rate_eps
+        clock += latency
+        c.observe(latency)
+        c.maybe_decide(clock)
+    return clock
+
+
+def test_aimd_convergence_under_rate_step():
+    c = SLOController(target_s=0.025, initial_batch=4096, min_batch=32,
+                      decide_every_s=0.25, min_samples=4)
+    clock = drive(c, 100_000, 30.0, 0.0)
+    # at 100k eps the sweet spot is batch ~2300 (0.023s fill): AIMD must
+    # sit inside 2x target with a settled batch
+    assert c.last_p99_s <= 2 * 0.025
+    assert 1000 <= c.batch_target <= 2400
+    settled = c.batch_target
+    # STEP the offered rate down 5x: the old batch now takes ~115ms to
+    # fill -> multiplicative decrease kicks in within a few windows
+    clock = drive(c, 20_000, 30.0, clock)
+    assert c.batch_target < settled / 2
+    assert c.last_p99_s <= 2 * 0.025, \
+        f"controller failed to re-converge: p99={c.last_p99_s * 1e3:.1f}ms"
+    assert c.counts["decrease"] >= 1 and c.counts["increase"] >= 2
+    # hysteresis: the band between target*(1-h) and target produces
+    # hold decisions rather than oscillation
+    assert c.counts["hold"] >= 1
+    # decision log is telemetry-visible and bounded
+    m = c.metrics()
+    assert m["decision_log"] and m["decisions"]["decrease"] >= 1
+    assert all(d["action"] in ("increase", "decrease", "hold")
+               for d in m["decision_log"])
+    # step back UP: additive increase recovers throughput
+    before = c.batch_target
+    drive(c, 100_000, 20.0, clock)
+    assert c.batch_target > before
+
+
+def test_controller_bounds_and_window_gating():
+    c = SLOController(target_s=0.010, initial_batch=64, min_batch=32,
+                      max_batch=128, decide_every_s=1.0, min_samples=4)
+    # too few samples / too little elapsed time -> no decision
+    c.maybe_decide(0.0)
+    c.observe(0.5)
+    assert c.maybe_decide(0.5) is None          # window not elapsed
+    assert c.maybe_decide(2.0) is None          # min_samples not met
+    for _ in range(4):
+        c.observe(0.5)
+    d = c.maybe_decide(3.0)
+    assert d["action"] == "decrease" and c.batch_target == 32
+    for _ in range(50):
+        for _ in range(4):
+            c.observe(0.0001)
+        c.maybe_decide(c._last_decide + 2.0)
+    assert c.batch_target == 128                # clamped at max_batch
+
+
+# ---------------------------------------------------------------------------
+# runtime wiring: @app:latencySLO + @app:maxBatchLatency fallback
+# ---------------------------------------------------------------------------
+
+def test_latency_slo_annotation_wires_controller():
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(
+        "@app:latencySLO('25 ms')\n" + FILTER_APP)
+    assert rt.slo is not None and rt.slo.adaptive
+    assert rt.slo.target_s == pytest.approx(0.025)
+    # flush cadence rides the controller: half the target by default
+    assert rt.max_batch_latency_s == pytest.approx(0.0125)
+    rt.start()
+    cols, ts = tape(256, seed=3)
+    rt.input_handler("S").send_batch(cols, ts)
+    rt.flush()
+    rep = rt.statistics()
+    assert rep["slo"]["adaptive"] and rep["slo"]["target_ms"] == 25.0
+    assert rep["slo"]["observed_batches"] >= 1
+    # the controller's series render in the Prometheus exposition
+    prom = rt.stats.prometheus()
+    assert "siddhi_tpu_slo_batch_target" in prom
+    assert "siddhi_tpu_slo_target_seconds" in prom
+    mgr.shutdown()
+
+
+def test_slo_oversize_batch_splits_output_invariant():
+    """A columnar send far larger than the SLO batch target is split via
+    the PR-4 halving machinery; outputs match the un-SLO'd run."""
+    cols, ts = tape(2048, seed=4)
+
+    def run(head):
+        mgr = SiddhiManager()
+        rt = mgr.create_app_runtime(head + FILTER_APP)
+        if head:
+            rt._apply_batch_target(128)   # force 2048 >> 2 * target
+        out = []
+        rt.add_batch_callback("Out", lambda b: out.extend(
+            (int(t), r) for t, r in zip(b.timestamps,
+                                        b.rows(rt.strings))))
+        rt.start()
+        rt.input_handler("S").send_batch(cols, ts)
+        rt.flush()
+        mgr.shutdown()
+        return out
+
+    plain = run("")
+    split = run("@app:latencySLO('25 ms')\n")
+    assert split == plain and len(plain) > 0
+
+
+def test_max_batch_latency_rides_controller_non_adaptive():
+    """@app:maxBatchLatency reimplemented on the SLO controller path:
+    cadence-only mode, no AIMD, and the auto-flush behavior holds (the
+    no-silent-semantics-change fallback)."""
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(
+        "@app:maxBatchLatency('40 ms')\n" + FILTER_APP)
+    assert rt.slo is not None and not rt.slo.adaptive
+    assert rt.slo.target_s is None
+    assert rt.max_batch_latency_s == pytest.approx(0.040)
+    # an aged-out partial builder still flushes without an explicit
+    # flush() — the original annotation behavior
+    got = []
+    rt.add_callback("Out", lambda evs: got.extend(e.data for e in evs))
+    rt.start()
+    h = rt.input_handler("S")
+    h.send(("K1", 101.0, 1))        # far below batch_capacity
+    deadline = time.time() + 5.0
+    while not got and time.time() < deadline:
+        time.sleep(0.01)
+    mgr.shutdown()
+    assert got == [("K1", 101.0, 2)]     # v2 = v * 2
+    # and no controller decisions ever fire in cadence-only mode
+    assert rt.slo.counts == {"increase": 0, "decrease": 0, "hold": 0}
+
+
+def test_latency_cadence_drains_pipelined_results():
+    """A depth-D dispatch pipeline (tuned or annotated) must not hold an
+    aged-out micro-batch's results past the flush cadence: the scheduler
+    pump drains in-flight entries, so latency targets and pipelining
+    compose."""
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(
+        "@app:maxBatchLatency('40 ms')\n@app:devicePipeline(2)\n"
+        + FILTER_APP)
+    assert rt._plans[0].pipeline_depth == 2
+    got = []
+    rt.add_callback("Out", lambda evs: got.extend(e.data for e in evs))
+    rt.start()
+    rt.input_handler("S").send(("K1", 101.0, 1))
+    deadline = time.time() + 5.0
+    while not got and time.time() < deadline:
+        time.sleep(0.01)     # NO explicit flush(): the pump must deliver
+    mgr.shutdown()
+    assert got == [("K1", 101.0, 2)]
+
+
+# ---------------------------------------------------------------------------
+# autotuner sweep + planner consult
+# ---------------------------------------------------------------------------
+
+def test_autotuner_sweep_persists_and_planner_consults(tmp_path,
+                                                       monkeypatch):
+    monkeypatch.setenv("SIDDHI_TUNE_CACHE", str(tmp_path / "tune.json"))
+    tuner = Autotuner()     # the shared per-path cache runtimes consult
+    res = tuner.tune(FILTER_APP, n_events=2048,
+                     grid=[Geometry(batch=256, pipeline_depth=0),
+                           Geometry(batch=512, pipeline_depth=2)],
+                     warm_events=256)
+    assert not res["from_cache"]
+    assert len(res["candidates"]) == 2
+    assert res["winner"]["batch"] in (256, 512)
+    # every candidate saw identical outputs (enforced inside tune())
+    ms = {c["matches"] for c in res["candidates"]}
+    assert len(ms) == 1 and ms.pop() > 0
+    # warm cache: the second tune() skips the sweep entirely
+    res2 = tuner.tune(FILTER_APP, n_events=2048)
+    assert res2["from_cache"] and res2["candidates"] == []
+    # a fresh runtime build consults the persisted winner: batch
+    # capacity + pipeline depth come from the cache, and the hit gauges
+    # show it
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(FILTER_APP)
+    assert rt.batch_capacity == res["winner"]["batch"]
+    plan = rt._plans[0]
+    assert plan.pipeline_depth == res["winner"]["pipeline_depth"]
+    assert rt.tuner.hits >= 2
+    rep_t = rt.statistics()["tuning"]
+    assert rep_t["cache_hits"] >= 2 and rep_t["tuning_cache_entries"] >= 2
+    prom = rt.stats.prometheus()
+    assert "siddhi_tpu_tuning_cache_hits_total" in prom
+    # explicit annotations still win over the cache
+    rt2 = mgr.create_app_runtime("@app:devicePipeline(7)\n" + FILTER_APP)
+    assert rt2._plans[0].pipeline_depth == 7
+    mgr.shutdown()
+
+
+def test_sweep_rejects_output_divergence(tmp_path):
+    """The invariance guard actually fires: doctor one candidate's
+    result path and the sweep must raise rather than persist."""
+    from siddhi_tpu.core.autotune import AutotuneError
+    tuner = Autotuner(TuningCache(str(tmp_path / "t.json")))
+    real = tuner._measure
+    calls = [0]
+
+    def crooked(app_text, g, tapes, n_events, warm_events, out_streams):
+        res = real(app_text, g, tapes, n_events, warm_events, out_streams)
+        calls[0] += 1
+        if calls[0] == 2:
+            res["out_crc"] ^= 1
+        return res
+
+    tuner._measure = crooked
+    with pytest.raises(AutotuneError, match="output-invariant"):
+        tuner.tune(FILTER_APP, n_events=1024,
+                   grid=[Geometry(batch=256), Geometry(batch=512)],
+                   warm_events=256, force=True)
+
+
+def test_plan_signature_stability():
+    mgr = SiddhiManager()
+    rt1 = mgr.create_app_runtime(FILTER_APP)
+    rt2 = mgr.create_app_runtime(FILTER_APP)
+    s1 = plan_signature(rt1._plans[0])
+    assert s1 is not None and s1.startswith("filter:")
+    assert s1 == plan_signature(rt2._plans[0])
+    rt3 = mgr.create_app_runtime(FILTER_APP.replace("p > 100", "p > 99"))
+    assert plan_signature(rt3._plans[0]) != s1
+    mgr.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# service surfacing
+# ---------------------------------------------------------------------------
+
+def test_service_tuning_endpoint():
+    import urllib.request
+    from siddhi_tpu.service import SiddhiService
+    svc = SiddhiService(port=0).start()
+    base = f"http://127.0.0.1:{svc.port}"
+    try:
+        app = ("@app:name('TuneMe')\n" + FILTER_APP)
+        req = urllib.request.Request(f"{base}/siddhi/artifact/deploy",
+                                     data=app.encode(), method="POST")
+        assert json.loads(urllib.request.urlopen(req).read())["app"] \
+            == "TuneMe"
+        with urllib.request.urlopen(f"{base}/siddhi/artifact/tuning") as r:
+            body = json.loads(r.read())
+        assert body["path"] == shared_cache().path
+        assert "entries" in body and "hits" in body and "device" in body
+        with urllib.request.urlopen(
+                f"{base}/siddhi/artifact/tuning?siddhiApp=TuneMe") as r:
+            per_app = json.loads(r.read())
+        assert per_app["app"] == "TuneMe"
+        assert "cache_hits" in per_app and "cache_misses" in per_app
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{base}/siddhi/artifact/tuning?siddhiApp=Nope")
+        assert ei.value.code == 404
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# fused-lane packing (@app:fusedLanes)
+# ---------------------------------------------------------------------------
+
+def test_fused_lane_packing_splits_groups():
+    nq = 16     # MIN_GROUP is 8: a pack below it can't fuse on its own
+    parts = ["@app:playback\n@app:fusedLanes(8)\n"
+             "define stream S (sym string, p double);"]
+    for i in range(nq):
+        parts.append(
+            f"@info(name='q{i}') from every e1=S[p > {100 + i}] -> "
+            f"e2=S[p > e1.p] within 1 sec "
+            f"select e1.p as p1, e2.p as p2 insert into Out{i};")
+    app16 = "\n".join(parts)
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(app16)
+    fused = [p for p in rt._plans
+             if type(p).__name__ == "MultiQueryDevicePatternPlan"]
+    assert len(fused) == 2 and all(p.n_queries == 8 for p in fused)
+    # unpacked: one kernel carries all 16 lanes
+    rt2 = mgr.create_app_runtime(app16.replace("@app:fusedLanes(8)\n", ""))
+    fused2 = [p for p in rt2._plans
+              if type(p).__name__ == "MultiQueryDevicePatternPlan"]
+    assert len(fused2) == 1 and fused2[0].n_queries == nq
+    # same matches either way (lane packing is a geometry knob, not a
+    # semantics knob)
+    def feed(r):
+        got = []
+        for i in range(nq):
+            r.add_callback(f"Out{i}", lambda evs, i=i: got.extend(
+                (i, e.data) for e in evs))
+        r.start()
+        h = r.input_handler("S")
+        rng = np.random.default_rng(7)
+        ts0 = 1_700_000_000_000
+        for k in range(256):
+            h.send((f"K{k % 4}", float(q4(rng.uniform(90, 135)))),
+                   timestamp=ts0 + k * 25)
+        r.flush()
+        return sorted(got)
+    assert feed(rt) == feed(rt2)
+    mgr.shutdown()
