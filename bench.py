@@ -132,7 +132,11 @@ def _overlap_summary(stats: dict) -> dict:
 
 def p99_latency(app, stream, tape, keys, out_stream="Out", warm=10):
     """Per-match detect latency: batch-ingest start -> callback delivery
-    through the public path.  Returns p99 in ms (None if no matches)."""
+    through the public path.  Returns p99 in ms (None if no matches).
+    Warm batches run (and FLUSH) before the timed window so compiles and
+    deferred pipeline deliveries land outside it — the treatment config 6
+    got in PR 5; without the post-warm flush the largest frontier points
+    could time a compile and report null/outlier p99s."""
     from siddhi_tpu import SiddhiManager
 
     mgr = SiddhiManager()
@@ -147,9 +151,11 @@ def p99_latency(app, stream, tape, keys, out_stream="Out", warm=10):
     batches = _columnar(rt, stream, tape, keys)
     for i, (cols, ts) in enumerate(batches):
         if i == warm:
+            rt.flush()          # drain warm leftovers OUTSIDE the window
             lat.clear()
         t_start[0] = time.perf_counter()
         h.send_batch(cols, ts)
+    rt.flush()                  # deliver anything still in flight
     mgr.shutdown()
     return round(float(np.percentile(lat, 99)), 1) if lat else None
 
@@ -176,6 +182,13 @@ C2B = STOCK_ET + ("@info(name='q') from StockStream"
 C3 = STOCK + ("@info(name='q') from every e1=StockStream[price > 100] -> "
               "e2=StockStream[price > e1.price] within 1 sec "
               "select e1.price as p1, e2.price as p2 insert into Out;\n")
+
+# static-transition variant of config 3 (no capture-dependent filter):
+# the shape the bit-packed multi-stride "dfa" plan family accepts — used
+# for the per-family kernel roofline sweep
+C3S = STOCK + ("@info(name='q') from every e1=StockStream[price > 100] -> "
+               "e2=StockStream[price < 95] within 1 sec "
+               "select e1.price as p1, e2.price as p2 insert into Out;\n")
 
 C4 = STOCK + """
 partition with (symbol of StockStream)
@@ -286,6 +299,47 @@ def bench_config(name, dev_app, host_app, n, batch, keys=8, dt_ms=1,
     return res
 
 
+def _wrap_kernel_factory(obj, name, store):
+    """Wrap a jitted-block factory so the last (fn, args) pair is kept
+    for device-resident re-invocation (kernel-only probes)."""
+    orig = getattr(obj, name)
+
+    def factory(*a, **k):
+        fn = orig(*a, **k)
+
+        def wrapped(*fa):
+            store["fn"], store["args"] = fn, fa
+            return fn(*fa)
+        return wrapped
+    setattr(obj, name, factory)
+
+
+def _capture_pattern_kernels(plan, store):
+    """Instrument EVERY pattern execution family's block factory on one
+    plan (sequential NFAKernel, chunked-halo per-K kernels, and the
+    scan/dfa parallel kernels) so kernel-only probes capture whichever
+    family the plan actually dispatches."""
+    _wrap_kernel_factory(plan.kernel, "block_fn", store)
+    orig_ck = plan._chunk_kernel
+
+    def chunk_kernel(K):
+        kern = orig_ck(K)
+        if not getattr(kern, "_bench_wrapped", False):
+            _wrap_kernel_factory(kern, "block_fn", store)
+            kern._bench_wrapped = True
+        return kern
+    plan._chunk_kernel = chunk_kernel
+    orig_pk = plan._parallel_kernel
+
+    def par_kernel():
+        kern = orig_pk()
+        if not getattr(kern, "_bench_wrapped", False):
+            _wrap_kernel_factory(kern, "block_fn", store)
+            kern._bench_wrapped = True
+        return kern
+    plan._parallel_kernel = par_kernel
+
+
 def kernel_p99_ms(app, batch, keys=8, dt_ms=1, chains=8, per=16):
     """Kernel-COMPUTE-only detect latency at this micro-batch size: the
     captured jitted NFA block re-runs in `chains` chains of `per` calls on
@@ -303,28 +357,7 @@ def kernel_p99_ms(app, batch, keys=8, dt_ms=1, chains=8, per=16):
     h = rt.input_handler(STREAM)
     store: dict = {}
     plan = next(p for p in rt._plans if isinstance(p, DevicePatternPlan))
-
-    def wrap_factory(obj, name):
-        orig = getattr(obj, name)
-
-        def factory(*a, **k):
-            fn = orig(*a, **k)
-
-            def wrapped(*fa):
-                store["fn"], store["args"] = fn, fa
-                return fn(*fa)
-            return wrapped
-        setattr(obj, name, factory)
-    wrap_factory(plan.kernel, "block_fn")
-    orig_ck = plan._chunk_kernel
-
-    def chunk_kernel(K):
-        kern = orig_ck(K)
-        if not getattr(kern, "_bench_wrapped", False):
-            wrap_factory(kern, "block_fn")
-            kern._bench_wrapped = True
-        return kern
-    plan._chunk_kernel = chunk_kernel
+    _capture_pattern_kernels(plan, store)
 
     tape = make_tape(2 * batch, batch, keys=keys, dt_ms=dt_ms)
     for cols, ts in _columnar(rt, STREAM, tape, keys):
@@ -516,7 +549,7 @@ def bench_overlap(n=1 << 16, batch=1 << 13, repeats=3, depth=3):
                     f"is overlap, not kernel changes"}
 
 
-def kernel_eps(app, family, batch, keys=8, dt_ms=1, reps=6):
+def kernel_eps(app, family, batch, keys=8, dt_ms=1, reps=6, info=None):
     """Device-COMPUTE-only events/sec (VERDICT r4 weak #2): feed one real
     batch through the engine to compile + capture the jitted kernel call
     and its device-resident arguments, then re-invoke the kernel `reps`
@@ -536,18 +569,6 @@ def kernel_eps(app, family, batch, keys=8, dt_ms=1, reps=6):
     h = rt.input_handler(STREAM)
     store: dict = {}
 
-    def wrap_factory(obj, name):
-        orig = getattr(obj, name)
-
-        def factory(*a, **k):
-            fn = orig(*a, **k)
-
-            def wrapped(*fa):
-                store["fn"], store["args"] = fn, fa
-                return fn(*fa)
-            return wrapped
-        setattr(obj, name, factory)
-
     plans = rt._plans
     if family == "filter":
         plan = next(p for p in plans if isinstance(p, FilterProjectPlan))
@@ -561,20 +582,12 @@ def kernel_eps(app, family, batch, keys=8, dt_ms=1, reps=6):
     elif family == "window":
         plan = next(p for p in plans
                     if p.__class__.__name__ == "DeviceWindowAggPlan")
-        wrap_factory(plan, "_step_fn")
+        _wrap_kernel_factory(plan, "_step_fn", store)
         count = lambda args: int(np.asarray(args[1]["__nvalid__"]))
     elif family == "pattern":
         plan = next(p for p in plans if isinstance(p, DevicePatternPlan))
-        wrap_factory(plan.kernel, "block_fn")
-        orig_ck = plan._chunk_kernel
-
-        def chunk_kernel(K):
-            kern = orig_ck(K)
-            if not getattr(kern, "_bench_wrapped", False):
-                wrap_factory(kern, "block_fn")
-                kern._bench_wrapped = True
-            return kern
-        plan._chunk_kernel = chunk_kernel
+        _capture_pattern_kernels(plan, store)
+        store["plan_family"] = plan.family
 
         def count(args):
             ev = args[1]
@@ -590,6 +603,8 @@ def kernel_eps(app, family, batch, keys=8, dt_ms=1, reps=6):
     rt.flush()
     if "fn" not in store:
         mgr.shutdown()
+        if info is not None and "plan_family" in store:
+            info["plan_family"] = store["plan_family"]
         return None
     fn, args = store["fn"], store["args"]
     n_call = count(args)
@@ -617,6 +632,8 @@ def kernel_eps(app, family, batch, keys=8, dt_ms=1, reps=6):
     jax.block_until_ready(chain(reps))
     dt = time.perf_counter() - t0
     mgr.shutdown()
+    if info is not None and "plan_family" in store:
+        info["plan_family"] = store["plan_family"]
     return round(n_call * reps / dt)
 
 
@@ -715,6 +732,18 @@ def _mark(label, t0):
           file=sys.stderr, flush=True)
 
 
+def _safe(label, fn, default=None):
+    """Run one optional bench section; a failure degrades that section to
+    `default` instead of killing the run — the final stdout line must
+    ALWAYS be the machine-parseable summary (BENCH "parsed": null)."""
+    try:
+        return fn()
+    except Exception as e:
+        print(f"[bench] section {label!r} failed: {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
+        return default
+
+
 # ---------------------------------------------------------------------------
 # --autotune: tuner-driven frontier sweep + online SLO-controller demo
 # (core/autotune.py — see docs/AUTOTUNING.md)
@@ -759,7 +788,15 @@ def autotune_bench(smoke=False):
                          Geometry(batch=1 << 17, pipeline_depth=0),
                          Geometry(batch=1 << 17, pipeline_depth=3),
                          Geometry(batch=1 << 17, pipeline_depth=3,
-                                  chunk_lanes=128)]},
+                                  chunk_lanes=128),
+                         # plan-family axis: the sweep's output-invariance
+                         # check doubles as a cross-family differential
+                         Geometry(batch=1 << 17, pipeline_depth=3,
+                                  plan_family="chunk"),
+                         Geometry(batch=1 << 17, pipeline_depth=3,
+                                  plan_family="scan"),
+                         Geometry(batch=1 << 17, pipeline_depth=3,
+                                  plan_family="seq")]},
             "4_partitioned_1k": {
                 "app": ("@app:partitionCapacity(1000)\n"
                         "@app:deviceSlots(32)\n") + C4,
@@ -1195,8 +1232,79 @@ def chaos_bench(seed: int = 7) -> dict:
     return out
 
 
+def _print_summary(summary: dict, cap: int = 2048) -> None:
+    """Emit the machine-parseable summary as the FINAL stdout line,
+    bounded to `cap` bytes: drivers keep only a stdout tail and parse
+    its last line, so an oversized line truncates into garbage (the
+    BENCH "parsed": null failure shape).  Oversize degrades by dropping
+    detail keys — never by emitting an unparseable line."""
+    drop_order = ("stage_shares_config3", "configs", "roofline",
+                  "trace_coverage_config3")
+    line = json.dumps(summary)
+    for key in drop_order:
+        if len(line) <= cap:
+            break
+        summary.pop(key, None)
+        line = json.dumps(summary)
+    sys.stderr.flush()
+    print(line, flush=True)
+
+
+def pattern_families_smoke() -> dict:
+    """`bench.py --family-smoke` (scripts/smoke.sh): one eligible pattern
+    per plan family, run differentially against the host interpreter —
+    a lowering regression in any family fails fast, in CI time budget."""
+    from siddhi_tpu import SiddhiManager
+
+    CASES = {
+        # family -> (annotation head, query): each query is eligible for
+        # the family it exercises (asserted below via plan.family)
+        "seq": ("@app:patternFamily('seq')\n", C3),
+        "chunk": ("@app:patternFamily('chunk')\n", C3),
+        "scan": ("@app:patternFamily('scan')\n", C3),
+        "dfa": ("@app:patternFamily('dfa')\n", C3S),
+    }
+
+    def run(app, n=1024, batch=256):
+        mgr = SiddhiManager()
+        rt = mgr.create_app_runtime(app)
+        rows = []
+        rt.add_batch_callback("Out", lambda b: rows.extend(
+            map(tuple, b.rows(rt.strings))))
+        rt.start()
+        h = rt.input_handler(STREAM)
+        from siddhi_tpu.core.pattern_plan import DevicePatternPlan
+        fam = next((p.family for p in rt._plans
+                    if isinstance(p, DevicePatternPlan)), None)
+        tape = make_tape(n, batch)
+        for cols, ts in _columnar(rt, STREAM, tape, 8):
+            h.send_batch(cols, ts)
+        rt.flush()
+        mgr.shutdown()
+        return fam, rows
+
+    out = {"families": {}, "pass": True}
+    for fam, (ann, q) in CASES.items():
+        used, dev = run(ann + DEV["patterns"] + q)
+        _u, host = run(HOST["patterns"] + q)
+        ok = used == fam and dev == host and len(dev) > 0
+        out["families"][fam] = {"engaged": used, "matches": len(dev),
+                                "host_matches": len(host),
+                                "identical": dev == host, "pass": ok}
+        out["pass"] = out["pass"] and ok
+    return out
+
+
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
+    if "--family-smoke" in argv:
+        res = pattern_families_smoke()
+        print(json.dumps({"metric": "plan_family_parity",
+                          "value": 1 if res["pass"] else 0,
+                          "unit": "all_families_match_interpreter", **res}))
+        if not res["pass"]:
+            sys.exit(1)
+        return
     if "--chaos" in argv:
         seed = 7
         if "--seed" in argv:
@@ -1267,8 +1375,36 @@ def main(argv=None):
         "sequence", PIPE + DEV["patterns"] + C3, HOST["patterns"] + C3,
         n=1 << 18, batch=1 << 17, latency=True,
         lat_dev_app=DEV["patterns"] + C3)
+    info3: dict = {}
     configs["3_sequence"]["kernel_eps"] = kernel_eps(
-        DEV["patterns"] + C3, "pattern", batch=1 << 17)
+        DEV["patterns"] + C3, "pattern", batch=1 << 17, info=info3)
+    configs["3_sequence"]["plan_family"] = info3.get("plan_family")
+    # per-family kernel roofline sweep (the plan-family axis): same tape,
+    # same batch, each family forced via @app:patternFamily; the "dfa"
+    # family needs a static transition, so it sweeps the C3S variant
+    # next to "scan" on the same tape for a like-for-like column
+
+    def _fam_eps(fam, app):
+        # a forced-but-ineligible family falls back with a warning; the
+        # roofline must never mislabel the fallback's throughput, so the
+        # ENGAGED family is checked and mismatches are reported as such
+        inf: dict = {}
+        eps = kernel_eps(app, "pattern", batch=1 << 17, info=inf)
+        used = inf.get("plan_family")
+        if used != fam:
+            return {"eps": eps, "engaged": used, "requested": fam}
+        return eps
+
+    configs["3_sequence"]["kernel_eps_by_family"] = {
+        fam: _safe(f"kernel_eps family {fam}", lambda fam=fam: _fam_eps(
+            fam, f"@app:patternFamily('{fam}')\n" + DEV["patterns"] + C3))
+        for fam in ("seq", "chunk", "scan")}
+    configs["3_sequence"]["kernel_eps_static_by_family"] = {
+        fam: _safe(f"kernel_eps static family {fam}",
+                   lambda fam=fam: _fam_eps(
+                       fam, f"@app:patternFamily('{fam}')\n"
+                       + DEV["patterns"] + C3S))
+        for fam in ("scan", "dfa")}
     _mark("config 3 done", t0)
 
     # latency/throughput frontier for the CEP sequence config (the
@@ -1279,22 +1415,30 @@ def main(argv=None):
     # a REAL p99 (it used to report null): measured unpipelined, like
     # every other frontier point
     big = c3["batch"]
-    c3["frontier"] = frontier(DEV["patterns"] + C3, HOST["patterns"] + C3,
-                              deadline=t0 + 420) + [
+    # the largest frontier point gets a REAL measured p99 like every
+    # other point: warmed (and flushed) before timing — the same
+    # treatment config 6 got in PR 5 (BENCH_r05 still recorded null)
+    c3["frontier"] = _safe("frontier", lambda: frontier(
+        DEV["patterns"] + C3, HOST["patterns"] + C3,
+        deadline=t0 + 420), []) + [
         {"batch": big, "eps": c3["device_eps"],
-         "p99_ms": p99_latency(DEV["patterns"] + C3, STREAM,
-                               make_tape(big * 10, big), 8, warm=4)}]
-    c3["latency_demo"] = latency_demo(DEV["patterns"] + C3,
-                                      HOST["patterns"] + C3)
-    c3["trace"] = trace_breakdown(DEV["patterns"] + C3)
+         "p99_ms": _safe("big-point p99", lambda: p99_latency(
+             DEV["patterns"] + C3, STREAM,
+             make_tape(big * 8, big), 8, warm=4))}]
+    c3["latency_demo"] = _safe("latency_demo", lambda: latency_demo(
+        DEV["patterns"] + C3, HOST["patterns"] + C3))
+    c3["trace"] = _safe("trace", lambda: trace_breakdown(
+        DEV["patterns"] + C3), {})
     _mark("frontier + latency demo + trace done", t0)
 
     head = ("@app:partitionCapacity(1000)\n@app:deviceSlots(32)\n")
     configs["4_partitioned_1k"] = bench_config(
         "partitioned", head + C4, HOST["patterns"] + C4,
         n=2 << 18, batch=1 << 18, keys=1000, latency=True, repeats=5)
+    info4: dict = {}
     configs["4_partitioned_1k"]["kernel_eps"] = kernel_eps(
-        head + C4, "pattern", batch=1 << 18, keys=1000)
+        head + C4, "pattern", batch=1 << 18, keys=1000, info=info4)
+    configs["4_partitioned_1k"]["plan_family"] = info4.get("plan_family")
 
     c5 = c5_app(1000)
     c5_outs = tuple(f"Out{i}" for i in range(16))
@@ -1368,7 +1512,7 @@ def main(argv=None):
     # this image, so an -O2 C++ run of the same matcher algorithms on
     # the same tape distribution stands in as a conservative UPPER bound
     # for single-JVM single-thread throughput on this hardware
-    nat = native_baseline()
+    nat = _safe("native baseline", native_baseline, {})
     nat_of = {"1_filter": "filter", "2_window_agg": "window",
               "3_sequence": "sequence", "4_partitioned_1k": "partitioned"}
     for cfg, key in nat_of.items():
@@ -1377,6 +1521,25 @@ def main(argv=None):
             configs[cfg]["vs_native_cpp"] = round(
                 configs[cfg]["device_eps"] / nat[key]["eps"], 2)
     _mark("native baseline done", t0)
+
+    # roofline block (ROADMAP item 2 trajectory): per-config device
+    # KERNEL eps vs the single-thread native C++ roofline, for the
+    # WINNING plan family — the gap this PR's parallel-in-time families
+    # exist to close, tracked per run
+    roofline = {}
+    for cfg in ("3_sequence", "4_partitioned_1k"):
+        c = configs.get(cfg, {})
+        ke, ne = c.get("kernel_eps"), c.get("native_cpp_eps")
+        roofline[cfg] = {
+            "plan_family": c.get("plan_family"),
+            "kernel_eps": ke,
+            "native_cpp_eps": ne,
+            "vs_native_cpp": round(ke / ne, 4) if ke and ne else None,
+        }
+    roofline["3_sequence"]["kernel_eps_by_family"] = \
+        configs["3_sequence"].get("kernel_eps_by_family")
+    roofline["3_sequence"]["kernel_eps_static_by_family"] = \
+        configs["3_sequence"].get("kernel_eps_static_by_family")
 
     h = configs["4_partitioned_1k"]
     detail = {
@@ -1402,29 +1565,37 @@ def main(argv=None):
                          "fixed pull latency, ~10-25 MB/s): transfers, "
                          "not compute, bound most configs here",
         },
+        "roofline": roofline,
         "configs": configs,
     }
-    with open("BENCH_DETAIL.json", "w") as f:
-        json.dump(detail, f, indent=1)
+    def _write_detail():
+        with open("BENCH_DETAIL.json", "w") as f:
+            json.dump(detail, f, indent=1)
+    _safe("detail file", _write_detail)
     # ONE short stdout line: drivers keep only the stdout TAIL, so the
     # full per-config detail (which blew past their capture window —
-    # BENCH_r05 "parsed": null) goes to BENCH_DETAIL.json and the
-    # parseable summary stays well under 2 kB
-    tr = c3.get("trace", {})
-    print(json.dumps({
+    # BENCH "parsed": null) goes to BENCH_DETAIL.json and the parseable
+    # summary stays bounded; _print_summary degrades the payload rather
+    # than ever emitting an oversized/unparseable final line
+    tr = c3.get("trace") or {}
+    summary = {
         "metric": detail["metric"], "value": detail["value"],
         "unit": detail["unit"], "vs_baseline": detail["vs_baseline"],
         "vs_production_claim": detail["vs_production_claim"],
         "p99_detect_ms": detail["p99_detect_ms"],
         "trace_coverage_config3": tr.get("coverage"),
-        "stage_shares_config3": {st: d["share"] for st, d in
+        "stage_shares_config3": {st: d.get("share") for st, d in
                                  tr.get("stages", {}).items()},
+        "roofline": {k: {kk: v.get(kk) for kk in
+                         ("plan_family", "kernel_eps", "vs_native_cpp")}
+                     for k, v in roofline.items()},
         "configs": {k: {"eps": v["device_eps"], "speedup": v["speedup"],
                         **({"p99_ms": v["p99_detect_ms"]}
                            if v.get("p99_detect_ms") is not None else {})}
                     for k, v in configs.items()},
         "detail": "BENCH_DETAIL.json",
-    }))
+    }
+    _print_summary(summary)
 
 
 if __name__ == "__main__":

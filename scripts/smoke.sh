@@ -85,6 +85,13 @@ echo "== autotune smoke =="
 SIDDHI_TUNE_CACHE="$(mktemp -u /tmp/siddhi_tune_smoke.XXXXXX.json)" \
     python bench.py --autotune --smoke
 
+echo "== plan-family parity smoke =="
+# bench.py --family-smoke: one eligible pattern per NFA plan family
+# (seq / chunk / scan / dfa), each run differentially against the host
+# interpreter — a lowering regression in any family fails fast here
+# instead of surfacing as wrong matches in production
+python bench.py --family-smoke
+
 echo "== pipelined-vs-unpipelined bench smoke =="
 # bench.py --smoke: short pipelined-vs-unpipelined run over the
 # multi-plan overlap config; asserts identical match counts and prints

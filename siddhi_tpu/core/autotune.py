@@ -53,8 +53,13 @@ import numpy as np
 from ..query import ast
 
 CACHE_VERSION = 1
-GEOMETRY_KEYS = ("batch", "pipeline_depth", "chunk_lanes", "lane_pack")
+GEOMETRY_KEYS = ("batch", "pipeline_depth", "chunk_lanes", "lane_pack",
+                 "plan_family")
 PLAN_FAMILIES = ("filter", "window", "join", "pattern", "multi_query", "app")
+# pattern-kernel execution families (docs/PERFORMANCE.md "Plan families"):
+# seq = persistent sequential-in-T NFA scan, chunk = stateless chunked-halo
+# lanes, scan = associative-scan SFA, dfa = bit-packed multi-stride hybrid
+PATTERN_FAMILIES = ("seq", "chunk", "scan", "dfa")
 
 
 class AutotuneError(Exception):
@@ -73,6 +78,7 @@ class Geometry:
     pipeline_depth: Optional[int] = None    # @app:devicePipeline depth
     chunk_lanes: Optional[int] = None       # chunked-NFA lane count K
     lane_pack: Optional[int] = None         # fused multi-query lanes/kernel
+    plan_family: Optional[str] = None       # pattern family (PATTERN_FAMILIES)
 
     def to_dict(self) -> dict:
         return {k: getattr(self, k) for k in GEOMETRY_KEYS
@@ -80,8 +86,12 @@ class Geometry:
 
     @classmethod
     def from_dict(cls, d: dict) -> "Geometry":
-        return cls(**{k: (int(d[k]) if d.get(k) is not None else None)
-                      for k in GEOMETRY_KEYS if k in d})
+        out = {}
+        for k in GEOMETRY_KEYS:
+            if k not in d or d.get(k) is None:
+                continue
+            out[k] = str(d[k]) if k == "plan_family" else int(d[k])
+        return cls(**out)
 
     def label(self) -> str:
         return ",".join(f"{k}={v}" for k, v in self.to_dict().items())
@@ -195,6 +205,10 @@ def validate_cache_data(data) -> list:
             for k, v in geo.items():
                 if k not in GEOMETRY_KEYS:
                     probs.append(f"{where}: unknown geometry knob {k!r}")
+                elif k == "plan_family":
+                    if v not in PATTERN_FAMILIES:
+                        probs.append(f"{where}: plan_family must be one of "
+                                     f"{PATTERN_FAMILIES}, got {v!r}")
                 elif not isinstance(v, int) or isinstance(v, bool) \
                         or v < 0:
                     probs.append(f"{where}: knob {k!r} must be a "
@@ -291,7 +305,8 @@ class TuningCache:
 
     def put(self, sig: str, geometry: dict, family: Optional[str] = None,
             score: Optional[dict] = None) -> str:
-        geometry = {k: int(v) for k, v in geometry.items()
+        geometry = {k: (str(v) if k == "plan_family" else int(v))
+                    for k, v in geometry.items()
                     if k in GEOMETRY_KEYS and v is not None}
         if not geometry:
             raise AutotuneError(f"empty geometry for {sig!r}")
@@ -416,6 +431,31 @@ def chunk_lanes_for(rt, q=None, default: int = 64) -> int:
         if g is not None and g.chunk_lanes is not None:
             return g.chunk_lanes
     return default
+
+
+def pattern_family_for(rt, q=None) -> Optional[str]:
+    """Requested pattern execution family (seq|chunk|scan|dfa), or None
+    for automatic selection: `@app:patternFamily` wins, then the tuning
+    cache's persisted winner.  The plan only honors a family its
+    eligibility analysis proved sound (DevicePatternPlan.families) —
+    an ineligible request falls back with a warning, never silently
+    changes semantics."""
+    an = ast.find_annotation(rt.app.annotations, "app:patternFamily")
+    if an is not None:
+        fam = str(an.element()).lower()
+        if fam in ("auto", ""):
+            return None
+        if fam not in PATTERN_FAMILIES:
+            raise AutotuneError(
+                f"@app:patternFamily({fam!r}): unknown family "
+                f"(have {PATTERN_FAMILIES} or 'auto')")
+        return fam
+    tn = getattr(rt, "tuner", None)
+    if tn is not None and q is not None:
+        g = tn.lookup("pattern", q)
+        if g is not None and g.plan_family is not None:
+            return g.plan_family
+    return None
 
 
 def fused_lane_pack_for(rt, group_sig) -> int:
@@ -628,14 +668,17 @@ class Autotuner:
 
     # -- grid ------------------------------------------------------------
 
-    def default_grid(self, n_events: int, chunk_lanes=None) -> list:
+    def default_grid(self, n_events: int, chunk_lanes=None,
+                     plan_families=None) -> list:
         batches = [b for b in self.DEFAULT_BATCHES if b <= max(256,
                                                                n_events)]
         batches = batches or [min(2048, n_events)]
         lanes = list(chunk_lanes) if chunk_lanes else [None]
-        return [Geometry(batch=b, pipeline_depth=d, chunk_lanes=k)
+        fams = list(plan_families) if plan_families else [None]
+        return [Geometry(batch=b, pipeline_depth=d, chunk_lanes=k,
+                         plan_family=f)
                 for b in batches for d in self.DEFAULT_DEPTHS
-                for k in lanes]
+                for k in lanes for f in fams]
 
     # -- sweep -----------------------------------------------------------
 
@@ -644,6 +687,7 @@ class Autotuner:
              slo_ms: Optional[float] = None, warm_events: int = 2048,
              persist: bool = True, force: bool = False,
              out_streams: Optional[tuple] = None,
+             plan_families: Optional[tuple] = None,
              log: Optional[Callable] = None) -> dict:
         """Sweep `grid` (or the bounded default) over `app_text`.
 
@@ -664,7 +708,7 @@ class Autotuner:
                         "score": ent.get("score")}
 
         grid = list(grid) if grid is not None else \
-            self.default_grid(n_events)
+            self.default_grid(n_events, plan_families=plan_families)
         if not grid:
             raise AutotuneError("empty candidate grid")
         results = []
@@ -730,6 +774,8 @@ class Autotuner:
                 geo = {"batch": g.batch, "pipeline_depth": g.pipeline_depth}
                 if fam == "pattern" and g.chunk_lanes is not None:
                     geo["chunk_lanes"] = g.chunk_lanes
+                if fam == "pattern" and g.plan_family is not None:
+                    geo["plan_family"] = g.plan_family
                 if fam == "multi_query" and g.lane_pack is not None:
                     geo["lane_pack"] = g.lane_pack
                 keys.append(self.cache.put(sig, geo, family=fam,
@@ -754,7 +800,8 @@ class Autotuner:
                 rg = getattr(plan, "regeometry", None)
                 if rg is not None:
                     rg(batch_hint=g.batch, depth=g.pipeline_depth,
-                       chunk_lanes=g.chunk_lanes)
+                       chunk_lanes=g.chunk_lanes,
+                       plan_family=g.plan_family)
             rt.enable_stats(True)
             if out_streams is None:
                 # every insert-into stream target — from the AST, not the

@@ -41,6 +41,20 @@ def build_app(rt) -> None:
 def _build_app_scoped(rt) -> None:
     from .table import InMemoryTable, TableError
 
+    # `@app:patternFamily` names a pattern-kernel execution family
+    # (seq | chunk | scan | dfa | auto — docs/PERFORMANCE.md "Plan
+    # families").  Validate the NAME once here, loudly, so a typo is a
+    # PlanError on EVERY path (scoped, partitioned, and fused pattern
+    # plans) and never silently falls back to auto selection.  Whether
+    # the family is *eligible* for a given chain is decided later by
+    # each plan's eligibility analysis (ineligible -> warn + sound
+    # fallback).
+    from .autotune import AutotuneError, pattern_family_for
+    try:
+        pattern_family_for(rt)
+    except AutotuneError as e:
+        raise PlanError(str(e)) from None
+
     app = rt.app
     for tid, td in app.table_definitions.items():
         if tid in rt.schemas:

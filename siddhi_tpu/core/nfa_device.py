@@ -128,6 +128,9 @@ class PNode:
     waiting_ms: Optional[int]       # absent `for T`
     pre_conjs: list = field(default_factory=list)   # event-only -> (T,P)
     step_conjs: list = field(default_factory=list)  # capture-referencing
+    step_asts: list = field(default_factory=list)   # raw AST per step conj
+    #   (parallel to step_conjs; nfa_parallel lowers monotone comparisons
+    #   over earlier captures into segment-tree threshold hops)
     pre_key: Optional[str] = None   # xs key of the precomputed mask
 
 
@@ -348,6 +351,7 @@ def lower_chain(state_input, schemas_by_stream: dict, strings: StringTable,
                     raise DeviceNFAUnsupported(
                         "head filter references later captures")
                 pn.step_conjs.append(ce)
+                pn.step_asts.append(c)
     return spec
 
 

@@ -1,0 +1,639 @@
+"""Parallel-in-time NFA plan families: associative-scan (SFA) + DFA/hybrid.
+
+The sequential device kernel (nfa_device.NFAKernel) walks one event per
+`lax.scan` step per lane: throughput is bounded by the T-long dependency
+chain, not by math (BENCH_r05: ~0.01-0.02x the single-thread C++ roofline
+on the P=1 pattern configs).  *Simultaneous Finite Automata* (arXiv
+1405.0562) breaks that chain: simulate the automaton from EVERY state,
+compose per-event transition functions associatively, and the whole
+block collapses to log-depth scans.  For the linear chains this module
+accepts, the composed transition function factorizes — "the earliest
+completion reachable from state k at time t" is fully determined by
+per-position *next-match pointers*, so the SFA composition lowers to:
+
+  * a reverse `jax.lax.associative_scan` (min semiring) per position for
+    statically-maskable transitions (the per-event predicate matrix is
+    precomputed outside the scan, exactly like the sequential kernel's
+    pre-masks), and
+  * a vectorized segment-tree descent for *threshold* transitions —
+    capture-dependent filters of the monotone comparison form
+    `attr > f(earlier captures)` (the BENCH config-3/4 shape
+    `e2.price > e1.price`), answered as "first index >= s whose masked
+    value beats v" in O(log T) gathers per hop, batched over every
+    pending instance at once.
+
+Two plan families are built on these primitives:
+
+  * family "scan" — the SFA lowering above, O(S log T) depth.
+  * family "dfa"  — NFA->DFA/hybrid lowering (arXiv 2210.10077) with
+    state-set compaction and bit-packed transitions: the per-event
+    position masks pack into one u32 *symbol word* (bit k = event
+    matches position k), blocks of STRIDE=4 events precompose into
+    dense per-block transition tables (first-hit offsets for all
+    positions bit-packed into one u32 per block), and the block-level
+    next pointers ride ONE associative scan over T/4 elements — a
+    multi-stride dense table walk instead of per-event stepping
+    (cf. 2209.05686, CAMA 2112.00267).  Threshold hops share the
+    segment-tree machinery (the "hybrid" part).
+
+Eligibility (classify_parallel) is strict and *sound*: anything outside
+the supported algebra reports a reason string and the planner keeps the
+sequential kernel (or the chunked-halo mode) — the families never guess.
+Match semantics of the eligible class (every-head linear chains of
+(1,1) stream positions, within-bounded): each head-matching event arms
+one instance; an instance at position k advances on the FIRST later
+event matching position k (the slot is then consumed), expiring instead
+when that event arrives past the position's `within` horizon.  The
+next-pointer chase reproduces exactly that — one candidate completion
+per head — so outputs are byte-identical to the sequential kernel and
+the host oracle (asserted by tests/test_plan_families.py).
+
+Cross-flush continuity reuses the chunked-halo harness in
+pattern_plan.py: blocks are stateless, the last `within` window of
+events replays at the next flush, and completions at or before the
+previous flush's last seq are suppressed on device.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..query import ast
+from .expr import (ExprError, compile_expression, compute_dtypes)
+from .nfa_device import (ChainSpec, NFAKernel, _hi32, _lo32, _I32,
+                         pow2_at_least)
+
+STRIDE = 4                # dfa family: events per precomposed transition
+_OFF_BITS = 3             # bits per packed first-hit offset (0..STRIDE)
+NUMERIC = (ast.AttrType.INT, ast.AttrType.LONG,
+           ast.AttrType.FLOAT, ast.AttrType.DOUBLE)
+
+
+class ParallelUnsupported(Exception):
+    """Chain shape outside the parallel families' sound subset."""
+
+
+@dataclass
+class HopThreshold:
+    """One monotone capture-dependent conjunct: own_col OP rhs(captures)."""
+    own_key: str                  # "e2.price" — the arriving event's column
+    op: str                       # "gt" | "ge" | "lt" | "le"
+    rhs: object                   # CompiledExpr over earlier-ref captures
+    own_type: ast.AttrType = ast.AttrType.DOUBLE
+
+
+@dataclass
+class Hop:
+    """One chain position lowered for the pointer chase."""
+    ref: str
+    scode: int
+    within_ms: Optional[int]
+    pre_conjs: list = field(default_factory=list)   # CompiledExpr, event-only
+    threshold: Optional[HopThreshold] = None
+
+    @property
+    def is_static(self) -> bool:
+        return self.threshold is None
+
+
+@dataclass
+class ParallelProgram:
+    hops: list                    # [Hop], index = chain position
+    stream_ids: list
+    schemas: dict                 # ref -> StreamSchema
+    ref_pos: dict                 # ref -> position index
+
+    @property
+    def S(self) -> int:
+        return len(self.hops)
+
+
+_FLIP = {"gt": "lt", "ge": "le", "lt": "gt", "le": "ge"}
+_OPN = {ast.CompareOp.GT: "gt", ast.CompareOp.GE: "ge",
+        ast.CompareOp.LT: "lt", ast.CompareOp.LE: "le"}
+
+
+def _own_var(e, node, schemas) -> Optional[str]:
+    """Attr name when `e` is a plain Variable over the node's OWN event
+    (qualified with its ref, or unqualified resolving to its schema —
+    PatternFilterContext resolution order), else None."""
+    if not isinstance(e, ast.Variable) or e.index is not None:
+        return None
+    if e.stream_ref == node.ref:
+        return e.attribute
+    if e.stream_ref is None and e.attribute in schemas[node.ref].types:
+        return e.attribute
+    return None
+
+
+def lower_parallel(spec: ChainSpec, strings,
+                   param_extra: Optional[dict] = None) -> ParallelProgram:
+    """Lower a ChainSpec into a pointer-chase program, or raise
+    ParallelUnsupported with the (human-readable) ineligibility reason.
+    The accepted algebra is the provably-equivalent subset: every-head
+    linear chains of single (1,1) stream positions, within-bounded, with
+    event-only filters plus at most one monotone threshold conjunct per
+    non-head position."""
+    if spec.is_sequence:
+        raise ParallelUnsupported("strict sequence (`,` succession)")
+    if not spec.every_head:
+        raise ParallelUnsupported("non-`every` head (single stateful arm)")
+    if spec.S < 2:
+        raise ParallelUnsupported("single-position chain (no scan depth)")
+    hops: list = []
+    ref_pos: dict = {}
+    for pi, pos in enumerate(spec.positions):
+        if pos.op is not None:
+            raise ParallelUnsupported("logical and/or position")
+        if pos.is_count:
+            raise ParallelUnsupported("count quantifier <m:n>")
+        n = pos.nodes[0]
+        if n.kind != "stream":
+            raise ParallelUnsupported("absent (`not ... for`) position")
+        if pos.sticky and pi > 0:
+            raise ParallelUnsupported("`every` below the head")
+        if pos.within_ms is None:
+            raise ParallelUnsupported(
+                "position without a `within` bound (stateless tail replay "
+                "needs a finite horizon)")
+        hop = Hop(n.ref, n.scode, pos.within_ms, list(n.pre_conjs))
+        if n.step_conjs:
+            if pi == 0:
+                raise ParallelUnsupported("head filter reads captures")
+            if len(n.step_conjs) > 1:
+                raise ParallelUnsupported(
+                    "multiple capture-dependent conjuncts on one position "
+                    "(first-match of a conjunction is not decomposable)")
+            hop.threshold = _lower_threshold(
+                n, n.step_asts[0], spec, strings, param_extra, ref_pos)
+        hops.append(hop)
+        ref_pos[n.ref] = pi
+    return ParallelProgram(hops, list(spec.stream_ids), dict(spec.schemas),
+                           ref_pos)
+
+
+def _lower_threshold(node, cond, spec, strings, param_extra,
+                     ref_pos) -> HopThreshold:
+    """`own.attr OP expr(earlier captures)` -> HopThreshold, else raise."""
+    from .nfa_device import PatternFilterContext
+    if not isinstance(cond, ast.Compare) or cond.op not in _OPN:
+        raise ParallelUnsupported(
+            "capture-dependent filter is not a <,<=,>,>= comparison")
+    own_l = _own_var(cond.left, node, spec.schemas)
+    own_r = _own_var(cond.right, node, spec.schemas)
+    if (own_l is None) == (own_r is None):
+        raise ParallelUnsupported(
+            "comparison must have the arriving event's attribute on "
+            "exactly one side")
+    attr = own_l if own_l is not None else own_r
+    op = _OPN[cond.op] if own_l is not None else _FLIP[_OPN[cond.op]]
+    own_t = spec.schemas[node.ref].type_of(attr)
+    if own_t not in NUMERIC:
+        raise ParallelUnsupported(
+            f"threshold attribute {attr!r} is not numeric")
+    rhs_ast = cond.right if own_l is not None else cond.left
+    ctx = PatternFilterContext(spec.schemas, strings, node.ref)
+    if param_extra:
+        ctx.extra = dict(param_extra)
+    try:
+        rhs = compile_expression(rhs_ast, ctx)
+    except ExprError as e:
+        raise ParallelUnsupported(f"threshold rhs not compilable: {e}")
+    if rhs.type not in NUMERIC:
+        raise ParallelUnsupported("threshold rhs is not numeric")
+    ok_reads = set()
+    for r, pi in ref_pos.items():
+        for a in spec.schemas[r].attributes:
+            ok_reads.add(f"{r}.{a.name}")
+    bad = set(rhs.reads) - ok_reads
+    if bad:
+        raise ParallelUnsupported(
+            f"threshold rhs reads non-capture keys {sorted(bad)!r} "
+            f"(own event / timestamp / later positions)")
+    return HopThreshold(f"{node.ref}.{attr}", op, rhs, own_t)
+
+
+def classify_parallel(spec: ChainSpec, kernel: NFAKernel, strings,
+                      param_extra: Optional[dict] = None) -> dict:
+    """{'scan': True|reason, 'dfa': True|reason} for one lowered chain.
+    A True value means the family is sound for this ChainSpec; a string
+    is the ineligibility reason (surfaced in statistics() and asserted
+    by the forced-fallback tests)."""
+    try:
+        prog = lower_parallel(spec, strings, param_extra)
+        if kernel.params or kernel.emit_qid:
+            raise ParallelUnsupported("per-lane query parameters "
+                                      "(fused multi-query kernel)")
+        for ce in (list(kernel.sel_fns.values())
+                   + ([kernel.having] if kernel.having else [])):
+            for k in ce.reads:
+                if "." in k and "[" in k.split(".", 1)[0]:
+                    raise ParallelUnsupported(
+                        f"indexed capture read {k!r} in selector/having")
+    except ParallelUnsupported as e:
+        return {"scan": str(e), "dfa": str(e)}
+    out = {"scan": True}
+    if prog.S > 8:
+        out["dfa"] = ("more than 8 positions (symbol words bit-pack one "
+                      "position per u32 lane bit)")
+    elif not any(h.is_static for h in prog.hops[1:]):
+        out["dfa"] = ("no static transition to bit-pack (every hop is "
+                      "threshold-dependent)")
+    else:
+        out["dfa"] = True
+    return out
+
+
+# ---------------------------------------------------------------------------
+# vectorized "first index >= s with masked value OP v" primitives
+# ---------------------------------------------------------------------------
+
+def _sentinel(dt, agg: str):
+    if jnp.issubdtype(dt, jnp.floating):
+        return jnp.array(-jnp.inf if agg == "max" else jnp.inf, dt)
+    info = jnp.iinfo(dt)
+    return jnp.array(info.min if agg == "max" else info.max, dt)
+
+
+def _tree_dtype(own_dt, rhs_dt):
+    """Dtype the threshold tree aggregates (and compares) in: the
+    promotion of both comparison sides, with int32 widened to int64 so
+    the sentinel sits strictly OUTSIDE the value range — `>=`/`<=` hit
+    checks must never be satisfiable by a masked-out leaf (an int32
+    column whose rhs equals INT32_MIN would otherwise match them).
+    Mixed int/float comparisons promote to the float side, whose ±inf
+    sentinels are strictly outside every value, and whose rounding then
+    matches the sequential kernel's own promoted per-event compare."""
+    dt = jnp.promote_types(own_dt, rhs_dt)
+    if dt == jnp.int32:
+        return jnp.dtype(jnp.int64)
+    return dt
+
+
+def _build_heap(vals, mask, L: int, agg: str, dt):
+    """Perfect binary segment tree in heap layout (1-based; leaves at
+    [L, 2L)).  Built with log2(L) vectorized reductions — the SFA
+    transition-composition tree for threshold hops.  Masked-out and NaN
+    leaves are replaced by the sentinel BEFORE aggregation: the
+    sequential kernel evaluates the predicate per event (NaN compares
+    False there), while jnp.maximum/minimum would propagate a NaN to
+    every ancestor and poison whole subtrees."""
+    sent = _sentinel(dt, agg)
+    keep = mask
+    if jnp.issubdtype(vals.dtype, jnp.floating):
+        keep = keep & ~jnp.isnan(vals)
+    vals = jnp.where(keep, vals.astype(dt), sent)
+    red = jnp.maximum if agg == "max" else jnp.minimum
+    lvl = jnp.full((L,), sent, dt).at[:vals.shape[0]].set(vals)
+    levels = [lvl]
+    while lvl.shape[0] > 1:
+        lvl = red(lvl[0::2], lvl[1::2])
+        levels.append(lvl)
+    # heap[1]=root ... heap[L:2L)=leaves; heap[0] unused (sentinel)
+    return jnp.concatenate([jnp.full((1,), sent, dt)]
+                           + [lv for lv in reversed(levels)])
+
+
+def _first_hit(heap, L: int, s, v, op: str):
+    """First leaf index >= s whose value satisfies OP v; L when none.
+    Vectorized over query arrays s, v; 2*log2(L) gather rounds total
+    (up-walk decomposing [s, L) into aligned blocks visited left to
+    right, then a descent into the first qualifying subtree).
+
+    Hit checks are sentinel-safe: `>=`/`<=` rewrite to strict compares
+    against the adjacent representable value in the tree dtype (exact —
+    int32 trees are widened, floats use nextafter; an infinite rhs
+    meeting infinite data, or an int64 rhs of exactly INT64_MIN, are
+    the accepted pathological corners)."""
+    va = jnp.asarray(v, heap.dtype)
+    if op == "ge":
+        v = jnp.nextafter(va, jnp.array(-jnp.inf, heap.dtype)) \
+            if jnp.issubdtype(heap.dtype, jnp.floating) else va - 1
+        op = "gt"
+    elif op == "le":
+        v = jnp.nextafter(va, jnp.array(jnp.inf, heap.dtype)) \
+            if jnp.issubdtype(heap.dtype, jnp.floating) else va + 1
+        op = "lt"
+    else:
+        v = va
+    cmp = {"gt": lambda a, b: a > b,
+           "lt": lambda a, b: a < b}[op]
+    P = max(L.bit_length() - 1, 0)
+
+    # fori_loop (not an unrolled python loop): the round count is static
+    # but the body is identical each round, and unrolling 2*log2(L)
+    # gather rounds made the XLA program ~4x slower to COMPILE — which
+    # dominates small deployments (every pattern test runtime pays it)
+    def up(i, st):
+        l, found, fnode = st
+        r = jnp.int32(2 * L) >> i
+        odd = (l & 1) == 1
+        nv = heap[jnp.clip(l, 0, 2 * L - 1)]
+        take = odd & (l < r) & cmp(nv, v) & ~found
+        fnode = jnp.where(take, l, fnode)
+        found = found | take
+        return ((l + odd.astype(_I32)) >> 1, found, fnode)
+
+    l0 = (jnp.clip(s, 0, L) + L).astype(_I32)
+    _l, found, fnode = lax.fori_loop(
+        0, P + 1, up, (l0, jnp.zeros(l0.shape, bool),
+                       jnp.zeros(l0.shape, _I32)))
+
+    def down(_i, fnode):
+        internal = found & (fnode < L)
+        left = 2 * fnode
+        lv = heap[jnp.clip(left, 0, 2 * L - 1)]
+        goleft = cmp(lv, v)
+        return jnp.where(internal,
+                         jnp.where(goleft, left, left + 1), fnode)
+
+    fnode = lax.fori_loop(0, P, down, fnode)
+    return jnp.where(found, fnode - L, L).astype(_I32)
+
+
+def _next_static_scan(mask, L: int):
+    """next[t] = first index >= t with mask set (L = none): ONE reverse
+    associative scan in the min semiring — the SFA composition of
+    per-event transition functions restricted to a static position."""
+    F = mask.shape[0]
+    idx = jnp.where(mask, jnp.arange(F, dtype=_I32), jnp.int32(L))
+    return lax.associative_scan(jnp.minimum, idx, reverse=True)
+
+
+# ---------------------------------------------------------------------------
+# the block kernel
+# ---------------------------------------------------------------------------
+
+class ParallelChainKernel:
+    """Stateless flat-block kernel for one lowered chain, in either the
+    "scan" (pure SFA) or "dfa" (bit-packed multi-stride hybrid) family.
+
+    Mirrors NFAKernel's packed-output contract exactly (meta row, valid
+    row under `having`, out_names/out_dtypes from the plan's NFAKernel)
+    so DevicePatternPlan._unpack_block consumes both interchangeably.
+    Blocks carry no device state: ev is the chunked-halo flat layout
+    (`__flat.*` arrays + `__nev__`/`__prev_seq__`/bases) minus the lane
+    geometry — the whole flush is ONE log-depth program."""
+
+    def __init__(self, prog: ParallelProgram, nfak: NFAKernel,
+                 family: str = "scan"):
+        assert family in ("scan", "dfa")
+        self.prog = prog
+        self.nfak = nfak              # selector/having/output metadata
+        self.family = family
+        self.f64 = nfak.f64
+        self._mode = nfak._mode
+        self._block_cache: dict = {}
+
+    # NFAKernel-compatible surface consumed by _call_block / bench
+    def block_fn(self, F: int, M: int):
+        key = (F, M)
+        fn = self._block_cache.get(key)
+        if fn is None:
+            fn = self._block_cache[key] = jax.jit(self._make_block(M))
+        return fn
+
+    def _make_block(self, M: int):
+        def block(state, ev):
+            with compute_dtypes(self._mode):
+                return state, self._block_impl(ev, M)
+        return block
+
+    # -- mask/env helpers -----------------------------------------------
+
+    def _flat_env(self, ev, hop: Hop, ts, base_ts) -> dict:
+        env = {}
+        for a in self.prog.schemas[hop.ref].attributes:
+            key = f"__flat.{hop.scode}.{a.name}"
+            if key in ev:
+                env[f"{hop.ref}.{a.name}"] = ev[key]
+        env["__timestamp__"] = base_ts + ts.astype(jnp.int64)
+        return env
+
+    def _hop_mask(self, ev, hop: Hop, ts, valid, base_ts):
+        m = valid
+        if len(self.prog.stream_ids) > 1:
+            m = m & (ev["__flat.__scode__"] == hop.scode)
+        if hop.pre_conjs:
+            env = self._flat_env(ev, hop, ts, base_ts)
+            for ce in hop.pre_conjs:
+                m = m & jnp.broadcast_to(ce.fn(env), m.shape)
+        return m
+
+    def _cap_env(self, ev, j_at: dict, keys, F: int, base_ts, comp_j=None):
+        """Capture env gathered at resolved hop indices: key "r.attr" ->
+        flat column at j_at[position(r)] (clipped; callers mask validity
+        downstream).  `keys` bounds the gathers to what's read."""
+        env = {}
+        for k in keys:
+            if k == "__timestamp__":
+                if comp_j is not None:
+                    env[k] = base_ts + ev["__flat.__ts__"][comp_j] \
+                        .astype(jnp.int64)
+                continue
+            if "." not in k or k.startswith("__"):
+                continue
+            refpart, attr = k.split(".", 1)
+            base = refpart.split("[", 1)[0]
+            pi = self.prog.ref_pos.get(base)
+            if pi is None:
+                continue
+            scode = self.prog.hops[pi].scode
+            col = ev.get(f"__flat.{scode}.{attr}")
+            if col is None:
+                continue
+            env[k] = col[jnp.clip(j_at[pi], 0, F - 1)]
+        return env
+
+    # -- dfa family: bit-packed multi-stride static tables ----------------
+
+    def _dfa_tables(self, masks, F: int, L: int):
+        """Precompose per-event symbol words into stride-4 block tables.
+        Returns (suffix_flat per static hop, packed first-offset words,
+        block-level next pointers per static hop, NB)."""
+        B = STRIDE
+        NB = -(-F // B)
+        Fp = NB * B
+        static = [k for k in range(1, self.prog.S)
+                  if self.prog.hops[k].is_static]
+        # ONE u32 symbol word per event: bit k = matches position k
+        sym = jnp.zeros((Fp,), jnp.uint32)
+        for k in static:
+            mk = jnp.zeros((Fp,), bool).at[:F].set(masks[k])
+            sym = sym | (mk.astype(jnp.uint32) << np.uint32(k))
+        o = jnp.arange(B, dtype=_I32)[None, :]
+        suffix = {}
+        first = {}
+        for k in static:
+            bits = ((sym.reshape(NB, B) >> np.uint32(k)) & 1) != 0
+            offs = jnp.where(bits, o, jnp.int32(B))
+            # in-block suffix-first offsets (stride-4: 3 dense mins)
+            acc = offs[:, B - 1]
+            cols = [acc]
+            for c in range(B - 2, -1, -1):
+                acc = jnp.minimum(offs[:, c], acc)
+                cols.append(acc)
+            suf = jnp.stack(list(reversed(cols)), axis=1)   # (NB, B)
+            suffix[k] = suf.reshape(-1)
+            first[k] = suf[:, 0]
+        # per-block transition table: first-hit offsets for ALL static
+        # positions bit-packed into one u32 word per block
+        packed = jnp.zeros((NB,), jnp.uint32)
+        for k in static:
+            packed = packed | (first[k].astype(jnp.uint32)
+                               << np.uint32(_OFF_BITS * k))
+        # block-level next pointers: one associative scan over F/4
+        # elements per static position (stacked -> a single scan call)
+        if static:
+            blk = jnp.stack(
+                [jnp.where(first[k] < B,
+                           jnp.arange(NB, dtype=_I32), jnp.int32(NB))
+                 for k in static], axis=1)
+            nblk = lax.associative_scan(jnp.minimum, blk, reverse=True,
+                                        axis=0)
+            nblk = {k: nblk[:, i] for i, k in enumerate(static)}
+        else:
+            nblk = {}
+        return suffix, packed, nblk, NB
+
+    def _dfa_next(self, k: int, s, suffix, packed, nblk, NB: int, L: int):
+        """Multi-stride lookup: in-block suffix table, then the packed
+        block-transition word of the next block containing a hit."""
+        B = STRIDE
+        Fp = NB * B
+        sc = jnp.clip(s, 0, Fp - 1)
+        inb = suffix[k][sc]                      # first o >= s%B in block
+        b = sc >> 2
+        j_in = (b << 2) + inb
+        b2 = nblk[k][jnp.clip(b + 1, 0, NB - 1)]
+        ok2 = (b + 1 < NB) & (b2 < NB)
+        f2 = ((packed[jnp.clip(b2, 0, NB - 1)]
+               >> (jnp.uint32(_OFF_BITS * k))) & jnp.uint32(7)).astype(_I32)
+        j_blk = (b2 << 2) + f2
+        j = jnp.where(inb < B, j_in, jnp.where(ok2, j_blk, jnp.int32(L)))
+        return jnp.where(s < Fp, j, jnp.int32(L)).astype(_I32)
+
+    # -- the block --------------------------------------------------------
+
+    def _block_impl(self, ev, M: int):
+        prog, nfak = self.prog, self.nfak
+        S = prog.S
+        F = ev["__flat.__ts__"].shape[0]
+        L = pow2_at_least(F, lo=2)
+        nev = ev["__nev__"].astype(_I32)
+        prev_seq = ev["__prev_seq__"]
+        base_ts = ev["__base_ts__"]
+        ts = ev["__flat.__ts__"]
+        # scan/dfa flushes always ship the explicit seq array (output
+        # events consume global seqs, so derived-consecutive seqs would
+        # force a second structural compile at flush 2)
+        seq = ev["__flat.__seq__"]
+        valid = jnp.arange(F, dtype=_I32) < nev
+        masks = [self._hop_mask(ev, h, ts, valid, base_ts)
+                 for h in prog.hops]
+
+        if self.family == "dfa":
+            suffix, packed, nblk, NB = self._dfa_tables(masks, F, L)
+
+        # expiry heap: the sequential kernel expires a waiting instance
+        # on the FIRST arriving event whose age exceeds the position's
+        # `within` horizon — matching or not (nfa_device._step computes
+        # `expired` before the match mask, over timey=valid).  With
+        # out-of-order timestamps a later event can carry a REGRESSED
+        # ts, so checking the matched event alone would resurrect
+        # instances the sequential kernel killed.  i64 aggregation:
+        # ts offsets reach ±2^30 and ts+W must not wrap i32.
+        ts_heap = _build_heap(ts, valid, L, "max", jnp.dtype(jnp.int64))
+        ts64 = ts.astype(jnp.int64)
+
+        # pointer chase: every event index is a candidate head
+        j0 = jnp.arange(F, dtype=_I32)
+        ok = masks[0]
+        j_at = {0: j0}
+        j = j0
+        for k in range(1, S):
+            hop = prog.hops[k]
+            s = j + 1
+            if hop.is_static:
+                if self.family == "dfa":
+                    jn = self._dfa_next(k, s, suffix, packed, nblk, NB, L)
+                else:
+                    nxt = _next_static_scan(masks[k], L)
+                    jn = jnp.where(s < F, nxt[jnp.clip(s, 0, F - 1)],
+                                   jnp.int32(L))
+            else:
+                th = hop.threshold
+                agg = "max" if th.op in ("gt", "ge") else "min"
+                own = ev[f"__flat.{hop.scode}.{th.own_key.split('.', 1)[1]}"]
+                env = self._cap_env(ev, j_at, th.rhs.reads, F, base_ts)
+                v = jnp.broadcast_to(th.rhs.fn(env), (F,))
+                dt = _tree_dtype(own.dtype, v.dtype)
+                heap = _build_heap(own, masks[k], L, agg, dt)
+                jn = _first_hit(heap, L, s, v, th.op)
+            ok = ok & (jn < F)
+            js = jnp.clip(jn, 0, F - 1)
+            # the hop survives iff the match arrives BEFORE the first
+            # event that would expire the waiting instance (ts > head_ts
+            # + W_k); this also subsumes the matched event's own age
+            # check (a killer has ts strictly past the horizon)
+            killer = _first_hit(ts_heap, L, s,
+                                ts64 + jnp.int64(hop.within_ms), "gt")
+            ok = ok & (jn < killer)
+            j_at[k] = js
+            j = js
+        comp_j = j_at[S - 1]
+        lv = ok & (seq[comp_j] > prev_seq.astype(_I32))
+
+        # compaction: one cumsum + one scatter per column (NFAKernel's
+        # flat-buffer layout; M overflow re-runs with a bigger buffer)
+        pos = jnp.cumsum(lv.astype(_I32), dtype=_I32) - lv
+        n = pos[-1] + lv[-1]
+        wpos = jnp.where(lv & (pos < M), pos, M)
+        jm = {k: jnp.zeros((M,), _I32).at[wpos].set(v, mode="drop")
+              for k, v in j_at.items()}
+
+        # selector env over compacted capture gathers
+        need = set()
+        for ce in list(nfak.sel_fns.values()) \
+                + ([nfak.having] if nfak.having else []):
+            need.update(ce.reads)
+        env = self._cap_env(ev, jm, need, F, base_ts,
+                            comp_j=jm[S - 1])
+        sel = {name: jnp.broadcast_to(ce.fn(env), (M,))
+               for name, ce in nfak.sel_fns.items()}
+        mvalid = jnp.arange(1, M + 1, dtype=_I32) <= n
+        if nfak.having is not None:
+            henv = dict(env)
+            henv.update(sel)
+            mvalid = mvalid & jnp.broadcast_to(nfak.having.fn(henv), (M,))
+        sel["__timestamp__"] = ts[jm[S - 1]]
+        sel["__seq__"] = seq[jm[S - 1]]
+        sel["__head_seq__"] = seq[jm[0]]
+
+        NO_DL = jnp.int32(2 ** 31 - 1)
+        meta = (jnp.zeros((M,), _I32)
+                .at[0].set(n).at[3].set(NO_DL))
+        irows = [meta]
+        if nfak.having is not None:
+            irows.append(mvalid.astype(_I32))
+        frows = []
+        for name in nfak.out_names:
+            col = sel[name]
+            if col.dtype == jnp.float64:
+                frows.append(col)
+            elif col.dtype == jnp.float32:
+                irows.append(lax.bitcast_convert_type(col, _I32))
+            elif col.dtype == jnp.int64:
+                irows.append(_hi32(col))
+                irows.append(_lo32(col))
+            else:
+                irows.append(col.astype(_I32))
+        out = {"i": jnp.stack(irows, axis=0)}
+        if frows:
+            out["f"] = jnp.stack(frows, axis=0)
+        return out

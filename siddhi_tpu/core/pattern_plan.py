@@ -174,45 +174,68 @@ class DevicePatternPlan(QueryPlan):
         self._buffered: list = []   # (stream_id, EventBatch)
         self._scode = {sid: i for i, sid in enumerate(self.spec.stream_ids)}
 
-        # chunked-halo mode: a within-bounded every-head pattern with no
-        # partition key has P=1, which starves the lane axis (the scan is
-        # fully sequential).  Because every pending instance dies within W
-        # of its head, the event sequence can be split into K own-chunks
-        # processed by K parallel lanes, each reading through a halo of
-        # the events within W after its chunk; heads only arm on OWNED
-        # events (`__can_start__`), so every match is found exactly once.
-        # Cross-flush continuity: the last W of events replays at the next
-        # flush, and completions at or before the previous flush's last
-        # seq are dropped (they already emitted).  Blocks are stateless —
-        # device state never persists, so there is nothing to rebase.
+        # ---- plan-family selection (docs/PERFORMANCE.md "Plan families").
+        # A within-bounded every-head pattern with no partition key can run
+        # STATELESS: every pending instance dies within W of its head, so
+        # blocks replay the last W of events at the next flush and drop
+        # completions at or before the previous flush's last seq.  Three
+        # stateless execution families share that harness:
+        #   chunk — split each flush into K own-chunks scanned by K
+        #           parallel lanes with halo reads (sequential-in-T per
+        #           lane; `__can_start__` keeps matches exactly-once);
+        #   scan  — associative-scan SFA lowering (nfa_parallel.py):
+        #           whole-flush next-pointer composition, O(log T) depth;
+        #   dfa   — bit-packed multi-stride hybrid lowering: u32 symbol
+        #           words + stride-4 precomposed block tables.
+        # Eligibility analysis picks the cheapest sound family; the
+        # sequential kernel ("seq") is the universal fallback, and the
+        # autotuner sweeps the family as a geometry axis (@app:patternFamily
+        # / tuning-cache `plan_family` force one explicitly).
         self._chunk_cfg = None
-        if (not broadcast_events and part_key_fns is None
-                and partitions == 1
-                and getattr(rt, "_async_workers", 1) == 1
-                and self.spec.every_head and not self.kernel.has_absent
-                and not self.spec.needs_init_slot
-                and all(p.within_ms is not None for p in self.spec.positions)):
-            from .autotune import chunk_lanes_for, pipeline_depth_for
-            lanes = chunk_lanes_for(rt, q)
-            if lanes > 1:
-                self._chunk_cfg = {
-                    "W": max(p.within_ms for p in self.spec.positions),
-                    "lanes": lanes}
-                self._tail: Optional[dict] = None   # replayed raw events
-                self._prev_last_seq = -1
-                self._chunk_A = slots
-                self._chunk_E: Optional[int] = None
-                self._kern_by_p: dict = {}
-                self._of_dropped = 0
-                self.pipeline_depth = pipeline_depth_for(rt, "pattern", q)
-                from .pipeline import DispatchPipeline
-                self._pipe = DispatchPipeline(
-                    name, lambda e: [self._materialize_chunk(e)],
-                    depth=self.pipeline_depth)
-                # chunked blocks are stateless on device and finalize
-                # rolls its host bookkeeping back on failure — the
-                # degradation ladder may halve and retry the flush
-                self.retryable_finalize = True
+        self._tail: Optional[dict] = None       # replayed raw events
+        self._prev_last_seq = -1
+        self._chunk_A = slots
+        self._chunk_E: Optional[int] = None
+        self._kern_by_p: dict = {}
+        self._par_kerns: dict = {}              # family -> kernel
+        self._of_dropped = 0
+        self._family_dispatches: dict = {}
+        self.family = "seq"
+        base = True
+        if broadcast_events:
+            base = "fused multi-query lane kernel"
+        elif part_key_fns is not None or partitions != 1:
+            base = "partitioned (persistent per-key lane state)"
+        elif getattr(rt, "_async_workers", 1) != 1:
+            base = "async ingest workers (flush order not deterministic)"
+        elif not self.spec.every_head:
+            base = "non-`every` head (single stateful arm)"
+        elif self.kernel.has_absent or self.spec.needs_init_slot:
+            base = "absent state (timer-driven deadlines need device state)"
+        elif not all(p.within_ms is not None for p in self.spec.positions):
+            base = "position without a `within` bound"
+        self.families: dict = {"seq": True}
+        from .autotune import (chunk_lanes_for, pattern_family_for,
+                               pipeline_depth_for)
+        self._stateless_lanes = chunk_lanes_for(rt, q)
+        if base is True:
+            from .nfa_parallel import classify_parallel
+            self.families.update(classify_parallel(
+                self.spec, self.kernel, rt.strings, param_extra))
+            self.families["chunk"] = True if self._stateless_lanes > 1 \
+                else "chunk lanes <= 1 (@app:deviceChunkLanes)"
+            if self.mesh is not None:
+                for f in ("scan", "dfa"):
+                    if self.families[f] is True:
+                        self.families[f] = ("multi-device mesh (flat block "
+                                            "has no lane axis to shard)")
+        else:
+            self.families.update({"chunk": base, "scan": base, "dfa": base})
+        want = pattern_family_for(rt, q)
+        fam = self._choose_family(want)
+        if fam != "seq":
+            self.pipeline_depth = pipeline_depth_for(rt, "pattern", q)
+            self._enter_stateless(fam)
         # device grids shipped per block: only attrs some predicate or
         # capture row reads, per scode
         self._grid_attrs: list = sorted(self._needed_grid_attrs())
@@ -221,6 +244,31 @@ class DevicePatternPlan(QueryPlan):
         # fail here (-> sequential fallback) instead of at first flush
         dummy = self._dense_dummy(T=2)
         jax.eval_shape(self.kernel.block_fn(2, 8), self.state, dummy)
+        while self.family in ("scan", "dfa"):
+            # same guarantee for the parallel-in-time families: a lowering
+            # surprise demotes to the NEXT sound family at build (each
+            # candidate validated in turn), never at first flush
+            try:
+                jax.eval_shape(self._parallel_kernel().block_fn(8, 16),
+                               {}, self._flat_dummy(8))
+                break
+            except Exception as e:   # pragma: no cover - safety net
+                import warnings
+                self.families[self.family] = \
+                    f"build validation failed: {e}"
+                self._par_kerns.pop(self.family, None)
+                fam = self._choose_family(None)
+                warnings.warn(
+                    f"pattern {name!r}: plan family {self.family!r} failed "
+                    f"build validation ({e}); demoting to {fam!r}",
+                    RuntimeWarning, stacklevel=2)
+                if fam == "seq":
+                    self.family = "seq"
+                    self._chunk_cfg = None
+                    self._pipe = None
+                    self.retryable_finalize = False
+                else:
+                    self.family = fam
 
     # -- helpers -------------------------------------------------------------
 
@@ -268,6 +316,22 @@ class DevicePatternPlan(QueryPlan):
         if not self.f64 and t == ast.AttrType.DOUBLE:
             return np.float32
         return dtype_of(t)
+
+    def _flat_dummy(self, F: int) -> dict:
+        """Tiny flat-block ev (the scan/dfa families' input layout) for
+        build-time shape validation."""
+        import jax.numpy as jnp
+        ev = {"__flat.__ts__": jnp.zeros((F,), jnp.int32),
+              "__flat.__seq__": jnp.zeros((F,), jnp.int32),
+              "__nev__": jnp.zeros((), jnp.int32),
+              "__prev_seq__": jnp.zeros((), jnp.int32),
+              "__base_ts__": jnp.zeros((), jnp.int64),
+              "__base_seq__": jnp.zeros((), jnp.int64)}
+        if len(self.spec.stream_ids) > 1:
+            ev["__flat.__scode__"] = jnp.zeros((F,), jnp.int32)
+        for si, attr, t in self._grid_attrs:
+            ev[f"__flat.{si}.{attr}"] = jnp.zeros((F,), self._np_dtype(t))
+        return ev
 
     def _dense_dummy(self, T: int) -> dict:
         import jax.numpy as jnp
@@ -360,6 +424,97 @@ class DevicePatternPlan(QueryPlan):
                                 emit_qid=self.kernel.emit_qid,
                                 init_on_tick=self._init_on_tick)
 
+    # -- plan families ---------------------------------------------------
+
+    # auto-selection preference: cheapest sound family first, measured —
+    # the associative-scan lowering beats the bit-packed multi-stride
+    # tables on the shipping backends (bench kernel_eps_by_family:
+    # static chain, scan ~3.4M eps vs dfa ~2.9M vs chunk ~57k on
+    # CPU), and both beat K sequential chunk lanes everywhere; "seq" is
+    # the universal fallback.  The autotuner's plan_family knob overrides
+    # per app when a sweep finds otherwise on a given device.
+    FAMILY_ORDER = ("scan", "dfa", "chunk")
+
+    def _choose_family(self, want: Optional[str]) -> str:
+        if want is not None:
+            if want == "seq" or self.families.get(want) is True:
+                return want
+            import warnings
+            warnings.warn(
+                f"pattern {self.name!r}: requested plan family {want!r} is "
+                f"not eligible ({self.families.get(want)}); falling back to "
+                f"automatic selection", RuntimeWarning, stacklevel=2)
+        for f in self.FAMILY_ORDER:
+            if self.families.get(f) is True:
+                return f
+        return "seq"
+
+    def _enter_stateless(self, fam: str) -> None:
+        """Engage a stateless family (chunk/scan/dfa): blocks carry no
+        device state, cross-flush continuity = tail replay + seq dedup,
+        and finalize rolls its bookkeeping back on failure so the
+        degradation ladder may halve and retry the flush."""
+        self.family = fam
+        if self._chunk_cfg is None:
+            self._chunk_cfg = {
+                "W": max(p.within_ms for p in self.spec.positions),
+                "lanes": max(2, self._stateless_lanes)}
+        if self._pipe is None:
+            from .pipeline import DispatchPipeline
+            self._pipe = DispatchPipeline(
+                self.name, lambda e: [self._materialize_chunk(e)],
+                depth=self.pipeline_depth)
+        self.retryable_finalize = True
+
+    def _set_family(self, fam: str) -> None:
+        """Adaptive-geometry family switch (autotuner / regeometry).
+        Stateless<->stateless moves are flush-boundary output-invariant
+        (all three share the tail/dedup bookkeeping); seq<->stateless
+        switches only before the plan has touched data (the persistent
+        slot state and the replay tail don't interconvert)."""
+        import warnings
+        if fam == self.family:
+            return
+        if fam != "seq" and self.families.get(fam) is not True:
+            warnings.warn(
+                f"pattern {self.name!r}: plan family {fam!r} not eligible "
+                f"({self.families.get(fam)}); keeping {self.family!r}",
+                RuntimeWarning, stacklevel=2)
+            return
+        stateless = ("chunk", "scan", "dfa")
+        if self.family in stateless and fam in stateless:
+            self.family = fam
+            return
+        if self._ts_base is None and self._tail is None \
+                and not self._buffered:
+            if fam == "seq":
+                self.family = "seq"
+                self._chunk_cfg = None
+                self._pipe = None
+                self.retryable_finalize = False
+            else:
+                self._enter_stateless(fam)
+            return
+        warnings.warn(
+            f"pattern {self.name!r}: cannot switch plan family "
+            f"{self.family!r} -> {fam!r} mid-stream (device state and the "
+            f"replay tail do not interconvert)", RuntimeWarning,
+            stacklevel=2)
+
+    def _parallel_kernel(self):
+        """Build (and cache) the parallel-in-time kernel for the current
+        scan/dfa family — shares the NFAKernel's selector/having/output
+        metadata so packed blocks unpack identically."""
+        kern = self._par_kerns.get(self.family)
+        if kern is None:
+            from .nfa_parallel import ParallelChainKernel, lower_parallel
+            prog = lower_parallel(self.spec, self.rt.strings,
+                                  self.param_extra)
+            kern = ParallelChainKernel(prog, self.kernel,
+                                       family=self.family)
+            self._par_kerns[self.family] = kern
+        return kern
+
     def _rebase(self, min_ts: int, min_seq: int) -> None:
         """Shift the plan's ts/seq bases forward and adjust persistent slot
         offsets so i32 locals never overflow.  Ancient slots clamp to
@@ -410,6 +565,15 @@ class DevicePatternPlan(QueryPlan):
             # matches): keys ever assigned to a lane
             d["keys_assigned"] = len(self._key_to_part)
         d["dropped_partials"] = int(self.dropped)
+        # plan-family gauges: the selected execution family (string —
+        # statistics() only; Prometheus skips non-numerics), per-family
+        # dispatch counts, and eligibility reasons for rejected families
+        d["plan_family"] = self.family
+        for f, n in self._family_dispatches.items():
+            d[f"dispatches_{f}"] = int(n)
+        inel = {f: r for f, r in self.families.items() if r is not True}
+        if inel:
+            d["family_ineligible"] = inel
         return d
 
     # -- QueryPlan interface -------------------------------------------------
@@ -577,6 +741,8 @@ class DevicePatternPlan(QueryPlan):
                     M = max(self._m_hint, _m_bucket(2 * T))
                 pre = st
                 st, out = self._call_block(self.kernel, T, M, pre, ev)
+                self._family_dispatches["seq"] = \
+                    self._family_dispatches.get("seq", 0) + 1
                 from .pipeline import start_d2h
                 start_d2h(out, keys=("i",))   # pull overlaps the compute
                 dispatched.append((j, pre, ev, T, M, out))
@@ -657,6 +823,7 @@ class DevicePatternPlan(QueryPlan):
             raise
 
     def _run_chunked_flat_inner(self, ts, seq, scode, cols) -> list:
+        fam = self.family
         with self.rt.stats.stage("host_build", plan=self.name):
             cfg = self._chunk_cfg
             W = int(cfg["W"])
@@ -675,34 +842,39 @@ class DevicePatternPlan(QueryPlan):
             # event inside the halo/tail (over-covering is harmless).
             W = W + int(np.max(ts_mono - ts)) if N else W
 
-            # lane geometry: halo-dominated data (few events per W) gets
-            # fewer, longer chunks; K buckets to pow2 so kernels are reused
-            def _halo(K: int):
-                CS = -(-N // K)
-                ends = np.unique(np.minimum(np.arange(1, K + 1) * CS, N))
-                ends = ends[ends > 0]
-                to = np.searchsorted(ts_mono, ts_mono[ends - 1] + W, side="right")
-                return CS, int(np.max(to - ends))
-            # K rides pow2 buckets: latency-capped ingest produces VARIABLE
-            # small flushes, and every distinct K is a fresh kernel compile
-            # (~10 s through the tunnel); empty lanes are free
-            K = min(int(cfg["lanes"]), pow2_at_least(max(1, N), lo=8))
-            CS, H = _halo(K)
-            if CS < H:
-                # halo-dominated: fewer, longer chunks (lo=8 keeps the K
-                # bucket set tiny — empty lanes are free, fresh compiles
-                # through the tunnel are not)
-                K = min(int(cfg["lanes"]),
-                        pow2_at_least(max(1, N // max(H, 1)), lo=8))
+            K = CS = H = T = None
+            if fam == "chunk":
+                # lane geometry: halo-dominated data (few events per W)
+                # gets fewer, longer chunks; K buckets to pow2 so kernels
+                # are reused
+                def _halo(K: int):
+                    CS = -(-N // K)
+                    ends = np.unique(np.minimum(np.arange(1, K + 1) * CS, N))
+                    ends = ends[ends > 0]
+                    to = np.searchsorted(ts_mono, ts_mono[ends - 1] + W,
+                                         side="right")
+                    return CS, int(np.max(to - ends))
+                # K rides pow2 buckets: latency-capped ingest produces
+                # VARIABLE small flushes, and every distinct K is a fresh
+                # kernel compile (~10 s through the tunnel); empty lanes
+                # are free
+                K = min(int(cfg["lanes"]), pow2_at_least(max(1, N), lo=8))
                 CS, H = _halo(K)
-            if self.mesh is not None:
-                # lane axis shards over the mesh: K must divide evenly over
-                # the device count (K = min(lanes, N) can be arbitrary)
-                nd = self.mesh.devices.size
-                if K % nd:
-                    K = -(-K // nd) * nd
+                if CS < H:
+                    # halo-dominated: fewer, longer chunks (lo=8 keeps the
+                    # K bucket set tiny — empty lanes are free, fresh
+                    # compiles through the tunnel are not)
+                    K = min(int(cfg["lanes"]),
+                            pow2_at_least(max(1, N // max(H, 1)), lo=8))
                     CS, H = _halo(K)
-            T = pow2_at_least(CS + H, lo=64)
+                if self.mesh is not None:
+                    # lane axis shards over the mesh: K must divide evenly
+                    # over the device count (K = min(lanes, N) is arbitrary)
+                    nd = self.mesh.devices.size
+                    if K % nd:
+                        K = -(-K // nd) * nd
+                        CS, H = _halo(K)
+                T = pow2_at_least(CS + H, lo=64)
 
             # fresh i32 bases every flush (no persistent device state)
             ts_base = int(ts_mono[0])
@@ -730,12 +902,19 @@ class DevicePatternPlan(QueryPlan):
                 out[:N] = a
                 return out
             ev = {"__flat.__ts__": pad(ts32),
-                  "__cs__": np.int32(CS), "__nev__": np.int32(N),
+                  "__nev__": np.int32(N),
                   "__prev_seq__": prev_off,
                   "__base_ts__": np.int64(ts_base),
                   "__base_seq__": np.int64(seq_base)}
-            if seq[-1] - seq[0] == N - 1:
-                # consecutive seqs derive on device from one scalar
+            if fam == "chunk":
+                ev["__cs__"] = np.int32(CS)
+            if fam == "chunk" and seq[-1] - seq[0] == N - 1:
+                # consecutive seqs derive on device from one scalar.
+                # Chunk-family only: output events consume seqs, so flush
+                # 2+ always lands on the explicit-seq variant anyway —
+                # the scan/dfa families ship it from flush 1 and save a
+                # whole structural recompile (~3 s CPU / ~10 s tunnel)
+                # for 4 bytes/event of upload
                 ev["__seq0__"] = np.int32(0)
             else:
                 ev["__flat.__seq__"] = pad(
@@ -756,10 +935,47 @@ class DevicePatternPlan(QueryPlan):
         # after that the hint PINS it — an N-based floor would drift
         # across 64K buckets as the replay tail varies, and every drift
         # is a ~10s recompile through the tunnel
+        if fam != "chunk":
+            # scan/dfa: one candidate completion per head, so matches
+            # <= N <= F ALWAYS — M = F can never overflow, and riding
+            # the sticky F bucket means M never recompiles on its own
+            return self._pipe.push(self._dispatch_par(
+                ev, F, F, ts_base, seq_base))
         M = (self._m_hint if self._m_hint >= 16384
              else max(self._m_hint, _m_bucket_chunk(N)))
         return self._pipe.push(self._dispatch_chunk(
             ev, K, T, M, ts_base, seq_base))
+
+    def _dispatch_par(self, ev, F, M, ts_base, seq_base) -> dict:
+        """One stateless scan/dfa-family block over the whole flat flush
+        (no lane geometry — the kernel is log-depth in T)."""
+        with self.rt.stats.stage("host_build", plan=self.name):
+            kern = self._parallel_kernel()
+        _st, out = self._call_block(kern, F, M, {}, ev)
+        from .pipeline import start_d2h
+        start_d2h(out)      # start the D2H pull while the device computes
+        self._family_dispatches[self.family] = \
+            self._family_dispatches.get(self.family, 0) + 1
+        return {"ev": ev, "F": F, "M": M, "out": out,
+                "ts_base": ts_base, "seq_base": seq_base}
+
+    def _materialize_par(self, e: dict):
+        while True:
+            with self.rt.stats.stage("transfer", plan=self.name):
+                ipack = np.asarray(e["out"]["i"])
+                fpack = np.asarray(e["out"]["f"]) if "f" in e["out"] \
+                    else None
+            n = int(ipack[0, 0])
+            if n > e["M"]:      # unreachable with M=F; exact-retry safety
+                e = self._dispatch_par(e["ev"], e["F"], _m_bucket_chunk(n),
+                                       e["ts_base"], e["seq_base"])
+                continue
+            break
+        # NOTE: _m_hint deliberately not updated — it sizes the chunk/seq
+        # match buffers, and par blocks ride M = F instead
+        # bases are per-flush: _unpack_block must see THIS entry's
+        self._ts_base, self._seq_base = e["ts_base"], e["seq_base"]
+        return self._unpack_block(ipack, fpack, n)
 
     def _dispatch_chunk(self, ev, K, T, M, ts_base, seq_base) -> dict:
         with self.rt.stats.stage("host_build", plan=self.name):
@@ -779,10 +995,14 @@ class DevicePatternPlan(QueryPlan):
         _st, out = self._call_block(kern, T, M, st0, ev)
         from .pipeline import start_d2h
         start_d2h(out)      # start the D2H pull while the device computes
+        self._family_dispatches["chunk"] = \
+            self._family_dispatches.get("chunk", 0) + 1
         return {"ev": ev, "K": K, "T": T, "M": M, "out": out,
                 "ts_base": ts_base, "seq_base": seq_base}
 
     def _materialize_chunk(self, e: dict):
+        if "F" in e:                  # scan/dfa-family entry
+            return self._materialize_par(e)
         while True:
             with self.rt.stats.stage("transfer", plan=self.name):
                 ipack = np.asarray(e["out"]["i"])
@@ -820,14 +1040,18 @@ class DevicePatternPlan(QueryPlan):
         return self._unpack_block(ipack, fpack, n)
 
     def regeometry(self, batch_hint=None, depth=None, chunk_lanes=None,
-                   **knobs) -> None:
+                   plan_family=None, **knobs) -> None:
         """Pattern-family geometry: base knobs plus the chunked-halo lane
-        count K.  A lane-count change only affects how FUTURE flushes
-        split into own-chunks (heads arm on owned events regardless of
-        K), so it is output-invariant like every other geometry move."""
+        count K and the execution family.  A lane-count change only
+        affects how FUTURE flushes split into own-chunks (heads arm on
+        owned events regardless of K); a stateless family switch applies
+        to future flushes over the same tail/dedup bookkeeping — both
+        output-invariant like every other geometry move."""
         super().regeometry(batch_hint=batch_hint, depth=depth, **knobs)
         if chunk_lanes is not None and self._chunk_cfg is not None:
             self._chunk_cfg["lanes"] = max(2, int(chunk_lanes))
+        if plan_family is not None:
+            self._set_family(str(plan_family))
 
     def flush_pending(self) -> list:
         # chunk results are raw columnar match tables, not OutputBatches:

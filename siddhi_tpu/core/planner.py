@@ -179,13 +179,16 @@ class QueryPlan:
         argument; asserted by the geometry differentials)."""
         if batch_hint is not None:
             self.batch_hint = int(batch_hint)
-        if depth is not None and self._pipe is not None \
-                and getattr(self, "_can_pipeline", True):
+        if depth is not None and getattr(self, "_can_pipeline", True):
             # _can_pipeline: a plan that must sync per flush (join side
             # filters feed the mirror update) pins depth 0 — geometry
-            # hints never override a correctness constraint
+            # hints never override a correctness constraint.  The depth
+            # is recorded even without a live pipeline: a later
+            # plan-family switch (pattern plans) builds its pipeline
+            # from self.pipeline_depth and must not lose the knob.
             self.pipeline_depth = int(depth)
-            self._pipe.set_depth(int(depth))
+            if self._pipe is not None:
+                self._pipe.set_depth(int(depth))
 
     def on_timer(self, now_ms: int) -> list:
         """Called by the scheduler tick (time windows, absent patterns...)."""

@@ -108,14 +108,31 @@ def test_chunked_sparse_data_reduces_lanes():
 
 
 def test_chunked_lane_annotation_disable():
-    """@app:deviceChunkLanes(0) turns the mode off (threaded state path)."""
+    """@app:deviceChunkLanes(0) turns the CHUNK family off.  Since the
+    plan-family split, that no longer forces the threaded state path —
+    the associative-scan family (which has no lane knob) may still
+    engage; `@app:patternFamily('seq')` is the explicit opt-out."""
+    from siddhi_tpu.core.pattern_plan import DevicePatternPlan
     q = QUERIES["two_state"]
-    chunked, dev = _run(
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(
+        "@app:devicePatterns('always')\n@app:deviceChunkLanes(0)\n"
+        + HEAD + q)
+    plan = next(p for p in rt._plans if isinstance(p, DevicePatternPlan))
+    assert plan.families["chunk"] is not True     # lanes knob honored
+    assert plan.family != "chunk"
+    mgr.shutdown()
+    _c, dev = _run(
         "@app:devicePatterns('always')\n@app:deviceChunkLanes(0)\n", q,
         n=600, batches=3)
     _h, host = _run("@app:devicePatterns('never')\n", q, n=600, batches=3)
-    assert not chunked
     assert dev == host
+    # the explicit sequential opt-out engages the threaded state path
+    chunked, dev2 = _run(
+        "@app:devicePatterns('always')\n@app:patternFamily('seq')\n", q,
+        n=600, batches=3)
+    assert not chunked
+    assert dev2 == host
 
 
 def test_chunked_snapshot_restore():
