@@ -1098,6 +1098,418 @@ def native_baseline():
     return out
 
 
+def _tape_str_batches(tape, keys=8):
+    """Tape -> (cols, ts) with symbol as STR arrays — the form both the
+    wire clients and the in-process differential feed, so the string
+    dictionary builds in the same order on every path."""
+    names = np.array([f"K{i}" for i in range(keys)])
+    return [({"symbol": names[t["sym_idx"]], "price": t["price"],
+              "volume": t["volume"]}, t["ts"]) for t in tape]
+
+
+def net_bench(smoke=False) -> dict:
+    """`--net [--smoke]`: serving-plane bench (docs/SERVING.md) on the
+    config-3 pattern workload.
+
+      * per-event REST POSTs (the old front door) vs columnar TCP
+        frames vs the shm ring vs in-process `send_batch` — eps each,
+        with the wire paths asserted BYTE-IDENTICAL to in-process
+        ingest (same matches, same decoded rows, same order)
+      * multi-producer TCP fan-in (full mode)
+      * overload: 2x the admitted rate under shed.policy='shed' —
+        engine p99 must stay within 2x its unloaded value, every shed
+        event must be accounted in the ErrorStore, and replay() must
+        restore them (zero unaccounted loss)
+
+    --smoke shrinks the tape for CI (scripts/smoke.sh) but keeps every
+    assertion."""
+    import threading
+    import urllib.request
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.net import RingProducer, TcpFrameClient
+    from siddhi_tpu.service import SiddhiService
+
+    n = 1 << 12 if smoke else 1 << 16
+    batch = 512 if smoke else 4096
+    warm = 2
+    app_body = DEV["patterns"] + C3
+    tape = make_tape(n + warm * batch, batch)
+    batches = _tape_str_batches(tape)
+    n_timed = sum(t["n"] for t in tape[warm:])
+
+    def run_collect(app, connect_fn):
+        """Fresh runtime; connect_fn(rt) -> (send, finish) callables.
+        Warm batches (compiles) land outside the timed window; returns
+        (eps over the timed region, ALL decoded Out rows)."""
+        mgr = SiddhiManager()
+        rt = mgr.create_app_runtime(app)
+        rows = []
+        rt.add_batch_callback("Out", lambda b: rows.extend(
+            map(tuple, b.rows(rt.strings))))
+        rt.start()
+        send, finish = connect_fn(rt)
+        for cols, ts in batches[:warm]:
+            send(cols, ts)
+        finish()
+        t0 = time.perf_counter()
+        for cols, ts in batches[warm:]:
+            send(cols, ts)
+        finish()
+        dt = time.perf_counter() - t0
+        mgr.shutdown()
+        for key in ("_bench_cli", "_bench_prod"):
+            c = rt.__dict__.get(key)
+            if c is not None:
+                c.close()
+        return n_timed / dt, rows
+
+    # 1) in-process columnar (the direct append_columnar path)
+    def connect_inproc(rt):
+        h = rt.input_handler(STREAM)
+        return h.send_batch, rt.flush
+    inproc_eps, inproc_rows = run_collect(app_body, connect_inproc)
+
+    # 2) loopback TCP frames through @source(type='tcp')
+    def connect_tcp(rt):
+        cli = TcpFrameClient("127.0.0.1", rt.sources[0].port, STREAM,
+                             TcpFrameClient.cols_of_schema(
+                                 rt.schemas[STREAM]))
+        rt.__dict__["_bench_cli"] = cli       # keep alive till shutdown
+        return cli.send_batch, lambda: cli.barrier(timeout=120)
+    tcp_eps, tcp_rows = run_collect(
+        "@source(type='tcp', port='0')\n" + app_body, connect_tcp)
+
+    # 3) shm ring
+    def connect_shm(rt):
+        prod = RingProducer(rt.sources[0].ring_name, STREAM,
+                            RingProducer.cols_of_schema(rt.schemas[STREAM]))
+        rt.__dict__["_bench_prod"] = prod
+        sent = [0]
+
+        def send(cols, ts):
+            prod.send_batch(cols, ts)
+            sent[0] += len(ts)
+
+        def finish():
+            prod.barrier(timeout=120)           # every frame popped
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:  # feed drains async of
+                if rt.admission[STREAM].metrics()["admitted_events"] \
+                        >= sent[0]:
+                    break                       # last pop fed: tight poll
+                time.sleep(0.0002)
+            rt.flush()
+        return send, finish
+    ring_slots = "16" if smoke else "64"
+    shm_eps, shm_rows = run_collect(
+        f"@source(type='shm', slots='{ring_slots}', "
+        f"slot.size='1048576')\n" + app_body, connect_shm)
+
+    # 4) per-event REST (the old debug front door) — measured on a
+    # slice of the tape, one keep-alive connection, one event per POST
+    n_rest = 256 if smoke else 1024
+    svc = SiddhiService(port=0, net=False).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{svc.port}/siddhi/artifact/deploy",
+            data=("@app:name('RestBench')\n"
+                  + app_body).encode(), method="POST")
+        urllib.request.urlopen(req).read()
+        import http.client
+        conn = http.client.HTTPConnection("127.0.0.1", svc.port)
+        rest_events = []
+        for cols, ts in batches:
+            for i in range(len(ts)):
+                rest_events.append((cols["symbol"][i], cols["price"][i],
+                                    int(cols["volume"][i]), int(ts[i])))
+                if len(rest_events) >= n_rest:
+                    break
+            if len(rest_events) >= n_rest:
+                break
+        t0 = time.perf_counter()
+        for sym, p, v, ts_i in rest_events:
+            body = json.dumps({"app": "RestBench", "stream": STREAM,
+                               "data": [str(sym), float(p), v],
+                               "timestamp": ts_i}).encode()
+            conn.request("POST", "/siddhi/artifact/event", body=body,
+                         headers={"Content-Type": "application/json"})
+            conn.getresponse().read()
+        rest_eps = n_rest / (time.perf_counter() - t0)
+        conn.close()
+    finally:
+        svc.stop()
+
+    # 5) multi-producer TCP fan-in (full mode): two connections, the
+    # tape split between them.  A STATELESS filter app — interleaved
+    # producers scramble cross-batch event time, which is a pattern-
+    # engine workload question (pending windows stop expiring
+    # monotonically), not a transport one; the filter isolates fan-in
+    # capacity.  No cross-producer order, so count-only.
+    mp_eps = None
+    if not smoke:
+        mgr = SiddhiManager()
+        rt = mgr.create_app_runtime(
+            "@source(type='tcp', port='0')\n" + DEV["filters"] + C1)
+        rt.start()
+        port = rt.sources[0].port
+        cols_spec = TcpFrameClient.cols_of_schema(rt.schemas[STREAM])
+        warm_cli = TcpFrameClient("127.0.0.1", port, STREAM, cols_spec)
+        for cols, ts in batches[:warm]:
+            warm_cli.send_batch(cols, ts)
+        warm_cli.barrier(timeout=120)
+
+        def one(share):
+            cli = TcpFrameClient("127.0.0.1", port, STREAM, cols_spec)
+            for cols, ts in share:
+                cli.send_batch(cols, ts)
+            cli.barrier(timeout=120)
+            cli.close()
+        ths = [threading.Thread(target=one, args=(s,))
+               for s in (batches[warm::2], batches[warm + 1::2])]
+        t0 = time.perf_counter()
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        mp_eps = n_timed / (time.perf_counter() - t0)
+        warm_cli.close()
+        mgr.shutdown()
+
+    identical = (tcp_rows == inproc_rows and shm_rows == inproc_rows
+                 and len(inproc_rows) > 0)
+
+    # 6) overload: 2x the admitted rate, shed.policy='shed'
+    overload = _net_overload(smoke)
+
+    res = {
+        "events": n_timed, "batch": batch,
+        "transport": {
+            "inproc_eps": round(inproc_eps),
+            "tcp_eps": round(tcp_eps),
+            "shm_eps": round(shm_eps),
+            "rest_eps": round(rest_eps, 1),
+            **({"tcp_2producer_filter_eps": round(mp_eps)}
+               if mp_eps else {}),
+        },
+        "tcp_vs_rest": round(tcp_eps / rest_eps, 1),
+        "shm_vs_tcp": round(shm_eps / tcp_eps, 2),
+        "matches": len(inproc_rows),
+        "identical": identical,
+        "overload": overload,
+    }
+    res["pass"] = bool(identical and res["tcp_vs_rest"] >= 5.0
+                       and overload["pass"])
+    return res
+
+
+def _net_overload(smoke=False) -> dict:
+    """Paced 2x-overload against a rate-limited tcp source with
+    shed.policy='shed': p99 bound, zero unaccounted loss, replayable."""
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.net import TcpFrameClient
+
+    rate = 4000.0                   # admitted eps
+    burst = 400.0
+    pace_batch = 64
+    seconds = 1.5 if smoke else 4.0
+    app = ("@app:statistics('true')\n"
+           f"@source(type='tcp', port='0', rate.limit='{rate}', "
+           f"burst='{burst}', shed.policy='shed')\n" + DEV["patterns"] + C3)
+
+    def paced_run(offered_eps):
+        mgr = SiddhiManager()
+        rt = mgr.create_app_runtime(app)
+        delivered = [0]
+        rt.add_batch_callback(STREAM, lambda b: delivered.__setitem__(
+            0, delivered[0] + b.n))
+        rt.start()
+        cli = TcpFrameClient(
+            "127.0.0.1", rt.sources[0].port, STREAM,
+            TcpFrameClient.cols_of_schema(rt.schemas[STREAM]))
+        rng = np.random.default_rng(11)
+        ts0 = 1_700_000_000_000
+        sent = 0
+
+        def one_batch():
+            nonlocal sent
+            cols = {"symbol": np.array(
+                        [f"K{i}" for i in rng.integers(0, 8, pace_batch)]),
+                    "price": q4(rng.uniform(90, 130, pace_batch)),
+                    "volume": rng.integers(1, 100, pace_batch)
+                       .astype(np.int32)}
+            cli.send_batch(cols, ts0 + np.arange(
+                sent, sent + pace_batch, dtype=np.int64))
+            sent += pace_batch
+
+        # warm OUTSIDE the measured window: the first batches trigger
+        # kernel compiles, which would otherwise backlog the socket and
+        # burst-shed on drain (and pollute the p99 histogram)
+        for _ in range(4):
+            one_batch()
+            cli.barrier(timeout=120)
+        rt.stats.reset()                # p99 over the paced window only
+        ctrl = rt.admission[STREAM]
+        m0 = ctrl.metrics()
+        sent0, delivered0 = sent, delivered[0]
+        interval = pace_batch / offered_eps
+        t_end = time.perf_counter() + seconds
+        ts_next = time.perf_counter()
+        while time.perf_counter() < t_end:
+            one_batch()
+            ts_next += interval
+            lag = ts_next - time.perf_counter()
+            if lag > 0:
+                time.sleep(lag)
+        cli.barrier(timeout=60)
+        m = ctrl.metrics()
+        stats = rt.statistics()
+        p99 = stats["streams"].get(STREAM, {}).get("p99_ms")
+        out = {"sent": sent - sent0,
+               "delivered": delivered[0] - delivered0,
+               "shed": m["shed_events"] - m0["shed_events"],
+               "p99_ms": p99,
+               "stored_frames": m["shed_frames"] - m0["shed_frames"]}
+        # replay restores every shed event (lift the limit first)
+        ctrl.bucket.rate = None
+        rep = rt.error_store.replay(rt)
+        rt.flush()
+        out["replayed_ok"] = (rep["remaining"] == 0
+                              and delivered[0] == sent)
+        cli.close()
+        mgr.shutdown()
+        return out
+
+    base = paced_run(rate * 0.5)            # unloaded: half the limit
+    over = paced_run(rate * 2.0)            # 2x the admitted rate
+    p99_ok = (base["p99_ms"] is None or over["p99_ms"] is None
+              or over["p99_ms"] <= 2.0 * max(base["p99_ms"], 1.0))
+    res = {"rate_limit_eps": rate, "unloaded": base, "overloaded": over,
+           "p99_within_2x": p99_ok,
+           "zero_loss": bool(over["replayed_ok"] and over["shed"] > 0)}
+    res["pass"] = bool(res["p99_within_2x"] and res["zero_loss"]
+                       and base["replayed_ok"])
+    return res
+
+
+def chaos_net(seed: int = 7) -> dict:
+    """Serving-plane chaos (`--chaos` rides this after the core
+    sections): mid-frame disconnects must not poison the server or
+    lose admitted frames; a slow consumer on a tiny shm ring must
+    backpressure the producer, never drop; injected ingest faults
+    capture whole frames for replay."""
+    import socket as _socket
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.faults import FaultInjector
+    from siddhi_tpu.net import RingProducer, TcpFrameClient
+    from siddhi_tpu.net import frame as fp
+
+    APP = ("@source(type='tcp', port='0')\n"
+           "define stream S (sym string, p double);\n"
+           "@info(name='q') from S select sym, p insert into Out;\n")
+    out: dict = {}
+    rng = np.random.default_rng(seed)
+
+    # 1) mid-frame disconnects between healthy producers
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(APP)
+    delivered = [0]
+    rt.add_batch_callback("S", lambda b: delivered.__setitem__(
+        0, delivered[0] + b.n))
+    rt.start()
+    port = rt.sources[0].port
+    cols_spec = TcpFrameClient.cols_of_schema(rt.schemas["S"])
+    n_sent = 0
+    for round_ in range(3):
+        cli = TcpFrameClient("127.0.0.1", port, "S", cols_spec)
+        for k in range(4):
+            cli.send_batch(
+                {"sym": np.array([f"K{i}" for i in
+                                  rng.integers(0, 4, 32)]),
+                 "p": q4(rng.uniform(0, 10, 32))},
+                np.arange(n_sent, n_sent + 32, dtype=np.int64))
+            n_sent += 32
+        cli.barrier()
+        cli.close()
+        # now a rude client: half a frame, then vanish
+        raw = _socket.create_connection(("127.0.0.1", port))
+        blob = fp.encode_hello("", "S", cols_spec)
+        raw.sendall(blob[:len(blob) // 2 + round_])
+        raw.close()
+        # and one that sends garbage
+        raw = _socket.create_connection(("127.0.0.1", port))
+        raw.sendall(bytes(rng.integers(0, 256, 64, dtype=np.uint8)))
+        raw.close()
+    time.sleep(0.1)
+    errors = rt.statistics()["net"]["S"].get("protocol_errors", 0)
+    disc_ok = delivered[0] == n_sent
+    out["mid_frame_disconnect"] = {
+        "sent": n_sent, "delivered": delivered[0],
+        "protocol_errors": errors, "pass": disc_ok}
+    mgr.shutdown()
+
+    # 2) slow consumer: a 2-slot ring backpressures, loses nothing
+    APP_SHM = ("@source(type='shm', slots='2', slot.size='8192')\n"
+               "define stream S (sym string, p double);\n"
+               "@info(name='q') from S select sym, p insert into Out;\n")
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(APP_SHM)
+    delivered2 = [0]
+    rt.add_batch_callback("S", lambda b: delivered2.__setitem__(
+        0, delivered2[0] + b.n))
+    rt.start()
+    prod = RingProducer(rt.sources[0].ring_name, "S",
+                        RingProducer.cols_of_schema(rt.schemas["S"]),
+                        push_timeout=30)
+    n2 = 0
+    for k in range(64):                     # 64 frames through 2 slots
+        prod.send_batch({"sym": np.array(["A", "B"]),
+                         "p": np.array([1.0, 2.0])},
+                        np.arange(n2, n2 + 2, dtype=np.int64))
+        n2 += 2
+    prod.barrier(timeout=30)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and delivered2[0] < n2:
+        rt.flush()
+        time.sleep(0.01)
+    slow_ok = delivered2[0] == n2
+    out["slow_consumer_ring"] = {"sent": n2, "delivered": delivered2[0],
+                                 "pass": slow_ok}
+    prod.close()
+    mgr.shutdown()
+
+    # 3) injected ingest faults: admitted frames capture whole + replay
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(APP)
+    delivered3 = [0]
+    rt.add_batch_callback("S", lambda b: delivered3.__setitem__(
+        0, delivered3[0] + b.n))
+    rt.start()
+    rt.fault_injector = FaultInjector(seed=seed, counts={"net.feed": 3})
+    cli = TcpFrameClient("127.0.0.1", rt.sources[0].port, "S",
+                         TcpFrameClient.cols_of_schema(rt.schemas["S"]))
+    n3 = 0
+    for k in range(8):
+        cli.send_batch({"sym": np.array(["X"] * 16),
+                        "p": q4(rng.uniform(0, 10, 16))},
+                       np.arange(n3, n3 + 16, dtype=np.int64))
+        n3 += 16
+    cli.barrier()
+    stored = len(rt.error_store)
+    rt.fault_injector = None
+    rep = rt.error_store.replay(rt)
+    rt.flush()
+    feed_ok = (stored == 3 and rep["remaining"] == 0
+               and delivered3[0] == n3)
+    out["injected_feed_faults"] = {
+        "sent": n3, "stored_then_replayed": stored,
+        "delivered_after_replay": delivered3[0], "pass": feed_ok}
+    cli.close()
+    mgr.shutdown()
+
+    out["pass"] = disc_ok and slow_ok and feed_ok
+    return out
+
+
 def chaos_bench(seed: int = 7) -> dict:
     """Seeded chaos harness (`--chaos [--seed N]`): runs the pattern,
     window, and join configs clean and then under injected faults
@@ -1229,6 +1641,12 @@ def chaos_bench(seed: int = 7) -> dict:
                    "pass": sink_ok}
     out["pass"] = out["pass"] and sink_ok
     mgr.shutdown()
+
+    # serving-plane chaos: mid-frame disconnects, slow shm consumer,
+    # injected ingest faults (zero admitted-frame loss throughout)
+    net = _safe("chaos net", lambda: chaos_net(seed), {"pass": False})
+    out["net"] = net
+    out["pass"] = out["pass"] and bool(net.get("pass"))
     return out
 
 
@@ -1239,7 +1657,7 @@ def _print_summary(summary: dict, cap: int = 2048) -> None:
     BENCH "parsed": null failure shape).  Oversize degrades by dropping
     detail keys — never by emitting an unparseable line."""
     drop_order = ("stage_shares_config3", "configs", "roofline",
-                  "trace_coverage_config3")
+                  "transport", "trace_coverage_config3")
     line = json.dumps(summary)
     for key in drop_order:
         if len(line) <= cap:
@@ -1302,6 +1720,18 @@ def main(argv=None):
         print(json.dumps({"metric": "plan_family_parity",
                           "value": 1 if res["pass"] else 0,
                           "unit": "all_families_match_interpreter", **res}))
+        if not res["pass"]:
+            sys.exit(1)
+        return
+    if "--net" in argv:
+        # serving-plane bench (docs/SERVING.md): REST vs TCP vs shm vs
+        # in-process on config 3, byte-identical differential, paced 2x
+        # overload with shed accounting + replay; --smoke shrinks for CI
+        res = net_bench(smoke="--smoke" in argv)
+        print(json.dumps({"metric": "net_serving_plane",
+                          "value": res["tcp_vs_rest"],
+                          "unit": "tcp_frame_eps_over_per_event_rest",
+                          **res}))
         if not res["pass"]:
             sys.exit(1)
         return
@@ -1541,6 +1971,42 @@ def main(argv=None):
     roofline["3_sequence"]["kernel_eps_static_by_family"] = \
         configs["3_sequence"].get("kernel_eps_static_by_family")
 
+    # serving-plane transport column (ROADMAP item 3): a smoke-scale
+    # net bench so every full run reports wire vs in-process ingest
+    net_res = _safe("net transport smoke",
+                    lambda: net_bench(smoke=True), {})
+    _mark("net transport smoke done", t0)
+
+    # transport-vs-host-vs-kernel breakdown per config: the
+    # "transport-bound" calibration note as a MEASURED column.  For each
+    # config: the kernel-only ceiling, the end-to-end in-process engine
+    # rate (kernel + host dispatch), and the wire ceiling (loopback TCP
+    # frames, measured on the config-3 schema at smoke scale — the
+    # schema every numbered config shares).  `bound` names the limiter:
+    # the wire when it is slower than the engine, else host dispatch
+    # when >half the end-to-end time is outside the kernel, else the
+    # kernel itself.
+    wire_eps = (net_res.get("transport") or {}).get("tcp_eps")
+    breakdown = {}
+    for cfg, c in sorted(configs.items()):
+        de, ke = c.get("device_eps"), c.get("kernel_eps")
+        if not de:
+            continue
+        row = {"engine_eps": de}
+        if ke:
+            row["kernel_eps"] = ke
+            row["host_share"] = round(max(0.0, 1.0 - de / ke), 3)
+        if wire_eps:
+            row["wire_tcp_eps"] = wire_eps
+            row["wire_vs_engine"] = round(wire_eps / de, 2)
+        if wire_eps and wire_eps < de:
+            row["bound"] = "transport"
+        elif ke and de / ke < 0.5:
+            row["bound"] = "host"
+        elif ke:
+            row["bound"] = "kernel"
+        breakdown[cfg] = row
+
     h = configs["4_partitioned_1k"]
     detail = {
         "metric": "partitioned_pattern_throughput_1k_keys",
@@ -1566,6 +2032,8 @@ def main(argv=None):
                          "not compute, bound most configs here",
         },
         "roofline": roofline,
+        "transport": net_res,
+        "transport_breakdown": breakdown,
         "configs": configs,
     }
     def _write_detail():
@@ -1589,9 +2057,17 @@ def main(argv=None):
         "roofline": {k: {kk: v.get(kk) for kk in
                          ("plan_family", "kernel_eps", "vs_native_cpp")}
                      for k, v in roofline.items()},
+        # the serving-plane transport column: wire ingest eps by
+        # transport (net_bench smoke scale) + the REST multiple
+        "transport": ({**net_res.get("transport", {}),
+                       "tcp_vs_rest": net_res.get("tcp_vs_rest"),
+                       "identical": net_res.get("identical")}
+                      if net_res else None),
         "configs": {k: {"eps": v["device_eps"], "speedup": v["speedup"],
                         **({"p99_ms": v["p99_detect_ms"]}
-                           if v.get("p99_detect_ms") is not None else {})}
+                           if v.get("p99_detect_ms") is not None else {}),
+                        **({"bound": breakdown[k]["bound"]}
+                           if breakdown.get(k, {}).get("bound") else {})}
                     for k, v in configs.items()},
         "detail": "BENCH_DETAIL.json",
     }

@@ -1,0 +1,61 @@
+"""Serving-plane quickstart: columnar wire ingest with admission
+control (docs/SERVING.md).
+
+A pattern app exposes a TCP frame endpoint with a 50k eps rate limit
+shedding into the replayable ErrorStore; a producer ships columnar
+batches with `TcpFrameClient` (zero per-event Python on either side),
+then the shed events are replayed once load clears.
+
+    python samples/net_serving.py
+"""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.net import TcpFrameClient
+
+APP = """
+@app:name('Serving')
+@source(type='tcp', port='0', rate.limit='50000', shed.policy='shed')
+define stream Ticks (symbol string, price double, volume int);
+
+@info(name='surge')
+from every e1=Ticks[price > 100] -> e2=Ticks[price > e1.price] within 1 sec
+select e1.symbol as symbol, e1.price as p1, e2.price as p2
+insert into Surges;
+"""
+
+mgr = SiddhiManager()
+rt = mgr.create_app_runtime(APP)
+matches = []
+rt.add_batch_callback("Surges", lambda b: matches.extend(b.rows(rt.strings)))
+rt.start()
+
+port = rt.sources[0].port
+print(f"frame server on 127.0.0.1:{port} (ws-capable, same port)")
+
+cli = TcpFrameClient("127.0.0.1", port, "Ticks",
+                     TcpFrameClient.cols_of_schema(rt.schemas["Ticks"]))
+rng = np.random.default_rng(7)
+ts0 = 1_700_000_000_000
+for k in range(8):
+    n = 2048
+    cli.send_batch(
+        {"symbol": np.array([f"K{i}" for i in rng.integers(0, 8, n)]),
+         "price": np.round(rng.uniform(90, 130, n), 2),
+         "volume": rng.integers(1, 1000, n).astype(np.int32)},
+        ts0 + np.arange(k * n, (k + 1) * n, dtype=np.int64))
+cli.barrier()          # PING/ACK: everything admitted, fed, flushed
+
+net = rt.statistics()["net"]["Ticks"]
+print(f"frames={net['frames_in']} events={net['events_in']} "
+      f"admitted={net['admitted_events']} shed={net['shed_events']} "
+      f"matches={len(matches)}")
+
+if net["shed_events"]:
+    rt.admission["Ticks"].bucket.rate = None      # load cleared
+    print("replaying shed events:", rt.error_store.replay(rt))
+
+cli.close()
+mgr.shutdown()

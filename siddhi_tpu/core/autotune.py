@@ -541,6 +541,13 @@ class SLOController:
         self.last_p99_s: Optional[float] = None
         self.decisions: deque = deque(maxlen=log_capacity)
         self.counts = {"increase": 0, "decrease": 0, "hold": 0}
+        # serving-plane admission throttle (net/admission.py token
+        # buckets scale their refill rate by this): multiplicative
+        # decrease with the batch target when p99 overshoots, additive
+        # recovery back to 1.0 under the target — overload lowers
+        # ADMISSION before engine latency collapses (ROADMAP item 3)
+        self.admission_factor = 1.0
+        self.admission_floor = 0.1
 
     def observe(self, seconds: float) -> None:
         """One per-batch latency sample (first buffered event ->
@@ -567,9 +574,12 @@ class SLOController:
         if p99 > self.target_s:
             action = "decrease"
             new = max(self.min_batch, int(old * self.backoff))
+            self.admission_factor = max(self.admission_floor,
+                                        self.admission_factor * self.backoff)
         elif p99 < self.target_s * (1.0 - self.hysteresis):
             action = "increase"
             new = min(self.max_batch, old + self.add_step)
+            self.admission_factor = min(1.0, self.admission_factor + 0.1)
         else:
             action = "hold"
             new = old
@@ -579,7 +589,8 @@ class SLOController:
                "p99_ms": round(p99 * 1e3, 3),
                "target_ms": round(self.target_s * 1e3, 3),
                "samples": self._win.count,
-               "batch_from": old, "batch": new}
+               "batch_from": old, "batch": new,
+               "admission_factor": round(self.admission_factor, 4)}
         self.decisions.append(dec)
         self._win.reset()
         self._last_decide = now_s
@@ -589,6 +600,7 @@ class SLOController:
         m = {"adaptive": self.adaptive,
              "flush_after_ms": round(self.flush_after_s * 1e3, 3),
              "batch_target": self.batch_target,
+             "admission_factor": round(self.admission_factor, 4),
              "decisions": dict(self.counts),
              "decision_log": list(self.decisions)[-16:]}
         if self.target_s is not None:

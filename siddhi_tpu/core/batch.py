@@ -143,6 +143,26 @@ class EventBatch:
         return b.freeze()
 
 
+def rows_of_columns(schema: StreamSchema, timestamps, columns: dict,
+                    strings: Optional[StringTable] = None) -> list:
+    """Columnar arrays -> [(ts_ms, row_tuple), ...] with string codes
+    decoded back to str.  The serving plane's shed/capture path: a
+    frame that admission drops is decoded ONCE here so the ErrorStore
+    entry is replayable through the normal row ingest (`rt.send`)."""
+    cols = []
+    for a in schema.attributes:
+        arr = np.asarray(columns[a.name])
+        if a.type == AttrType.STRING and strings is not None \
+                and arr.dtype.kind in "iu":
+            dec = strings._to_str
+            cols.append([dec[c] if 0 <= c < len(dec) else None
+                         for c in arr.tolist()])
+        else:
+            cols.append(arr.tolist())
+    ts = np.asarray(timestamps).tolist()
+    return list(zip(ts, (tuple(r) for r in zip(*cols)))) if cols else []
+
+
 class BatchBuilder:
     """Mutable row accumulator -> EventBatch.  The per-stream ingest buffer
     behind InputHandler (analog of the junction's ring slot filling,

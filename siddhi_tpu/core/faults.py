@@ -389,13 +389,19 @@ def split_buffered(bufs: list) -> Optional[list]:
 # ---------------------------------------------------------------------------
 
 class FaultInjector:
-    """Deterministic fault injection at the five recovery boundaries:
+    """Deterministic fault injection at the seven recovery boundaries:
 
       dispatch        device kernel dispatch (plans' jitted calls)
       d2h             device->host materialization (DispatchPipeline)
       sink.publish    Sink.publish attempts
       source.connect  Source.connect attempts
       persist.save    persistence store writes
+      net.decode      serving-plane frame decode (net/server.py) — a
+                      failure here is connection-fatal, like a corrupt
+                      frame off the wire
+      net.feed        serving-plane admitted-frame ingest; a failure
+                      captures the whole frame into the ErrorStore
+                      (zero-loss invariant, chaos-tested)
 
     `counts` arms a burst: the first N checks at a point fail.  `rates`
     arms a per-check probability drawn from a per-point rng seeded from
@@ -407,7 +413,7 @@ class FaultInjector:
     retry paths)."""
 
     POINTS = ("dispatch", "d2h", "sink.publish", "source.connect",
-              "persist.save")
+              "persist.save", "net.decode", "net.feed")
 
     def __init__(self, seed: int = 0, counts: Optional[dict] = None,
                  rates: Optional[dict] = None, kinds: Optional[dict] = None):

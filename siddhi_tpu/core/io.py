@@ -728,6 +728,17 @@ def _ann_options(a: ast.Annotation) -> dict:
             for i, (k, v) in enumerate(a.elements)}
 
 
+def _load_net_types() -> None:
+    """Lazy registration of the serving-plane transports (tcp/ws/shm
+    sources, tcp/ws sinks) — importing siddhi_tpu.net registers them.
+    Deferred so apps that never network pay no import cost."""
+    import importlib
+    try:
+        importlib.import_module(".net", package=__package__.rsplit(".", 1)[0])
+    except ImportError:
+        pass
+
+
 def build_io(rt) -> None:
     """Instantiate sources/sinks declared on stream definitions."""
     from ..query.ast import find_annotation
@@ -738,6 +749,9 @@ def build_io(rt) -> None:
                 opts = _ann_options(a)
                 typ = opts.get("type", "").lower()
                 cls = SOURCE_TYPES.get(typ)
+                if cls is None:
+                    _load_net_types()
+                    cls = SOURCE_TYPES.get(typ)
                 if cls is None:
                     raise PlanError(f"unknown source type {typ!r} on "
                                     f"{sid!r}; have {sorted(SOURCE_TYPES)}")
@@ -755,6 +769,9 @@ def build_io(rt) -> None:
                 opts = _ann_options(a)
                 typ = opts.get("type", "").lower()
                 cls = SINK_TYPES.get(typ)
+                if cls is None:
+                    _load_net_types()
+                    cls = SINK_TYPES.get(typ)
                 if cls is None:
                     raise PlanError(f"unknown sink type {typ!r} on "
                                     f"{sid!r}; have {sorted(SINK_TYPES)}")

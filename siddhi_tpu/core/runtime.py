@@ -13,6 +13,7 @@ auto-flushes when a builder reaches capacity.
 """
 from __future__ import annotations
 
+import threading
 import time
 import numpy as np
 from collections import defaultdict
@@ -256,6 +257,13 @@ class SiddhiAppRuntime:
         from .faults import ErrorStore
         self.error_store = ErrorStore()
         self.fault_injector = None      # set a faults.FaultInjector to arm
+        # serving-plane admission controllers, one per net-ingesting
+        # stream (siddhi_tpu.net.admission) — shared across transports,
+        # throttled by the SLO controller's admission_factor; the gate
+        # serializes net feeds against retire() across EVERY server
+        # feeding this runtime (net/server.py _gate_of)
+        self.admission: dict = {}
+        self._net_gate = threading.RLock()
         self._ladders: dict = {}        # plan name -> FaultLadder
         self._degraded: list = []       # quarantined-plan records
         qa = qast.find_annotation(app.annotations, "app:quarantineAfter")
@@ -282,7 +290,6 @@ class SiddhiAppRuntime:
         # ingest/timer mutual exclusion (the reference's ThreadBarrier +
         # per-query locks collapse to one runtime lock: state is columnar
         # and single-writer by design)
-        import threading
         self._lock = threading.RLock()
         # sink deliveries staged inside _drain (under the lock) and flushed
         # after release: a sink publishing into another runtime's source
@@ -414,7 +421,6 @@ class SiddhiAppRuntime:
         the next batch while the previous one computes (the reference's
         Disruptor + StreamHandler drain, StreamJunction.java:280-316)."""
         import queue as _queue
-        import threading
         # bounded: backpressure (reference buffer.size ring capacity)
         self._ingest_q = _queue.Queue(maxsize=self._async_buffer)
 
@@ -449,7 +455,6 @@ class SiddhiAppRuntime:
     def _start_scheduler(self) -> None:
         """Wall-clock timer pump: fires due timers (time windows, rate
         limits, triggers, absent patterns) without requiring set_time()."""
-        import threading
         if self._sched_thread is not None:
             return
         self._sched_stop = threading.Event()
@@ -460,6 +465,7 @@ class SiddhiAppRuntime:
 
         def pump():
             while not self._sched_stop.wait(tick):
+                self._pump_admission()  # outside the lock: feeds re-enter
                 with self._lock:
                     virtual = self._clock_ms is not None
                     if not virtual and self.max_batch_latency_s is not None:
@@ -503,6 +509,17 @@ class SiddhiAppRuntime:
         self._sched_thread = threading.Thread(
             target=pump, name="siddhi-scheduler", daemon=True)
         self._sched_thread.start()
+
+    def _pump_admission(self) -> None:
+        """Drain pending admission work ('oldest'-policy frames, queued
+        REST batches) whose tokens have refilled.  Wire connections
+        pump their own controller between frames, but once a producer
+        goes quiet nothing else would — without this timer tick, queued
+        work could sit unfed until the next frame arrived or teardown
+        shed it to the ErrorStore."""
+        for ctrl in list(self.admission.values()):
+            for w in ctrl.pump():
+                ctrl.feed_safely(w)
 
     # -- on-demand (store) queries (reference: SiddhiAppRuntime.query:272) ---
 
@@ -1062,9 +1079,18 @@ class SiddhiAppRuntime:
                 if t0b is not None:
                     self.slo.observe(now - t0b)
                 dec = self.slo.maybe_decide(now)
-                if dec is not None \
-                        and int(dec["batch"]) != self.batch_capacity:
-                    self._apply_batch_target(int(dec["batch"]))
+                if dec is not None:
+                    if int(dec["batch"]) != self.batch_capacity:
+                        self._apply_batch_target(int(dec["batch"]))
+                    if self.admission:
+                        # lower admission BEFORE latency collapses: the
+                        # serving plane's token buckets scale by the
+                        # controller's admission factor (docs/SERVING.md).
+                        # list(): net connection threads insert new
+                        # controllers at HELLO time, concurrently
+                        f = dec.get("admission_factor", 1.0)
+                        for ctrl in list(self.admission.values()):
+                            ctrl.set_rate_factor(f)
 
     # -- fault handling ------------------------------------------------------
 

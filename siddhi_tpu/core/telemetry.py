@@ -588,7 +588,13 @@ def render_prometheus(reports: dict) -> str:
                           ("failures", "siddhi_tpu_sink_failures_total",
                            "publish attempt failures per sink"),
                           ("stored", "siddhi_tpu_sink_stored_total",
-                           "payloads captured in the ErrorStore per sink"))
+                           "payloads captured in the ErrorStore per sink"),
+                          # net egress (siddhi_tpu/net sink.py): batched
+                          # columnar frames shipped over the wire
+                          ("frames_out", "siddhi_tpu_sink_frames_out_total",
+                           "columnar frames shipped by a net sink"),
+                          ("bytes_out", "siddhi_tpu_sink_bytes_out_total",
+                           "wire bytes shipped by a net sink"))
         for label, m in rep.get("sinks", {}).items():
             kl = {**al, "sink": label}
             for key, name, help_ in _SINK_COUNTERS:
@@ -602,6 +608,45 @@ def render_prometheus(reports: dict) -> str:
                 doc.add("siddhi_tpu_sink_circuit_opens_total", "counter",
                         "times the per-sink circuit breaker opened", kl,
                         m.get("circuit_opens", 0))
+        # serving-plane series (siddhi_tpu/net): wire ingest + admission
+        _NET_COUNTERS = (
+            ("frames_in", "siddhi_tpu_net_frames_total",
+             "wire frames received per stream"),
+            ("events_in", "siddhi_tpu_net_events_total",
+             "events received over the serving plane per stream"),
+            ("bytes_in", "siddhi_tpu_net_bytes_total",
+             "payload bytes received per stream"),
+            ("admitted_events", "siddhi_tpu_net_admitted_events_total",
+             "events admitted by the rate controller per stream"),
+            ("shed_events", "siddhi_tpu_net_shed_events_total",
+             "events shed into the ErrorStore per stream"),
+            ("shed_frames", "siddhi_tpu_net_shed_frames_total",
+             "frames shed into the ErrorStore per stream"),
+            ("credit_granted", "siddhi_tpu_net_credit_granted_total",
+             "credit frames granted to producers per stream"),
+            ("protocol_errors", "siddhi_tpu_net_protocol_errors_total",
+             "malformed/checksum-failed frames per stream"))
+        _NET_GAUGES = (
+            ("pending_frames", "siddhi_tpu_net_pending_frames",
+             "frames parked by the 'oldest' admission queue"),
+            ("pending_bytes", "siddhi_tpu_net_pending_bytes",
+             "bytes parked by the 'oldest' admission queue"),
+            ("rate_factor", "siddhi_tpu_net_admission_factor",
+             "SLO-driven admission throttle (1.0 = full rate)"),
+            ("open_connections", "siddhi_tpu_net_open_connections",
+             "live ingest connections per stream"),
+            ("ring_occupancy", "siddhi_tpu_net_ring_occupancy",
+             "shm-ring frames awaiting the consumer"),
+            ("blocked_seconds", "siddhi_tpu_net_blocked_seconds",
+             "cumulative block-policy backpressure wait"))
+        for sid, m in rep.get("net", {}).items():
+            nl = {**al, "stream": sid}
+            for key, name, help_ in _NET_COUNTERS:
+                if key in m:
+                    doc.add(name, "counter", help_, nl, m[key])
+            for key, name, help_ in _NET_GAUGES:
+                if key in m:
+                    doc.add(name, "gauge", help_, nl, m[key])
         # adaptive-geometry series (core/autotune.py)
         tun = rep.get("tuning")
         if tun:
@@ -855,6 +900,28 @@ class StatisticsManager:
                 sinks[f"{s.stream_id}[{i}]"] = m
         if sinks:
             rep["sinks"] = sinks
+        # serving plane (siddhi_tpu/net): per-stream admission gauges
+        # (frames/events/bytes in, sheds, pending, rate factor) merged
+        # with transport-level counters from net sources (connections,
+        # credit granted, ring occupancy)
+        net: dict = {}
+        for sid, ctrl in list(getattr(self.rt, "admission", {}).items()):
+            try:
+                net[sid] = ctrl.metrics()
+            except Exception:
+                continue
+        for s in getattr(self.rt, "sources", ()):
+            nm = getattr(s, "net_metrics", None)
+            if nm is None:
+                continue
+            try:
+                m = nm()
+            except Exception:
+                m = None
+            if m:
+                net.setdefault(s.stream_id, {}).update(m)
+        if net:
+            rep["net"] = net
         # adaptive execution geometry (core/autotune.py): tuning-cache
         # hit/miss gauges + the SLO controller's state and decision log
         tn = getattr(self.rt, "tuner", None)

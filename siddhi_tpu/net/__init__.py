@@ -1,0 +1,28 @@
+"""siddhi_tpu.net — the zero-copy serving data plane.
+
+Columnar wire ingest (frame.py over TCP/WebSocket via server.py,
+shared-memory rings via ring.py), admission control (admission.py),
+batched sink egress (sink.py), and the producer client library
+(client.py).  Importing this package registers the `tcp` / `ws` /
+`shm` source types and `tcp` / `ws` sink types; `core.io.build_io`
+imports it lazily the first time an app declares one, so apps that
+never touch the network pay nothing.
+
+See docs/SERVING.md for the wire format, ring layout, admission
+semantics, and the ops runbook.
+"""
+from .admission import AdmissionController, TokenBucket
+from .client import (FrameReceiver, NetClientError, RingProducer,
+                     TcpFrameClient, WsFrameClient)
+from .frame import FrameError
+from .ring import ShmRing
+from .server import NetServer
+from . import sink as _sink
+from . import source as _source
+
+_source.register()
+_sink.register()
+
+__all__ = ["AdmissionController", "TokenBucket", "FrameError",
+           "FrameReceiver", "NetClientError", "NetServer", "RingProducer",
+           "ShmRing", "TcpFrameClient", "WsFrameClient"]

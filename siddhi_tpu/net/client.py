@@ -1,0 +1,545 @@
+"""Producer client library for the serving plane.
+
+`TcpFrameClient` / `WsFrameClient` speak the columnar frame protocol
+(net/frame.py) over loopback-or-real TCP / WebSocket; `RingProducer`
+pushes the same frames through a shared-memory ring (net/ring.py) for
+co-located producers.  All three share the encode path: string columns
+are dictionary-encoded against a connection-local table whose deltas
+ship as STRINGS frames, numeric columns go over the wire as raw
+little-endian buffers — `send_batch` does no per-event Python.
+
+`FrameReceiver` is the mirror half for sink egress: a tiny
+accept-loop that decodes incoming frames back into columnar batches
+(tests, downstream consumers, and `bench.py --net` use it).
+"""
+from __future__ import annotations
+
+import json
+import os
+import base64
+import socket
+import struct
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from . import frame as fp
+from .ring import ShmRing
+
+class NetClientError(Exception):
+    pass
+
+
+def _schema_cols(schema) -> list:
+    return [(a.name, a.type.name.lower()) for a in schema.attributes]
+
+
+def _batch_rows(columns: dict, timestamps) -> int:
+    for v in columns.values():
+        return int(np.asarray(v).shape[0])
+    return int(np.asarray(timestamps).size)
+
+
+class _FrameEncoder:
+    """Shared columnar encode: schema order, string dictionary deltas."""
+
+    def __init__(self, stream: str, cols: list, str_cols: set):
+        from ..core.schema import dtype_of
+        from ..query.ast import AttrType
+        self.stream = stream
+        self.cols = cols                       # [(name, type), ...]
+        self.str_cols = str_cols               # names of string columns
+        self.strings = fp.WireStringTable()
+        # declared wire dtype per non-string column: values are CAST to
+        # it before framing — an int array handed to a double column
+        # must ship double bits, not get reinterpreted by the peer
+        self.dtypes = {name: np.dtype(dtype_of(AttrType[t.upper()]))
+                       for name, t in cols if name not in str_cols}
+
+    def encode_batch(self, columns: dict, timestamps,
+                     synced: int = None) -> bytes:
+        """One batch -> (optional STRINGS frame) + DATA frame bytes.
+        With `synced` (the highest code the peer is KNOWN to have
+        mapped), the delta covers every code from there up — so a
+        previously FAILED send whose delta never arrived is healed by
+        the next one (explicit start codes make the re-declare
+        idempotent server-side).  Without it, only never-sent strings
+        ship (the caller does its own catch-up, e.g. TcpSink)."""
+        ts = np.asarray(timestamps, dtype=np.int64)
+        if ts.ndim == 0:
+            ts = ts.reshape(1)
+        out = []
+        ordered = []
+        new_strings: list = []
+        for name, _t in self.cols:
+            if name not in columns:
+                raise NetClientError(f"missing column {name!r}")
+            v = columns[name]
+            if name in self.str_cols:
+                codes, new = self.strings.encode_column(v)
+                new_strings.extend(new)
+                ordered.append(codes)
+            else:
+                ordered.append(np.asarray(v, dtype=self.dtypes[name]))
+        if synced is not None:
+            delta = self.strings.strings_from(synced)
+            if delta:
+                out.append(fp.encode_strings(delta, start_code=synced))
+        elif new_strings:
+            out.append(fp.encode_strings(
+                new_strings, start_code=len(self.strings) - len(new_strings)))
+        n = int(ts.shape[0])
+        if ts.shape[0] == 1 and ordered and ordered[0].shape[0] > 1:
+            n = int(ordered[0].shape[0])
+            ts = np.full(n, int(ts[0]), dtype=np.int64)
+        out.append(fp.encode_data(ts, ordered))
+        return b"".join(out)
+
+
+class FrameClient:
+    """Base wire client: HELLO negotiation, credit accounting, batch
+    sends, PING/ACK barrier.  Subclasses supply _send/_recv_frame."""
+
+    def __init__(self, app: Optional[str], stream: str, cols: list,
+                 credit: bool = True):
+        str_cols = {name for name, t in cols if t == "string"}
+        self.app = app
+        self.stream = stream
+        self.enc = _FrameEncoder(stream, cols, str_cols)
+        self._synced = 1                # peer has mapped codes < this:
+        #                                 advanced only AFTER a send
+        #                                 succeeds, so a failed send's
+        #                                 lost STRINGS delta is re-shipped
+        #                                 by the next batch instead of
+        #                                 desyncing the dictionary forever
+        self.want_credit = credit
+        self.credit = 0                 # frames we may send before blocking
+        self.frames_sent = 0
+        self.events_sent = 0
+        self.bytes_sent = 0
+        self._acks: dict = {}
+        self._next_token = 1
+
+    @classmethod
+    def cols_of_schema(cls, schema) -> list:
+        return _schema_cols(schema)
+
+    # -- subclass surface ---------------------------------------------------
+
+    def _send(self, data: bytes) -> None:
+        raise NotImplementedError
+
+    def _recv_frame(self, timeout: Optional[float]):
+        """(ftype, payload) or None on timeout; None-able backchannel
+        (ring) returns None always."""
+        raise NotImplementedError
+
+    # -- protocol -----------------------------------------------------------
+
+    def hello(self, timeout: float = 5.0) -> None:
+        self._synced = 1                # (re-)negotiation resets the
+        #                                 server-side remap: re-ship all
+        self._send(fp.encode_hello(self.app or "", self.stream,
+                                   self.enc.cols, credit=self.want_credit))
+        deadline = time.monotonic() + timeout
+        while True:
+            f = self._recv_frame(max(0.001, deadline - time.monotonic()))
+            if f is None:
+                # a partial read returns None with time still on the
+                # clock (e.g. HELLO_OK split across TCP segments): only
+                # the deadline itself fails the negotiation
+                if time.monotonic() >= deadline:
+                    raise NetClientError("HELLO timed out")
+                continue
+            ftype, payload = f
+            if payload is None:         # CRC-rejected frame: wait on
+                continue                # for an intact reply
+            if ftype == fp.HELLO_OK:
+                self.credit = json.loads(payload).get("credit", 0) or 0
+                if not self.want_credit:
+                    self.credit = 0
+                elif self.credit <= 0:
+                    # the server negotiated credit OFF (credit='0'):
+                    # waiting for CREDIT frames would deadlock
+                    self.want_credit = False
+                return
+            if ftype == fp.ERROR:
+                raise NetClientError(json.loads(payload)["error"])
+
+    def send_batch(self, columns: dict, timestamps) -> None:
+        """Encode + ship one columnar batch (strings as str arrays —
+        dictionary codes are connection-local, never caller-visible)."""
+        blob = self.enc.encode_batch(columns, timestamps,
+                                     synced=self._synced)
+        self._respect_credit()
+        self._send(blob)
+        self._synced = len(self.enc.strings)
+        self.frames_sent += 1
+        self.bytes_sent += len(blob)
+        self.events_sent += _batch_rows(columns, timestamps)
+
+    def barrier(self, timeout: float = 30.0) -> None:
+        """PING/ACK round trip: returns once everything sent before it
+        has been admitted, fed, and flushed server-side."""
+        token = self._next_token
+        self._next_token += 1
+        self._send(fp.encode_ping(token))
+        deadline = time.monotonic() + timeout
+        while token not in self._acks:
+            f = self._recv_frame(max(0.001, deadline - time.monotonic()))
+            if f is not None:
+                self._on_control(*f)
+            elif time.monotonic() >= deadline:
+                raise NetClientError("barrier timed out")
+        del self._acks[token]
+
+    def close(self) -> None:
+        try:
+            self._send(fp.encode_frame(fp.BYE))
+        except Exception:
+            pass
+
+    # -- credit accounting --------------------------------------------------
+
+    def _respect_credit(self, timeout: float = 30.0) -> None:
+        if not self.want_credit:
+            return
+        self._drain_control()
+        deadline = time.monotonic() + timeout
+        while self.credit <= 0:
+            f = self._recv_frame(max(0.001, deadline - time.monotonic()))
+            if f is not None:
+                self._on_control(*f)
+            elif time.monotonic() >= deadline:
+                raise NetClientError(
+                    "no credit from server (backpressured) for "
+                    f"{timeout:.0f}s")
+        self.credit -= 1
+
+    def _drain_control(self) -> None:
+        while True:
+            f = self._recv_frame(0.0)
+            if f is None:
+                return
+            self._on_control(*f)
+
+    def _on_control(self, ftype: int, payload) -> None:
+        if payload is None:             # CRC-rejected reply frame: skip
+            return                      # (the next CREDIT/ACK re-syncs)
+        if ftype == fp.CREDIT:
+            self.credit += fp.decode_i64(payload)
+        elif ftype == fp.ACK:
+            self._acks[fp.decode_u64(payload)] = True
+        elif ftype == fp.ERROR:
+            raise NetClientError(json.loads(payload)["error"])
+
+
+class TcpFrameClient(FrameClient):
+    """Raw-TCP frame client.  Receives are buffer-based: a timeout
+    mid-frame keeps the partial bytes, so control frames can never
+    desync the stream."""
+
+    def __init__(self, host: str, port: int, stream: str, cols: list,
+                 app: Optional[str] = None, credit: bool = True,
+                 connect_timeout: float = 5.0):
+        super().__init__(app, stream, cols, credit)
+        self.sock = socket.create_connection((host, port),
+                                             timeout=connect_timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._rbuf = bytearray()        # append-in-place: O(1) amortized
+        self._fq: list = []
+        self.hello()
+
+    def _send(self, data: bytes) -> None:
+        self.sock.sendall(data)
+
+    def _recv_frame(self, timeout: Optional[float]):
+        if self._fq:
+            return self._fq.pop(0)
+        self.sock.settimeout(
+            timeout if timeout is None or timeout > 0 else 0.000001)
+        try:
+            b = self.sock.recv(1 << 16)
+            if not b:
+                raise EOFError("connection closed")
+            self._rbuf += b
+            self._fq.extend(fp.parse_buffer_inplace(self._rbuf))
+        except (socket.timeout, BlockingIOError):
+            pass
+        finally:
+            self.sock.settimeout(None)
+        return self._fq.pop(0) if self._fq else None
+
+    def close(self) -> None:
+        super().close()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class WsFrameClient(FrameClient):
+    """WebSocket frame client (RFC-6455 client side, binary messages).
+    Connects to the same NetServer port — the server sniffs the
+    upgrade."""
+
+    def __init__(self, host: str, port: int, stream: str, cols: list,
+                 app: Optional[str] = None, credit: bool = True,
+                 connect_timeout: float = 5.0):
+        super().__init__(app, stream, cols, credit)
+        self.sock = socket.create_connection((host, port),
+                                             timeout=connect_timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._buf = bytearray()
+        self._handshake(host, port)
+        self.hello()
+
+    def _handshake(self, host: str, port: int) -> None:
+        key = base64.b64encode(os.urandom(16)).decode()
+        self.sock.sendall(
+            (f"GET /siddhi/data HTTP/1.1\r\nHost: {host}:{port}\r\n"
+             f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+             f"Sec-WebSocket-Key: {key}\r\n"
+             f"Sec-WebSocket-Version: 13\r\n\r\n").encode())
+        hdr = b""
+        while b"\r\n\r\n" not in hdr:
+            b = self.sock.recv(4096)
+            if not b:
+                raise NetClientError("websocket handshake failed (EOF)")
+            hdr += b
+        head, _, rest = hdr.partition(b"\r\n\r\n")
+        status = head.split(b"\r\n", 1)[0]
+        if b" 101 " not in status:
+            raise NetClientError("websocket handshake rejected: "
+                                 + status.decode("latin1"))
+        self._buf = bytearray(rest)
+
+    # ws client frames MUST be masked
+    def _send(self, data: bytes) -> None:
+        mask = os.urandom(4)
+        n = len(data)
+        if n < 126:
+            hdr = bytes([0x82, 0x80 | n])
+        elif n < (1 << 16):
+            hdr = bytes([0x82, 0x80 | 126]) + struct.pack(">H", n)
+        else:
+            hdr = bytes([0x82, 0x80 | 127]) + struct.pack(">Q", n)
+        arr = np.frombuffer(data, dtype=np.uint8)
+        m = np.frombuffer((mask * ((n + 3) // 4))[:n], dtype=np.uint8)
+        self.sock.sendall(hdr + mask + (arr ^ m).tobytes())
+
+    def _recv_frame(self, timeout: Optional[float]):
+        """Read one ws message, parse the protocol frame inside.
+        Buffer-based: a timeout mid-message keeps the partial bytes."""
+        while True:
+            got = fp.parse_ws_frame_inplace(self._buf)
+            if got is None:
+                self.sock.settimeout(
+                    timeout if timeout is None or timeout > 0 else 0.000001)
+                try:
+                    b = self.sock.recv(1 << 16)
+                    if not b:
+                        raise EOFError("websocket closed")
+                    self._buf += b
+                except (socket.timeout, BlockingIOError):
+                    return None
+                finally:
+                    self.sock.settimeout(None)
+                continue
+            opcode, body = got
+            if opcode == 0x8:
+                raise EOFError("websocket closed")
+            if opcode in (0x9, 0xA):        # ping/pong: ignore
+                continue
+            frames, rest = fp.parse_buffer(body)
+            if rest or len(frames) != 1:
+                raise fp.FrameError("ws message is not one whole frame")
+            return frames[0]
+
+    def close(self) -> None:
+        super().close()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class RingProducer(FrameClient):
+    """Shared-memory producer: same frames, no backchannel — the ring's
+    occupancy IS the backpressure (push blocks when full), and
+    `barrier()` waits for the consumer to drain the ring."""
+
+    def __init__(self, ring_name: str, stream: str, cols: list,
+                 app: Optional[str] = None, push_timeout: float = 30.0):
+        super().__init__(app, stream, cols, credit=False)
+        self.ring = ShmRing.attach(ring_name)
+        self.push_timeout = push_timeout
+        self._send(fp.encode_hello(app or "", stream, cols, credit=False))
+
+    def _send(self, data: bytes) -> None:
+        if not self.ring.push(data, timeout=self.push_timeout):
+            raise NetClientError(
+                f"ring {self.ring.name!r} full for "
+                f"{self.push_timeout:.0f}s (slow consumer)")
+
+    def _recv_frame(self, timeout):
+        return None
+
+    def send_batch(self, columns: dict, timestamps) -> None:
+        blob = self.enc.encode_batch(columns, timestamps,
+                                     synced=self._synced)
+        if len(blob) > self.ring.capacity:
+            # split: a batch larger than one slot ships as several
+            # frames.  The oversize blob already registered this batch's
+            # new strings in the encoder, so its STRINGS delta MUST ship
+            # first (the re-encoded row-range parts won't re-declare
+            # them) — each delta frame rides its own slot.
+            self._send_split(blob, columns, timestamps)
+            return
+        self._send(blob)
+        self._synced = len(self.enc.strings)
+        self.frames_sent += 1
+        self.bytes_sent += len(blob)
+        self.events_sent += _batch_rows(columns, timestamps)
+
+    def _send_split(self, blob: bytes, columns: dict, timestamps) -> None:
+        for ftype, payload in fp.parse_buffer(blob)[0]:
+            if ftype != fp.STRINGS:
+                continue
+            delta = fp.encode_frame(ftype, payload)
+            if len(delta) > self.ring.capacity:
+                raise NetClientError(
+                    f"STRINGS delta ({len(delta)} bytes) exceeds ring "
+                    f"slot capacity {self.ring.capacity}; raise slot.size")
+            self._send(delta)
+            self.bytes_sent += len(delta)
+        self._synced = len(self.enc.strings)    # deltas are in the ring
+        ts = np.asarray(timestamps, dtype=np.int64)
+        n = int(ts.shape[0])
+        row_bytes = max(1, sum(np.asarray(v).dtype.itemsize if
+                               np.asarray(v).dtype.kind != "U" else 4
+                               for v in columns.values()) + 8)
+        per = max(1, (self.ring.capacity - 1024) // row_bytes)
+        for lo in range(0, n, per):
+            hi = min(n, lo + per)
+            part = {k: np.asarray(v)[lo:hi] for k, v in columns.items()}
+            # the delta already shipped: these re-encodes are DATA-only
+            part_blob = self.enc.encode_batch(part, ts[lo:hi])
+            self._send(part_blob)
+            self.frames_sent += 1
+            self.bytes_sent += len(part_blob)
+            self.events_sent += hi - lo
+
+    def barrier(self, timeout: float = 30.0) -> None:
+        if not self.ring.join(timeout=timeout):
+            raise NetClientError("ring drain barrier timed out")
+
+    def close(self) -> None:
+        super().close()
+        self.ring.close()
+
+
+# ---------------------------------------------------------------------------
+# egress receiver (sink counterpart; tests + bench)
+# ---------------------------------------------------------------------------
+
+class FrameReceiver:
+    """Tiny frame-protocol receiver: accepts connections, answers
+    HELLO/PING, decodes STRINGS + DATA frames into (stream, rows)
+    batches.  The consuming end of `@sink(type='tcp')`."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 fail_first: int = 0):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(8)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self.batches: list = []         # (stream, [(ts, row), ...])
+        self.frames = 0
+        self.strings_frames = 0         # dictionary deltas received
+        self._fail_first = fail_first   # refuse N connections (tests)
+        self._stop = threading.Event()
+        self._threads: list = []
+        self._lock = threading.Lock()
+        self._accept = threading.Thread(target=self._accept_loop,
+                                        name="frame-receiver", daemon=True)
+        self._accept.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._sock.accept()
+            except OSError:
+                return
+            if self._fail_first > 0:
+                self._fail_first -= 1
+                sock.close()
+                continue
+            t = threading.Thread(target=self._serve, args=(sock,),
+                                 daemon=True)
+            self._threads.append(t)
+            t.start()
+
+    def _serve(self, sock: socket.socket) -> None:
+        from types import SimpleNamespace
+        from ..core.batch import rows_of_columns
+        from ..core.schema import StreamSchema
+        from ..query.ast import Attribute, AttrType
+        read = fp.reader_for(sock)
+        strings = [None]                # connection dictionary
+        schema = None                   # decode via fp.decode_data —
+        stream_id = ""                  # ONE wire-walk implementation
+        try:
+            while not self._stop.is_set():
+                ftype, payload = fp.read_frame(read)
+                if ftype == fp.HELLO:
+                    h = fp.decode_hello(payload)
+                    stream_id = h["stream"]
+                    schema = StreamSchema(stream_id, tuple(
+                        Attribute(str(c[0]), AttrType[str(c[1]).upper()])
+                        for c in h["cols"]))
+                    sock.sendall(fp.encode_hello_ok(0))
+                elif ftype == fp.STRINGS:
+                    start, new = fp.decode_strings(payload)
+                    if start > len(strings):
+                        raise fp.FrameError("STRINGS delta gap")
+                    strings[start:start + len(new)] = new
+                    with self._lock:
+                        self.strings_frames += 1
+                elif ftype == fp.DATA:
+                    if schema is None:
+                        raise fp.FrameError("DATA before HELLO")
+                    ts, cols = fp.decode_data(payload, schema)
+                    rows = rows_of_columns(
+                        schema, ts, cols, SimpleNamespace(_to_str=strings))
+                    with self._lock:
+                        self.frames += 1
+                        self.batches.append((stream_id, rows))
+                elif ftype == fp.PING:
+                    sock.sendall(fp.encode_ack(fp.decode_u64(payload)))
+                elif ftype == fp.BYE:
+                    return
+        except (EOFError, ConnectionError, OSError, fp.FrameError):
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def rows(self, stream: Optional[str] = None) -> list:
+        with self._lock:
+            return [r for sid, rows in self.batches
+                    for r in rows if stream is None or sid == stream]
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for t in self._threads:
+            t.join(timeout=2)

@@ -1,0 +1,528 @@
+"""Columnar wire frame protocol — the serving plane's binary transport.
+
+Replaces per-event JSON-over-HTTP with length-prefixed binary frames
+whose DATA payloads are raw little-endian column buffers decoded
+straight into numpy views (`np.frombuffer`) and fed to
+`BatchBuilder.append_columnar` with zero per-event Python.  The same
+frame bytes ride TCP, WebSocket binary messages, and the shared-memory
+ring (net/ring.py) unchanged.
+
+Frame layout (all integers little-endian):
+
+    offset  size  field
+    0       2     magic   0x5346  ("SF")
+    2       1     version (1)
+    3       1     type    (FrameType)
+    4       4     payload length N
+    8       N     payload
+    8+N     4     CRC32 of payload (zlib.crc32)
+
+Frame types and payloads:
+
+    HELLO (1), client->server, JSON: {"app", "stream",
+        "cols": [[name, type], ...], "credit": bool}.  Schema is
+        negotiated ONCE per connection: names/types must match the
+        stream definition in order; every later DATA frame is raw
+        buffers with no per-frame schema.
+    HELLO_OK (2), server->client, JSON: {"ok": true, "credit": int}.
+    DATA (3): u32 n_rows, then the int64 timestamp column
+        (n_rows * 8 bytes), then each schema column's raw buffer in
+        declaration order (string columns as int32 CONNECTION-LOCAL
+        dictionary codes — see STRINGS).
+    STRINGS (4): string-table delta — u32 start_code, u32 count, then
+        per string u16 utf-8 byte length + bytes; the first string
+        holds `start_code`, the rest follow sequentially.  Codes are
+        assigned from 1 upward on both ends (code 0 is reserved for
+        null, mirroring schema.StringTable); the explicit start makes
+        re-sent deltas idempotent and lost-delta gaps loud.  The
+        server remaps connection codes -> runtime StringTable codes
+        with one vectorized gather per DATA frame.
+    CREDIT (5), server->client: i64 additional DATA frames the client
+        may send (explicit backpressure/credit signaling; a server
+        under admission pressure simply stops granting).
+    ACK (6), server->client: u64 token — reply to PING after
+        everything before the PING has been admitted and fed.
+    ERROR (7), server->client, JSON: {"error": "..."}.
+    PING (8), client->server: u64 token (the flush barrier).
+    BYE (9): empty; graceful close.
+
+docs/SERVING.md carries the normative spec with a worked hex example.
+"""
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import Callable
+
+import numpy as np
+
+MAGIC = 0x5346
+VERSION = 1
+HEADER = struct.Struct("<HBBI")          # magic, version, type, payload len
+TRAILER = struct.Struct("<I")            # crc32(payload)
+MAX_PAYLOAD = 64 << 20                   # 64 MiB sanity bound
+
+HELLO = 1
+HELLO_OK = 2
+DATA = 3
+STRINGS = 4
+CREDIT = 5
+ACK = 6
+ERROR = 7
+PING = 8
+BYE = 9
+
+_TYPE_NAMES = {HELLO: "HELLO", HELLO_OK: "HELLO_OK", DATA: "DATA",
+               STRINGS: "STRINGS", CREDIT: "CREDIT", ACK: "ACK",
+               ERROR: "ERROR", PING: "PING", BYE: "BYE"}
+
+
+class FrameError(Exception):
+    """Malformed frame: a payload that does not parse, a rejected
+    HELLO, or a stream desync.  Whether it kills the connection depends
+    on where it surfaces: payload-level errors on a negotiated
+    connection are rejected per-frame (the length prefix was already
+    consumed, so framing stays aligned); desyncs are fatal."""
+
+
+class FrameDesync(FrameError):
+    """Bad magic/version/oversized length: the byte stream can no
+    longer be trusted at all — connection-fatal."""
+
+
+def type_name(t: int) -> str:
+    return _TYPE_NAMES.get(t, f"type{t}")
+
+
+# ---------------------------------------------------------------------------
+# encode
+# ---------------------------------------------------------------------------
+
+def encode_frame(ftype: int, payload: bytes = b"") -> bytes:
+    """One complete frame: header + payload + crc trailer."""
+    return (HEADER.pack(MAGIC, VERSION, ftype, len(payload)) + payload
+            + TRAILER.pack(zlib.crc32(payload) & 0xFFFFFFFF))
+
+
+def encode_hello(app: str, stream: str, cols: list, credit: bool = True) -> bytes:
+    """cols: [(name, type_name), ...] in declaration order; type names
+    are the SiddhiQL attribute types ("string", "double", ...)."""
+    return encode_frame(HELLO, json.dumps(
+        {"app": app, "stream": stream, "cols": [list(c) for c in cols],
+         "credit": bool(credit)}).encode())
+
+
+def encode_hello_ok(credit: int) -> bytes:
+    return encode_frame(HELLO_OK, json.dumps(
+        {"ok": True, "credit": int(credit)}).encode())
+
+
+def encode_error(message: str) -> bytes:
+    return encode_frame(ERROR, json.dumps({"error": message}).encode())
+
+
+def encode_credit(n: int) -> bytes:
+    return encode_frame(CREDIT, struct.pack("<q", int(n)))
+
+
+def encode_ack(token: int) -> bytes:
+    return encode_frame(ACK, struct.pack("<Q", int(token)))
+
+
+def encode_ping(token: int) -> bytes:
+    return encode_frame(PING, struct.pack("<Q", int(token)))
+
+
+def encode_strings(new_strings: list, start_code: int = None) -> bytes:
+    """String-table delta frame; `new_strings` in code-assignment
+    order, the first holding code `start_code`.  The explicit start
+    makes deltas idempotent: a re-sent (full-table or overlapping)
+    delta overwrites the same positions, and a GAP — a delta whose
+    predecessor was lost — fails loudly instead of silently remapping
+    every later code."""
+    if start_code is None:
+        start_code = 1
+    parts = [struct.pack("<II", int(start_code), len(new_strings))]
+    for s in new_strings:
+        b = s.encode()
+        if len(b) > 0xFFFF:
+            raise FrameError(f"string too long for wire ({len(b)} bytes)")
+        parts.append(struct.pack("<H", len(b)))
+        parts.append(b)
+    return encode_frame(STRINGS, b"".join(parts))
+
+
+def encode_data(timestamps: np.ndarray, columns: list) -> bytes:
+    """DATA frame from an int64 timestamp array + schema-ordered column
+    arrays (strings already encoded to int32 connection codes).  One
+    `tobytes` per column — no per-event work."""
+    ts = np.ascontiguousarray(timestamps, dtype="<i8")
+    n = int(ts.shape[0])
+    parts = [struct.pack("<I", n), ts.tobytes()]
+    for col in columns:
+        arr = np.ascontiguousarray(col)
+        if arr.shape[0] != n:
+            raise FrameError(f"column has {arr.shape[0]} rows, expected {n}")
+        parts.append(arr.astype(arr.dtype.newbyteorder("<"),
+                                copy=False).tobytes())
+    return encode_frame(DATA, b"".join(parts))
+
+
+# ---------------------------------------------------------------------------
+# decode
+# ---------------------------------------------------------------------------
+
+def read_frame(read_exact: Callable[[int], bytes]) -> tuple:
+    """Read one frame from a byte stream.  `read_exact(n)` must return
+    exactly n bytes or raise EOFError/ConnectionError.  Returns
+    (ftype, payload bytes); raises FrameError on protocol violations."""
+    hdr = read_exact(HEADER.size)
+    magic, ver, ftype, n = HEADER.unpack(hdr)
+    if magic != MAGIC:
+        raise FrameDesync(f"bad magic 0x{magic:04x} (want 0x{MAGIC:04x})")
+    if ver != VERSION:
+        raise FrameDesync(f"unsupported protocol version {ver}")
+    if n > MAX_PAYLOAD:
+        raise FrameDesync(f"oversized payload ({n} bytes)")
+    payload = read_exact(n) if n else b""
+    (crc,) = TRAILER.unpack(read_exact(TRAILER.size))
+    if crc != (zlib.crc32(payload) & 0xFFFFFFFF):
+        raise FrameError(f"checksum mismatch on {type_name(ftype)} frame")
+    return ftype, payload
+
+
+def _scan_frames(view: memoryview) -> tuple:
+    """-> ([(ftype, payload), ...], consumed_offset) over any
+    buffer-like object.  A frame whose CRC fails is returned as
+    (ftype, None) — the length prefix already consumed it whole, so the
+    stream stays aligned and the caller can reject that ONE frame
+    without dropping the connection.  Desyncs (bad magic/version/
+    oversized length) raise FrameDesync: past those, no later length
+    can be trusted."""
+    frames = []
+    off = 0
+    while len(view) - off >= HEADER.size + TRAILER.size:
+        magic, ver, ftype, n = HEADER.unpack_from(view, off)
+        if magic != MAGIC:
+            raise FrameDesync(f"bad magic 0x{magic:04x}")
+        if ver != VERSION:
+            raise FrameDesync(f"unsupported protocol version {ver}")
+        if n > MAX_PAYLOAD:
+            raise FrameDesync(f"oversized payload ({n} bytes)")
+        end = off + HEADER.size + n + TRAILER.size
+        if end > len(view):
+            break
+        payload = bytes(view[off + HEADER.size:off + HEADER.size + n])
+        (crc,) = TRAILER.unpack_from(view, off + HEADER.size + n)
+        if crc != (zlib.crc32(payload) & 0xFFFFFFFF):
+            payload = None              # corrupt but aligned: reject one
+        frames.append((ftype, payload))
+        off = end
+    return frames, off
+
+
+def parse_buffer(buf: bytes) -> tuple:
+    """Parse as many complete frames as `buf` holds.  Returns
+    ([(ftype, payload), ...], leftover_bytes) — the ring/WS path, where
+    input arrives as discrete byte blobs rather than a stream."""
+    view = memoryview(buf)
+    try:
+        frames, off = _scan_frames(view)
+        return frames, bytes(view[off:])
+    finally:
+        view.release()
+
+
+def parse_buffer_inplace(buf: bytearray) -> list:
+    """parse_buffer over an accumulating bytearray: consumed frames are
+    deleted from the FRONT of `buf` in place, and an incomplete tail
+    stays put with NO copy — so socket readers appending 64 KB recv
+    chunks stay O(total) instead of O(total^2) on multi-chunk frames."""
+    view = memoryview(buf)
+    try:
+        frames, off = _scan_frames(view)
+    finally:
+        view.release()      # an exported view blocks bytearray resize
+    if off:
+        del buf[:off]
+    return frames
+
+
+def decode_hello(payload: bytes) -> dict:
+    try:
+        d = json.loads(payload)
+        if not isinstance(d, dict) or "stream" not in d:
+            raise ValueError("missing stream")
+        d.setdefault("app", None)
+        d.setdefault("cols", [])
+        d.setdefault("credit", True)
+        return d
+    except (ValueError, UnicodeDecodeError) as e:
+        raise FrameError(f"bad HELLO payload: {e}") from None
+
+
+def decode_strings(payload: bytes) -> tuple:
+    """-> (start_code, [strings])."""
+    try:
+        start, count = struct.unpack_from("<II", payload, 0)
+        off = 8
+        out = []
+        for _ in range(count):
+            (ln,) = struct.unpack_from("<H", payload, off)
+            off += 2
+            if off + ln > len(payload):
+                raise ValueError("truncated string entry")
+            out.append(payload[off:off + ln].decode())
+            off += ln
+        return start, out
+    except (struct.error, UnicodeDecodeError, ValueError) as e:
+        raise FrameError(f"bad STRINGS payload: {e}") from None
+
+
+def validate_hello_schema(hello: dict, schema) -> None:
+    """Negotiation check: the HELLO's declared columns must match the
+    stream schema by name and type, in order."""
+    want = [(a.name, a.type.name.lower()) for a in schema.attributes]
+    got = [(str(c[0]), str(c[1]).lower()) for c in hello.get("cols", [])]
+    if got != want:
+        raise FrameError(
+            f"schema mismatch for stream {schema.id!r}: client declared "
+            f"{got}, server has {want}")
+
+
+def decode_data(payload: bytes, schema, float64: bool = False) -> tuple:
+    """DATA payload -> (timestamps view, {name: column view}).  Views
+    alias the payload buffer zero-copy (read-only); string columns come
+    back as int32 CONNECTION codes — remap before ingest."""
+    from ..core.schema import dtype_of
+    if len(payload) < 4:
+        raise FrameError("truncated DATA payload")
+    (n,) = struct.unpack_from("<I", payload, 0)
+    off = 4
+    need = 8 * n
+    if off + need > len(payload):
+        raise FrameError("truncated DATA payload (timestamps)")
+    ts = np.frombuffer(payload, dtype="<i8", count=n, offset=off)
+    off += need
+    cols = {}
+    for a in schema.attributes:
+        dt = np.dtype(dtype_of(a.type, float64=float64)).newbyteorder("<")
+        if dt.kind == "O":
+            raise FrameError(
+                f"stream {schema.id!r}: object column {a.name!r} cannot "
+                f"ride the wire")
+        need = dt.itemsize * n
+        if off + need > len(payload):
+            raise FrameError(f"truncated DATA payload (column {a.name!r})")
+        cols[a.name] = np.frombuffer(payload, dtype=dt, count=n, offset=off)
+        off += need
+    if off != len(payload):
+        raise FrameError(f"DATA payload has {len(payload) - off} "
+                         f"trailing bytes")
+    return ts, cols
+
+
+def decode_i64(payload: bytes) -> int:
+    try:
+        return struct.unpack("<q", payload)[0]
+    except struct.error as e:
+        raise FrameError(f"bad credit payload: {e}") from None
+
+
+def decode_u64(payload: bytes) -> int:
+    try:
+        return struct.unpack("<Q", payload)[0]
+    except struct.error as e:
+        raise FrameError(f"bad token payload: {e}") from None
+
+
+# ---------------------------------------------------------------------------
+# connection-local string dictionary (client side + server remap)
+# ---------------------------------------------------------------------------
+
+class WireStringTable:
+    """Client-side connection dictionary: str -> sequential code from 1
+    (0 = null, mirroring schema.StringTable).  `encode_column` returns
+    the int32 code array plus the delta of never-sent strings — the
+    caller ships the delta as ONE STRINGS frame before the DATA frame."""
+
+    def __init__(self):
+        self._to_code: dict = {}
+        self._ordered: list = []        # strings in code order (code i+1)
+        self._n = 1                     # 0 reserved for null
+
+    def __len__(self) -> int:
+        return self._n
+
+    def all_strings(self) -> list:
+        """Every string ever encoded, in code-assignment order — the
+        full-table replay a reconnecting sink ships so already-encoded
+        payloads keep decoding (codes <= len are stable; the peer's
+        remap extends append-only, so re-declared strings are harmless
+        duplicates at higher codes)."""
+        return list(self._ordered)
+
+    def strings_from(self, code: int) -> list:
+        """Strings holding codes >= `code`, in order — the catch-up
+        delta for a peer known to have mapped codes < `code`."""
+        return list(self._ordered[max(0, code - 1):])
+
+    def encode_column(self, values) -> tuple:
+        arr = np.asarray(values)
+        if arr.dtype.kind in "iu":
+            raise FrameError(
+                "wire string columns must be str values, not codes "
+                "(dictionary codes are connection-local)")
+        new: list = []
+        if arr.dtype.kind == "U" and arr.ndim == 1:
+            uniq, first, inv = np.unique(arr, return_index=True,
+                                         return_inverse=True)
+            codes = np.empty(len(uniq), dtype=np.int32)
+            for j in np.argsort(first, kind="stable").tolist():
+                s = str(uniq[j])
+                c = self._to_code.get(s)
+                if c is None:
+                    c = self._to_code[s] = self._n
+                    self._n += 1
+                    self._ordered.append(s)
+                    new.append(s)
+                codes[j] = c
+            return codes[inv], new
+        out = np.empty(len(arr), dtype=np.int32)
+        for i, v in enumerate(arr.tolist()):
+            if v is None:
+                out[i] = 0
+                continue
+            c = self._to_code.get(v)
+            if c is None:
+                c = self._to_code[v] = self._n
+                self._n += 1
+                self._ordered.append(str(v))
+                new.append(str(v))
+            out[i] = c
+        return out, new
+
+
+class StringRemap:
+    """Server-side: connection code -> runtime StringTable code, applied
+    as one vectorized gather per string column.  Extended under the
+    runtime lock when a STRINGS delta arrives."""
+
+    def __init__(self):
+        self._map = np.zeros(1, dtype=np.int32)     # code 0 -> null (0)
+
+    def __len__(self) -> int:
+        return int(self._map.shape[0])
+
+    def extend(self, start_code: int, new_strings: list, strings) -> None:
+        """Apply a STRINGS delta starting at `start_code`.  `strings` is
+        the runtime's schema.StringTable; caller holds the runtime lock
+        (table writes are shared state).  Overlapping re-declarations
+        overwrite idempotently; a gap (a lost predecessor delta) raises."""
+        if not new_strings:
+            return
+        if start_code > self._map.shape[0]:
+            raise FrameError(
+                f"STRINGS delta starts at code {start_code} but only "
+                f"{self._map.shape[0]} codes are mapped (lost delta?)")
+        add = np.fromiter((strings.encode(s) for s in new_strings),
+                          dtype=np.int32, count=len(new_strings))
+        end = start_code + len(new_strings)
+        if end > self._map.shape[0]:
+            self._map = np.concatenate(
+                [self._map, np.zeros(end - self._map.shape[0],
+                                     dtype=np.int32)])
+        self._map[start_code:end] = add
+
+    def apply(self, codes: np.ndarray) -> np.ndarray:
+        arr = np.asarray(codes)
+        if arr.size and (int(arr.max(initial=0)) >= self._map.shape[0]
+                         or int(arr.min(initial=0)) < 0):
+            raise FrameError(
+                "DATA frame references string codes never declared in a "
+                "STRINGS delta (out-of-order frames?)")
+        return self._map[arr.astype(np.int64, copy=False)]
+
+
+def _scan_ws_frame(buf) -> tuple:
+    """One complete RFC-6455 frame from the front of `buf` ->
+    (opcode, body_bytes, end_offset), or None while incomplete —
+    nothing is consumed until whole, so a read timeout mid-frame can
+    never desync the stream.  Unmasks when the mask bit is set."""
+    if len(buf) < 2:
+        return None
+    opcode = buf[0] & 0x0F
+    masked = bool(buf[1] & 0x80)
+    n = buf[1] & 0x7F
+    off = 2
+    if n == 126:
+        if len(buf) < 4:
+            return None
+        n = struct.unpack_from(">H", buf, 2)[0]
+        off = 4
+    elif n == 127:
+        if len(buf) < 10:
+            return None
+        n = struct.unpack_from(">Q", buf, 2)[0]
+        off = 10
+    if n > MAX_PAYLOAD + 64:
+        # same sanity bound the raw-TCP path enforces on the length
+        # prefix (+ header slack: one ws message wraps one protocol
+        # frame) — without it a peer declaring a 2^40-byte message
+        # grows the receive buffer without limit
+        raise FrameDesync(
+            f"websocket frame of {n} bytes exceeds the "
+            f"{MAX_PAYLOAD >> 20} MiB bound")
+    if masked:
+        if len(buf) < off + 4:
+            return None
+        mask = bytes(buf[off:off + 4])
+        off += 4
+    else:
+        mask = None
+    if len(buf) < off + n:
+        return None
+    body = bytes(buf[off:off + n])
+    if mask and n:
+        arr = np.frombuffer(body, dtype=np.uint8)
+        m = np.frombuffer((mask * ((n + 3) // 4))[:n], dtype=np.uint8)
+        body = (arr ^ m).tobytes()
+    return opcode, body, off + n
+
+
+def parse_ws_frame(buf: bytes):
+    """_scan_ws_frame returning (opcode, body, rest_bytes) — shared by
+    the ws client and the server's ws path."""
+    got = _scan_ws_frame(buf)
+    if got is None:
+        return None
+    opcode, body, end = got
+    return opcode, body, buf[end:]
+
+
+def parse_ws_frame_inplace(buf: bytearray):
+    """parse_ws_frame over an accumulating bytearray: the consumed
+    message is deleted from the front in place (no tail copy) ->
+    (opcode, body) or None while incomplete."""
+    got = _scan_ws_frame(buf)
+    if got is None:
+        return None
+    opcode, body, end = got
+    del buf[:end]
+    return opcode, body
+
+
+def reader_for(sock) -> Callable[[int], bytes]:
+    """`read_exact` over a socket for read_frame()."""
+    def read_exact(n: int) -> bytes:
+        chunks = []
+        left = n
+        while left:
+            b = sock.recv(left)
+            if not b:
+                raise EOFError("connection closed mid-frame")
+            chunks.append(b)
+            left -= len(b)
+        return b"".join(chunks)
+    return read_exact

@@ -1,0 +1,656 @@
+"""Threaded frame server — the serving plane's ingest front door.
+
+One `NetServer` accepts both raw-TCP frame streams and WebSocket
+connections on the SAME port (the first bytes are sniffed: an HTTP
+`GET ` upgrade request takes the RFC-6455 path, anything else is the
+raw frame protocol), and can additionally consume shared-memory rings
+(net/ring.py) — all three transports funnel through one per-connection
+state machine:
+
+    HELLO       -> resolve (app, stream), validate schema, HELLO_OK
+    STRINGS     -> extend the connection's code remap (runtime lock)
+    DATA        -> decode to numpy views, remap string codes (one
+                   gather), admission-control, rt.send_columnar —
+                   zero per-event Python on the admit path
+    PING        -> feed+flush everything admitted, reply ACK (barrier)
+    BYE / EOF   -> close
+
+Admission decisions come from the per-stream AdmissionController
+(net/admission.py) shared across every transport feeding the stream.
+A 'block' decision stalls THIS reader thread — the socket stops
+draining, which is kernel backpressure to the producer — and the
+server stops granting CREDIT until feeding resumes.
+
+Deploy/undeploy racing live ingest: `retire(app)` flips the runtime
+into a parked state under the feed gate, so a frame is either fully
+fed to the live runtime or captured whole into the app's ErrorStore
+('net.undeployed') — never dropped, never half-delivered.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Optional
+
+from . import frame as fp
+from .admission import ADMIT, AdmissionController, Work
+from .ring import ShmRing
+
+_WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+# ---------------------------------------------------------------------------
+# byte-stream adapters
+# ---------------------------------------------------------------------------
+
+class SockStream:
+    """Buffered reader with pushback over a socket, so protocol
+    sniffing can un-read the bytes it peeked."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self._buf = bytearray()         # append-in-place: O(1) amortized
+
+    def push_back(self, data: bytes) -> None:
+        self._buf[:0] = data
+
+    def read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            b = self.sock.recv(max(4096, n - len(self._buf)))
+            if not b:
+                raise EOFError("connection closed mid-frame")
+            self._buf += b
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+    def read_line(self, limit: int = 8192) -> bytes:
+        while b"\n" not in self._buf:
+            if len(self._buf) > limit:
+                raise fp.FrameError("oversized header line")
+            b = self.sock.recv(4096)
+            if not b:
+                raise EOFError("connection closed in headers")
+            self._buf += b
+        i = self._buf.index(b"\n")
+        line = bytes(self._buf[:i])
+        del self._buf[:i + 1]
+        return line.rstrip(b"\r")
+
+    def write(self, data: bytes) -> None:
+        self.sock.sendall(data)
+
+
+class TcpWire:
+    """Buffer-based frame receive over raw TCP: a read timeout mid-frame
+    keeps the partial bytes in the buffer, so a slow producer can NEVER
+    desync the stream (the old read_exact-per-frame approach discarded
+    an already-consumed header when the payload stalled)."""
+
+    def __init__(self, stream: SockStream):
+        self.sock = stream.sock
+        self._buf = stream._buf         # adopt any sniffed leftovers
+        stream._buf = bytearray()
+
+    def write(self, data: bytes) -> None:
+        self.sock.sendall(data)
+
+    def poll(self) -> list:
+        """Complete frames available now (possibly []); raises
+        EOFError/OSError when the connection dies.  Blocks at most one
+        socket-timeout interval."""
+        frames = fp.parse_buffer_inplace(self._buf)
+        if frames:
+            return frames
+        try:
+            b = self.sock.recv(1 << 16)
+        except socket.timeout:
+            return []
+        if not b:
+            raise EOFError("connection closed")
+        self._buf += b
+        return fp.parse_buffer_inplace(self._buf)
+
+
+class WsWire:
+    """RFC-6455 server side, buffer-based like TcpWire: complete ws
+    messages are unwrapped into a byte stream, complete protocol frames
+    parsed out of it; partial data at any layer just waits in its
+    buffer.  Writes wrap each protocol frame in one unmasked binary
+    message."""
+
+    def __init__(self, stream: SockStream):
+        self.sock = stream.sock
+        self._ws_buf = stream._buf      # raw bytes (possibly mid-message)
+        stream._buf = bytearray()
+        self._stream_buf = bytearray()  # unwrapped protocol bytes
+
+    def write_ws(self, opcode: int, payload: bytes) -> None:
+        n = len(payload)
+        if n < 126:
+            hdr = bytes([0x80 | opcode, n])
+        elif n < (1 << 16):
+            hdr = bytes([0x80 | opcode, 126]) + struct.pack(">H", n)
+        else:
+            hdr = bytes([0x80 | opcode, 127]) + struct.pack(">Q", n)
+        self.sock.sendall(hdr + payload)
+
+    def write(self, data: bytes) -> None:
+        self.write_ws(0x2, data)
+
+    def _unwrap(self) -> None:
+        while True:
+            got = fp.parse_ws_frame_inplace(self._ws_buf)
+            if got is None:
+                return
+            opcode, body = got
+            if opcode == 0x8:                 # close
+                raise EOFError("websocket closed")
+            if opcode == 0x9:                 # ping -> pong
+                self.write_ws(0xA, body)
+            elif opcode != 0xA:               # binary/text/continuation
+                self._stream_buf += body
+
+    def poll(self) -> list:
+        self._unwrap()
+        frames = fp.parse_buffer_inplace(self._stream_buf)
+        if frames:
+            return frames
+        try:
+            b = self.sock.recv(1 << 16)
+        except socket.timeout:
+            return []
+        if not b:
+            raise EOFError("websocket closed")
+        self._ws_buf += b
+        self._unwrap()
+        return fp.parse_buffer_inplace(self._stream_buf)
+
+
+def ws_handshake(stream: SockStream, first_line: bytes) -> WsWire:
+    """Complete the server side of an RFC-6455 upgrade; `first_line` is
+    the already-read request line."""
+    key = None
+    while True:
+        line = stream.read_line()
+        if not line:
+            break
+        k, _, v = line.decode("latin1").partition(":")
+        if k.strip().lower() == "sec-websocket-key":
+            key = v.strip()
+    if key is None:
+        raise fp.FrameError("websocket upgrade without Sec-WebSocket-Key")
+    accept = base64.b64encode(
+        hashlib.sha1((key + _WS_GUID).encode()).digest()).decode()
+    stream.write(
+        b"HTTP/1.1 101 Switching Protocols\r\n"
+        b"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+        b"Sec-WebSocket-Accept: " + accept.encode() + b"\r\n\r\n")
+    return WsWire(stream)
+
+
+# ---------------------------------------------------------------------------
+# per-connection state machine
+# ---------------------------------------------------------------------------
+
+class Connection:
+    """One negotiated ingest connection (TCP, WS, or ring)."""
+
+    def __init__(self, server: "NetServer", label: str,
+                 send: Optional[Callable[[bytes], None]] = None):
+        self.server = server
+        self.label = label
+        self.send = send                # None: no backchannel (ring)
+        self.rt = None
+        self.stream_id: Optional[str] = None
+        self.schema = None
+        self.ctrl: Optional[AdmissionController] = None
+        self.remap = fp.StringRemap()
+        self.credit_chunk = 0
+        self._since_credit = 0
+        self._str_cols: list = []
+        self.frames = 0
+        self.events = 0
+
+    # -- frame dispatch -----------------------------------------------------
+
+    def on_frame(self, ftype: int, payload: bytes) -> bool:
+        """Handle one frame; returns False when the connection should
+        close."""
+        if ftype == fp.BYE:
+            return False
+        if ftype == fp.HELLO:
+            self._on_hello(fp.decode_hello(payload))
+            return True
+        if self.rt is None:
+            raise fp.FrameError(
+                f"{fp.type_name(ftype)} before HELLO on {self.label}")
+        if ftype == fp.STRINGS:
+            start, new = fp.decode_strings(payload)
+            with self.rt._lock:         # StringTable writes are shared
+                self.remap.extend(start, new, self.rt.strings)
+            return True
+        if ftype == fp.DATA:
+            self._on_data(payload)
+            return True
+        if ftype == fp.PING:
+            token = fp.decode_u64(payload)
+            self.pump()
+            self.rt.flush()
+            self._reply(fp.encode_ack(token))
+            return True
+        raise fp.FrameError(
+            f"unexpected {fp.type_name(ftype)} frame on {self.label}")
+
+    def _on_hello(self, hello: dict) -> None:
+        try:
+            rt, ctrl = self.server.resolve(hello.get("app"), hello["stream"])
+        except KeyError as e:
+            # unknown app/stream: a protocol-level rejection (ERROR
+            # frame + close), not a server-side crash
+            raise fp.FrameError(str(e).strip("'\"")) from None
+        schema = rt.schemas.get(hello["stream"])
+        if schema is None:
+            raise fp.FrameError(f"unknown stream {hello['stream']!r}")
+        fp.validate_hello_schema(hello, schema)
+        if self.rt is not None:
+            # re-negotiation: the remap ties THIS connection's string
+            # codes to the previously bound runtime's table, so it is
+            # stale either way — the peer must re-ship its dictionary
+            # (explicit start codes make the replay idempotent; a
+            # continuation without one trips the delta-gap check loudly
+            # instead of ingesting wrong strings), and credit
+            # accounting restarts with the new negotiation
+            self.remap = fp.StringRemap()
+            self._since_credit = 0
+        self.rt, self.schema, self.ctrl = rt, schema, ctrl
+        self.stream_id = hello["stream"]
+        from ..query.ast import AttrType
+        self._str_cols = [a.name for a in schema.attributes
+                          if a.type == AttrType.STRING]
+        self.credit_chunk = self.server.credit if hello.get("credit") else 0
+        self._reply(fp.encode_hello_ok(self.credit_chunk))
+
+    def _on_data(self, payload: bytes) -> None:
+        rt = self.rt
+        try:
+            rt.inject("net.decode", self.stream_id)
+        except Exception as e:
+            # injected decode fault: connection-fatal like a corrupt
+            # frame off the wire (faults.py POINTS) — mapped so the
+            # serve loop accounts a protocol error instead of the
+            # RuntimeError escaping and killing the thread unhandled
+            raise fp.FrameDesync(f"decode fault: {e}") from e
+        ts, cols = fp.decode_data(payload, self.schema)
+        for name in self._str_cols:     # one gather per string column
+            cols[name] = self.remap.apply(cols[name])
+        n = int(ts.shape[0])
+        self.frames += 1
+        self.events += n
+        work = self.server.make_work(rt, self.stream_id, self.schema,
+                                     ts, cols, len(payload))
+        d = self.ctrl.submit(work, stop=self.server.stopping)
+        for w in d.ready:
+            # guarded: queued work is mixed-provenance (REST batches
+            # share the controller and their feeds can raise, e.g. a
+            # type-bad value surfacing at flush) — an exception here
+            # must capture to the ErrorStore, not kill this connection
+            self.ctrl.feed_safely(w)
+        if d.action == ADMIT:
+            work.feed()                 # our own make_work: self-captures
+        self._grant_credit()
+
+    def pump(self) -> None:
+        """Feed any pending ('oldest' policy) work whose tokens
+        refilled — called between frames and on idle ticks."""
+        if self.ctrl is not None:
+            for w in self.ctrl.pump():
+                self.ctrl.feed_safely(w)
+
+    def _grant_credit(self) -> None:
+        if self.send is None or not self.credit_chunk:
+            return
+        self._since_credit += 1
+        if self._since_credit >= max(1, self.credit_chunk // 2):
+            self._reply(fp.encode_credit(self._since_credit))
+            self.server._count(credit_granted=self._since_credit)
+            self._since_credit = 0
+
+    def _reply(self, data: bytes) -> None:
+        if self.send is not None:
+            self.send(data)
+
+
+# ---------------------------------------------------------------------------
+# the server
+# ---------------------------------------------------------------------------
+
+class NetServer:
+    """Threaded TCP/WS frame listener + shm-ring consumers, feeding one
+    or many runtimes through `resolve_fn(app, stream) ->
+    (rt, AdmissionController)`."""
+
+    def __init__(self, resolve_fn: Callable, host: str = "127.0.0.1",
+                 port: int = 0, credit: int = 64, name: str = "siddhi-net",
+                 listen: bool = True):
+        """`listen=False` builds a listener-less server — no TCP socket
+        at all — for transports that only need the connection/feed-gate
+        machinery (shm-ring consumers via attach_ring)."""
+        self._resolve = resolve_fn
+        self.credit = int(credit)
+        self.name = name
+        self._sock = None
+        self.host, self.port = host, None
+        if listen:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._sock.bind((host, int(port)))
+            self._sock.listen(64)
+            # a cross-thread close() does not reliably wake a blocking
+            # accept() on Linux: poll with a short timeout instead, so
+            # stop() always unblocks the accept loop promptly
+            self._sock.settimeout(0.2)
+            self.host, self.port = self._sock.getsockname()[:2]
+        self._stop = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+        self._threads: list = []
+        self._conn_socks: list = []
+        self._rings: list = []          # (ring, thread)
+        self._lock = threading.Lock()
+        # counters (server-level; per-stream counters live on the
+        # AdmissionControllers)
+        self.connections = 0
+        self.open_connections = 0
+        self.ws_connections = 0
+        self.frames_in = 0
+        self.events_in = 0
+        self.bytes_in = 0
+        self.credit_granted = 0
+        self.protocol_errors = 0
+
+    # -- wiring -------------------------------------------------------------
+
+    def resolve(self, app: Optional[str], stream: str):
+        return self._resolve(app, stream)
+
+    def stopping(self) -> bool:
+        return self._stop.is_set()
+
+    def _gate_of(self, rt) -> threading.RLock:
+        """The feed-vs-retire gate for ONE runtime.  It lives ON the
+        runtime (like the retired mark) for two reasons: independent
+        apps served by one front door must not serialize their ingest
+        on a shared lock, and a runtime fed by SEVERAL servers (its own
+        @source port plus the service front door) needs retire() to
+        serialize against every feeder, not just this one."""
+        gate = getattr(rt, "_net_gate", None)
+        if gate is None:
+            with self._lock:
+                gate = getattr(rt, "_net_gate", None)
+                if gate is None:
+                    gate = rt._net_gate = threading.RLock()
+        return gate
+
+    def retire(self, rt) -> None:
+        """Park a runtime (undeploy/redeploy): frames already admitted
+        for THIS runtime land whole in its ErrorStore from now on.  The
+        mark lives ON the runtime object (not in an id-keyed map — a
+        collected runtime's id() could be recycled by a later deploy and
+        silently divert ITS ingest), so a redeploy under the same name
+        serves live through the new runtime while old connections'
+        frames park instead of feeding the zombie.  Serialized against
+        feeds by the runtime's gate — no frame is mid-feed when this
+        returns."""
+        with self._gate_of(rt):
+            rt._net_retired_store = rt.error_store
+
+    def make_work(self, rt, stream_id: str, schema, ts, cols,
+                  nbytes: int) -> Work:
+        from ..core.batch import rows_of_columns
+        gate = self._gate_of(rt)
+
+        def feed(rt=rt, stream_id=stream_id, ts=ts, cols=cols):
+            with gate:
+                store = getattr(rt, "_net_retired_store", None)
+                if store is not None:
+                    store.add(stream_id, "net.undeployed",
+                              "frame admitted before undeploy",
+                              rt.now_ms(),
+                              events=rows_of_columns(schema, ts, cols,
+                                                     rt.strings))
+                    return
+                try:
+                    rt.inject("net.feed", stream_id)
+                    rt.send_columnar(stream_id, cols, ts)
+                except Exception as e:
+                    # an admitted frame must NEVER vanish: capture whole
+                    rt.error_store.add(
+                        stream_id, "net.feed", e, rt.now_ms(),
+                        events=rows_of_columns(schema, ts, cols,
+                                               rt.strings))
+                    rt.stats.on_fault(stream_id, "net.feed")
+
+        return Work(n=int(ts.shape[0]), nbytes=nbytes, feed=feed,
+                    rows=lambda: rows_of_columns(schema, ts, cols,
+                                                 rt.strings),
+                    stream_id=stream_id)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "NetServer":
+        if self._sock is not None:
+            self._accept_thread = threading.Thread(
+                target=self._accept_loop, name=f"{self.name}-accept",
+                daemon=True)
+            self._accept_thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        with self._lock:
+            socks = list(self._conn_socks)
+        for s in socks:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        deadline = time.monotonic() + timeout
+        threads = ([self._accept_thread] if self._accept_thread else []) \
+            + [t for _, t in self._rings] + self._threads
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        for ring, _ in self._rings:
+            ring.close()
+            if ring.owner:
+                ring.unlink()
+        self._rings.clear()
+
+    # -- TCP/WS path --------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, addr = self._sock.accept()
+            except socket.timeout:
+                continue                # poll the stop flag
+            except OSError:
+                return                  # listener closed
+            t = threading.Thread(
+                target=self._serve_conn, args=(sock, addr),
+                name=f"{self.name}-conn", daemon=True)
+            with self._lock:
+                self._conn_socks.append(sock)
+                self._threads = [th for th in self._threads
+                                 if th.is_alive()] + [t]
+            t.start()
+
+    def _count(self, **deltas) -> None:
+        """Counter updates from connection/ring threads — locked, so
+        concurrent producers never lose increments."""
+        with self._lock:
+            for key, d in deltas.items():
+                setattr(self, key, getattr(self, key) + d)
+
+    def _count_frame(self, ftype: int, payload) -> None:
+        if payload is None:             # corrupt frame (CRC rejected)
+            self._count(frames_in=1)
+            return
+        ev = struct.unpack_from("<I", payload, 0)[0] \
+            if ftype == fp.DATA and len(payload) >= 4 else 0
+        self._count(frames_in=1, bytes_in=len(payload), events_in=ev)
+
+    HANDSHAKE_TIMEOUT_S = 10.0
+
+    def _serve_conn(self, sock: socket.socket, addr) -> None:
+        self._count(connections=1, open_connections=1)
+        label = f"{addr[0]}:{addr[1]}"
+        conn: Optional[Connection] = None
+        try:
+            # sniff + ws upgrade get a generous deadline (a pooled
+            # producer may connect before it has data); the frame loop
+            # then drops to short timeouts so idle ticks drive pump()
+            sock.settimeout(self.HANDSHAKE_TIMEOUT_S)
+            stream = SockStream(sock)
+            wire = self._sniff(stream)
+            sock.settimeout(0.2)
+            conn = Connection(self, label, send=wire.write)
+            while not self._stop.is_set():
+                frames = wire.poll()    # buffer-based: a timeout mid-
+                if not frames:          # frame can never desync
+                    conn.pump()
+                    continue
+                for ftype, payload in frames:
+                    self._count_frame(ftype, payload)
+                    if payload is None:
+                        # CRC failure: the frame was consumed whole by
+                        # its length prefix, so the stream is still
+                        # aligned — reject THIS frame, keep serving
+                        self._count(protocol_errors=1)
+                        try:
+                            wire.write(fp.encode_error(
+                                f"checksum mismatch on "
+                                f"{fp.type_name(ftype)} frame (rejected)"))
+                        except OSError:
+                            pass
+                        continue
+                    try:
+                        if not conn.on_frame(ftype, payload):
+                            return
+                    except fp.FrameDesync:
+                        raise
+                    except fp.FrameError as e:
+                        self._count(protocol_errors=1)
+                        try:
+                            wire.write(fp.encode_error(str(e)))
+                        except OSError:
+                            pass
+                        if conn.rt is None or ftype == fp.HELLO:
+                            # no negotiated binding (or a rejected
+                            # re-negotiation): nothing sound can follow
+                            return
+                        # payload-level error on a live binding
+                        # (truncated DATA, bad STRINGS delta, ...):
+                        # framing is intact — drop the frame, carry on
+        except socket.timeout:
+            pass                        # no HELLO within the handshake
+        except (EOFError, ConnectionError, OSError):  # deadline
+            pass                        # disconnects (mid-frame too) are
+        except fp.FrameError:           # normal serving-plane weather
+            self._count(protocol_errors=1)
+        finally:
+            if conn is not None:
+                try:
+                    conn.pump()
+                except Exception:
+                    pass
+            self._count(open_connections=-1)
+            try:
+                sock.close()
+            except OSError:
+                pass
+            with self._lock:
+                if sock in self._conn_socks:
+                    self._conn_socks.remove(sock)
+
+    def _sniff(self, stream: SockStream):
+        head = stream.read_exact(4)
+        if head == b"GET ":
+            self._count(ws_connections=1)
+            first = head + stream.read_line()
+            return ws_handshake(stream, first)
+        stream.push_back(head)
+        return TcpWire(stream)
+
+    # -- shm-ring path ------------------------------------------------------
+
+    def attach_ring(self, ring: ShmRing, label: Optional[str] = None) -> None:
+        """Consume a shared-memory ring on a dedicated thread.  The ring
+        carries the same frames; there is no backchannel, so credit is
+        the ring's own occupancy (a full ring blocks the producer)."""
+        conn = Connection(self, label or f"shm:{ring.name}", send=None)
+
+        def loop():
+            while not self._stop.is_set():
+                data = ring.pop(timeout=0.1)
+                if data is None:
+                    conn.pump()
+                    continue
+                try:
+                    frames, rest = fp.parse_buffer(data)
+                    if rest:
+                        raise fp.FrameError(
+                            "ring slot holds a truncated frame")
+                    for ftype, payload in frames:
+                        self._count_frame(ftype, payload)
+                        if payload is None:     # CRC-rejected frame
+                            self._count(protocol_errors=1)
+                            continue
+                        if not conn.on_frame(ftype, payload):
+                            # BYE ends the PRODUCER, not the ring: the
+                            # consumer outlives it so the next producer
+                            # attaching to the same ring (it re-HELLOs
+                            # to rebind) isn't left pushing into a ring
+                            # nobody drains
+                            conn.pump()
+                except fp.FrameError:
+                    self._count(protocol_errors=1)
+
+        t = threading.Thread(target=loop, name=f"{self.name}-ring",
+                             daemon=True)
+        self._rings.append((ring, t))
+        t.start()
+
+    # -- telemetry ----------------------------------------------------------
+
+    def metrics(self) -> dict:
+        # wire_* are transport-level totals (control frames included);
+        # the per-stream ingest counters live on the AdmissionControllers
+        # under their own frames_in/events_in/bytes_in names
+        m = {**({"port": self.port} if self.port is not None else {}),
+             "connections": self.connections,
+             "open_connections": self.open_connections,
+             "ws_connections": self.ws_connections,
+             "wire_frames": self.frames_in,
+             "wire_events": self.events_in,
+             "wire_bytes": self.bytes_in,
+             "credit_granted": self.credit_granted,
+             "protocol_errors": self.protocol_errors}
+        if self._rings:
+            occ = [r.occupancy() for r, _ in self._rings]
+            m["rings"] = len(self._rings)
+            m["ring_occupancy"] = sum(u for u, _ in occ)
+            m["ring_slots"] = sum(s for _, s in occ)
+        return m

@@ -1,0 +1,155 @@
+"""Network sources — `@source(type='tcp'|'ws'|'shm', ...)`.
+
+    @source(type='tcp', port='0', rate.limit='50000',
+            shed.policy='shed', max.pending='4 MB', credit='64',
+            @map(type='passThrough'))
+    define stream StockStream (symbol string, price double, volume int);
+
+`tcp` starts a NetServer on `port` (0 = ephemeral; the bound port is
+`source.port`) accepting BOTH raw-TCP frame streams and WebSocket
+upgrades — `ws` is an alias kept so apps can document intent.  `shm`
+creates a shared-memory frame ring (`ring.name` to pin the segment
+name, else one derives from app/stream/pid and is exposed as
+`source.ring_name`) and consumes it on a dedicated thread.
+
+All of them register ONE AdmissionController per stream in
+`rt.admission` — the rate limit/shed policy is global to the stream,
+shared with the service front door (service.py) if the app is served.
+
+The mapper SPI does not apply: frames ARE the columnar representation
+(a `@map` annotation other than passThrough is rejected loudly rather
+than silently ignored).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..core.io import PassThroughSourceMapper, Source, register_source_type
+from ..core.planner import PlanError
+from .admission import controller_from_options
+from .ring import ShmRing
+from .server import NetServer
+
+
+class _NetSourceBase(Source):
+    """Shared: admission registration + mapper validation."""
+
+    def _check_mapper(self) -> None:
+        if not isinstance(self.mapper, PassThroughSourceMapper):
+            raise PlanError(
+                f"@source(type={self.options.get('type')!r}) on "
+                f"{self.stream_id!r}: the net plane is columnar — @map "
+                f"is not applicable (frames are decoded straight into "
+                f"arrays); remove the @map annotation")
+
+    def _admission(self):
+        ctrl = self.rt.admission.get(self.stream_id)
+        if ctrl is None:
+            ctrl = controller_from_options(self.stream_id, self.options,
+                                           self.rt)
+            self.rt.admission[self.stream_id] = ctrl
+        return ctrl
+
+    def _resolve(self, app: Optional[str], stream: str):
+        if stream != self.stream_id:
+            from .frame import FrameError
+            raise FrameError(
+                f"this endpoint serves stream {self.stream_id!r}, "
+                f"not {stream!r}")
+        return self.rt, self._admission()
+
+    def net_metrics(self) -> dict:
+        """Transport-level gauges merged into statistics()['net']."""
+        return {}
+
+
+class TcpSource(_NetSourceBase):
+    """Frame server bound to one stream (raw TCP + WebSocket)."""
+
+    def connect(self) -> None:
+        self._check_mapper()
+        self.server = NetServer(
+            self._resolve,
+            host=self.options.get("host", "127.0.0.1"),
+            port=int(self.options.get("port", 0)),
+            credit=int(self.options.get("credit", 64)),
+            name=f"siddhi-net-{self.stream_id}")
+        self._admission()               # register even before any frame
+        self.server.start()
+        self.port = self.server.port
+
+    def disconnect(self) -> None:
+        srv = getattr(self, "server", None)
+        if srv is not None:
+            srv.stop()
+            # pending ('oldest') frames shed to the ErrorStore: nothing
+            # admitted-but-unfed is silently lost at teardown
+            ctrl = self.rt.admission.get(self.stream_id)
+            if ctrl is not None:
+                ctrl.flush_pending_to_store("source disconnected")
+
+    def net_metrics(self) -> dict:
+        srv = getattr(self, "server", None)
+        return {"transport": "tcp", **srv.metrics()} if srv else {}
+
+
+class ShmSource(_NetSourceBase):
+    """Shared-memory ring consumer for co-located producers."""
+
+    def connect(self) -> None:
+        self._check_mapper()
+        name = self.options.get("ring.name") or \
+            f"sid_{self.rt.app.name[:12]}_{self.stream_id[:12]}_{os.getpid()}"
+        self.ring = ShmRing.create(
+            name=name,
+            slots=int(self.options.get("slots", 64)),
+            slot_size=int(self.options.get("slot.size", 256 << 10)))
+        self.ring_name = self.ring.name
+        # listener-less server: only the ring consumer thread and the
+        # Connection/feed-gate machinery — no TCP socket is bound
+        self.server = NetServer(self._resolve, listen=False,
+                                name=f"siddhi-shm-{self.stream_id}")
+        self._admission()
+        self.server.attach_ring(self.ring)
+
+    def disconnect(self) -> None:
+        srv = getattr(self, "server", None)
+        if srv is not None:
+            srv.stop()
+            ctrl = self.rt.admission.get(self.stream_id)
+            if ctrl is not None:
+                ctrl.flush_pending_to_store("source disconnected")
+
+    def net_metrics(self) -> dict:
+        srv = getattr(self, "server", None)
+        return {"transport": "shm", **srv.metrics()} if srv else {}
+
+
+def register() -> None:
+    from ..extension import Example, ExtensionMeta
+    register_source_type("tcp", TcpSource, meta=ExtensionMeta(
+        name="tcp", namespace="source",
+        description="columnar frame ingest over raw TCP or WebSocket "
+                    "(zero per-event Python; docs/SERVING.md)",
+        examples=(Example(
+            "@source(type='tcp', port='0', rate.limit='50000', "
+            "shed.policy='shed') define stream S (sym string, p double);",
+            "frame server on an ephemeral port with a 50k eps "
+            "admission limit shedding into the ErrorStore"),)))
+    register_source_type("ws", TcpSource, meta=ExtensionMeta(
+        name="ws", namespace="source",
+        description="alias of the tcp frame source (the server sniffs "
+                    "WebSocket upgrades on the same port)",
+        examples=(Example(
+            "@source(type='ws', port='8007') "
+            "define stream S (sym string, p double);",
+            "WebSocket producers connect to the same frame port"),)))
+    register_source_type("shm", ShmSource, meta=ExtensionMeta(
+        name="shm", namespace="source",
+        description="shared-memory frame ring for co-located producers "
+                    "(net/ring.py)",
+        examples=(Example(
+            "@source(type='shm', ring.name='ticks', slots='64') "
+            "define stream S (sym string, p double);",
+            "SPSC shm ring named 'ticks'; producers attach by name"),)))

@@ -1,8 +1,15 @@
-"""REST deployment service — run the engine as a server.
+"""REST control plane + columnar serving data plane.
 
 Reference: modules/siddhi-service (JAX-RS/MSF4J microservice,
 `POST /siddhi/artifact/deploy`, `GET /siddhi/artifact/undeploy`,
 src/gen/.../api/SiddhiApi.java:31-63).
+
+The HTTP surface is the CONTROL plane (deploy/undeploy/query/stats/
+errors/metrics) plus a convenience JSON event endpoint; production
+traffic enters through the DATA plane — a NetServer (siddhi_tpu/net)
+speaking the columnar frame protocol over TCP and WebSocket on its own
+port (`service.net_port`), feeding every deployed app with zero
+per-event Python and per-stream admission control (docs/SERVING.md).
 
 Endpoints (JSON unless noted):
   POST /siddhi/artifact/deploy      body = SiddhiQL app text (plain)
@@ -10,6 +17,20 @@ Endpoints (JSON unless noted):
   GET  /siddhi/artifact/apps
   POST /siddhi/artifact/event       {"app": ..., "stream": ..., "data": [...],
                                      "timestamp": optional ms}
+                                    `data` may be ONE row or a LIST of
+                                    rows (batch form, one shared
+                                    optional timestamp), or pass
+                                    "events": [{"data": [...],
+                                    "timestamp": ...}, ...] — all forms
+                                    share one validation path; malformed
+                                    bodies get a 400 JSON error.  The
+                                    batch rides the stream's admission
+                                    controller (same quotas/shed
+                                    accounting as the frame plane): a
+                                    rate-limited stream sheds REST
+                                    traffic into the ErrorStore with a
+                                    429, or parks it with a 202 under
+                                    shed.policy='oldest'
   POST /siddhi/artifact/query       {"app": ..., "query": "from T select ..."}
   GET  /siddhi/artifact/stats?siddhiApp=<name>
   GET  /metrics[?siddhiApp=<name>]  Prometheus text exposition (0.0.4) over
@@ -18,10 +39,12 @@ Endpoints (JSON unless noted):
                                     the persisted execution-geometry tuning
                                     cache (docs/AUTOTUNING.md): entries +
                                     hit/miss gauges, or one app's view
+  GET  /siddhi/net                  data-plane descriptor: frame port +
+                                    per-stream admission/transport gauges
   GET  /siddhi/errors?siddhiApp=<name>[&stream=<id>]
                                     list the app's ErrorStore entries
                                     (@OnError(action='store') captures,
-                                    exhausted sink publishes)
+                                    exhausted sink publishes, net sheds)
   POST /siddhi/errors               {"app": ..., "action": "replay"|
                                      "discard", "ids": optional [int]}
                                     replay captured events/payloads through
@@ -37,6 +60,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
@@ -48,10 +72,46 @@ from .query import ast as qast
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
+class _ControlServer(ThreadingHTTPServer):
+    """Handler threads are daemons AND tracked, so `stop()` can join
+    them with a bounded timeout — test runs and bench teardown never
+    hang on a stuck keep-alive connection."""
+
+    daemon_threads = True
+    block_on_close = False      # stdlib would join unbounded; we bound it
+
+    def __init__(self, *a, **k):
+        super().__init__(*a, **k)
+        self._handler_threads: list = []
+        self._threads_lock = threading.Lock()
+
+    def process_request(self, request, client_address):
+        t = threading.Thread(target=self.process_request_thread,
+                             args=(request, client_address), daemon=True)
+        with self._threads_lock:
+            self._handler_threads = [th for th in self._handler_threads
+                                     if th.is_alive()] + [t]
+        t.start()
+
+    def join_handlers(self, timeout: float = 5.0) -> None:
+        deadline = time.monotonic() + timeout
+        with self._threads_lock:
+            threads = list(self._handler_threads)
+            self._handler_threads = []
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+
+
 class SiddhiService:
-    def __init__(self, port: int = 0, manager: Optional[SiddhiManager] = None):
+    def __init__(self, port: int = 0, manager: Optional[SiddhiManager] = None,
+                 net: bool = True, net_port: int = 0):
         self.manager = manager or SiddhiManager()
         self.runtimes: dict = {}
+        self._stopping = False          # unblocks 'block'-policy REST waits
+        # ErrorStores of undeployed apps: frames admitted by the data
+        # plane before an undeploy land here (never dropped), and stay
+        # inspectable until the name is redeployed
+        self.retired_errors: dict = {}
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -86,11 +146,15 @@ class SiddhiService:
                         name = service.deploy(self._body().decode())
                         self._reply(200, {"status": "deployed", "app": name})
                     elif path == "/siddhi/artifact/event":
-                        req = json.loads(self._body())
-                        service.send_event(req["app"], req["stream"],
-                                           tuple(req["data"]),
-                                           req.get("timestamp"))
-                        self._reply(200, {"status": "ok"})
+                        body = self._body()
+                        try:
+                            req = json.loads(body)
+                        except ValueError as e:
+                            raise ValueError(f"body is not JSON: {e}") \
+                                from None
+                        code, out = service.send_events(req,
+                                                        nbytes=len(body))
+                        self._reply(code, out)
                     elif path == "/siddhi/artifact/query":
                         req = json.loads(self._body())
                         rows = service.store_query(req["app"], req["query"])
@@ -98,7 +162,8 @@ class SiddhiService:
                     elif path == "/siddhi/errors":
                         req = json.loads(self._body())
                         app = req.get("app")
-                        if app not in service.runtimes:
+                        if (app not in service.runtimes
+                                and app not in service.retired_errors):
                             self._reply(404, {"error":
                                               f"no deployed app {app!r}"})
                         else:
@@ -108,6 +173,8 @@ class SiddhiService:
                     else:
                         self._reply(404, {"error": f"no route {path}"})
                 except Exception as e:
+                    # EVERY failure is a 400 JSON error — a malformed
+                    # body must never surface as a 500 stack trace
                     self._reply(400, {"error": f"{type(e).__name__}: {e}"})
 
             def do_GET(self):
@@ -129,7 +196,8 @@ class SiddhiService:
                             self._reply(200, service.stats(app))
                     elif u.path == "/siddhi/errors":
                         app = q.get("siddhiApp", [None])[0]
-                        if app not in service.runtimes:
+                        if (app not in service.runtimes
+                                and app not in service.retired_errors):
                             self._reply(404, {"error":
                                               f"no deployed app {app!r}"})
                         else:
@@ -142,6 +210,8 @@ class SiddhiService:
                                               f"no deployed app {app!r}"})
                         else:
                             self._reply(200, service.tuning(app))
+                    elif u.path == "/siddhi/net":
+                        self._reply(200, service.net_info())
                     elif u.path == "/metrics":
                         app = q.get("siddhiApp", [None])[0]
                         if app is not None and app not in service.runtimes:
@@ -154,9 +224,52 @@ class SiddhiService:
                 except Exception as e:
                     self._reply(400, {"error": f"{type(e).__name__}: {e}"})
 
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.httpd = _ControlServer(("127.0.0.1", port), Handler)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+        # the data plane: one shared frame server over every deployed
+        # app; admission controllers are per (app, stream) and shared
+        # with any @source(type='tcp'|'shm') the app itself declares
+        self.net = None
+        self.net_port = None
+        if net:
+            from .net.server import NetServer
+            self.net = NetServer(self._net_resolve, port=net_port,
+                                 name="siddhi-service-net")
+            self.net_port = self.net.port
+
+    # -- data plane -------------------------------------------------------
+
+    def _net_resolve(self, app: Optional[str], stream: str):
+        rt = self.runtimes.get(app or "")
+        if rt is None:
+            raise KeyError(f"no deployed app {app!r}")
+        ctrl = rt.admission.get(stream)
+        if ctrl is None:
+            if stream not in rt.schemas:
+                raise KeyError(f"app {app!r} has no stream {stream!r}")
+            from .net.admission import controller_from_options
+            # default controller: unlimited rate, pure accounting —
+            # declare @source(rate.limit=..., shed.policy=...) on the
+            # stream to arm real limits (the SAME controller then
+            # governs both the app's own port and this front door).
+            # setdefault: concurrent HELLOs race this insert — exactly
+            # one controller may win or accounting splits across two
+            ctrl = rt.admission.setdefault(
+                stream, controller_from_options(stream, {}, rt))
+        return rt, ctrl
+
+    def net_info(self) -> dict:
+        if self.net is None:
+            return {"enabled": False}
+        streams = {}
+        # list() snapshots: connection threads insert controllers at
+        # HELLO time, racing this scrape
+        for name, rt in list(self.runtimes.items()):
+            for sid, ctrl in list(rt.admission.items()):
+                streams[f"{name}/{sid}"] = ctrl.metrics()
+        return {"enabled": True, "port": self.net.port,
+                "server": self.net.metrics(), "streams": streams}
 
     # -- operations -------------------------------------------------------
 
@@ -170,6 +283,9 @@ class SiddhiService:
             rt.enable_stats(True)
         old = self.runtimes.pop(name, None)
         if old is not None:
+            if self.net is not None:
+                self.net.retire(old)
+            self._park_errors(name, old.error_store)
             old.shutdown()
         rt.start()
         self.runtimes[name] = rt
@@ -177,13 +293,147 @@ class SiddhiService:
 
     def undeploy(self, name: str) -> None:
         rt = self.runtimes.pop(name)
+        # retire FIRST: the data plane serializes this against in-flight
+        # feeds, so every admitted frame either reached the live runtime
+        # or lands whole in the (parked) ErrorStore — never dropped
+        if self.net is not None:
+            self.net.retire(rt)
+        self._park_errors(name, rt.error_store)
         rt.shutdown()
 
+    def _park_errors(self, name: str, store) -> None:
+        """Park a retiring runtime's ErrorStore under its app name.  A
+        PREVIOUS generation's still-unreplayed entries must survive the
+        churn ('never dropped'): they merge INTO the retiring store,
+        oldest generation first.  The INCOMING store is always the one
+        parked — the data plane's retire() pointed in-flight feeds at
+        it, so frames admitted before the undeploy but fed after this
+        call still land somewhere reachable (merging the other way
+        would orphan them in a store nothing lists or replays)."""
+        prev = self.retired_errors.get(name)
+        self.retired_errors[name] = store
+        if prev is None or prev is store or not len(prev):
+            return
+        newer = store.take(None)
+        for e in prev.take(None):       # fresh ids: two generations'
+            store.add(e.stream_id, e.point, e.message,    # counters both
+                      e.timestamp_ms, events=e.events,    # start at 1
+                      payloads=e.payloads, sink=e.sink)
+        for e in newer:
+            store._readd(e)
+
+    def send_events(self, req: dict, nbytes: int = 0) -> tuple:
+        """Shared validation for the single-event AND batch JSON forms;
+        raises ValueError (→ 400) on anything malformed.  Returns
+        (http_code, body): admitted requests ingest and return
+        200 {"status": "ok"}; the batch rides the stream's
+        AdmissionController — the SAME quotas, shed accounting, and
+        telemetry as the frame plane (docs/SERVING.md) — so under a
+        rate limit REST traffic sheds into the replayable ErrorStore
+        (429 {"status": "shed"}) or parks ('oldest' policy,
+        202 {"status": "queued"}) instead of jumping the line."""
+        if not isinstance(req, dict):
+            raise ValueError("body must be a JSON object")
+        app = req.get("app")
+        rt = self.runtimes.get(app)
+        if rt is None:
+            raise ValueError(f"no deployed app {app!r}")
+        stream = req.get("stream")
+        if stream not in rt.schemas:
+            raise ValueError(f"app {app!r} has no stream {stream!r}")
+        attrs = rt.schemas[stream].attributes
+        n_attrs = len(attrs)
+        events: list = []
+
+        def _row(data, ts, where: str):
+            if not isinstance(data, (list, tuple)):
+                raise ValueError(f"{where}: 'data' must be a list")
+            if len(data) != n_attrs:
+                raise ValueError(
+                    f"{where}: stream {stream!r} expects {n_attrs} "
+                    f"attributes, got {len(data)}")
+            for v, a in zip(data, attrs):
+                # type-check at the boundary: a bad value admitted here
+                # would only surface at flush, inside the engine's
+                # batch builder — poisoning the whole runtime, not just
+                # this request (malformed input must 400, never 500)
+                t = a.type.name
+                if t in ("INT", "LONG", "FLOAT", "DOUBLE") and (
+                        isinstance(v, bool)
+                        or not isinstance(v, (int, float))):
+                    raise ValueError(
+                        f"{where}: attribute {a.name!r} expects a "
+                        f"number ({t.lower()}), got {type(v).__name__}")
+                if t == "BOOL" and not isinstance(v, bool):
+                    raise ValueError(
+                        f"{where}: attribute {a.name!r} expects a bool, "
+                        f"got {type(v).__name__}")
+            if ts is not None and not isinstance(ts, (int, float)):
+                raise ValueError(f"{where}: 'timestamp' must be a number")
+            events.append((tuple(data),
+                           int(ts) if ts is not None else None))
+
+        if "events" in req:
+            evs = req["events"]
+            if not isinstance(evs, list):
+                raise ValueError("'events' must be a list of objects")
+            for i, ev in enumerate(evs):
+                if not isinstance(ev, dict) or "data" not in ev:
+                    raise ValueError(
+                        f"events[{i}] must be an object with 'data'")
+                _row(ev["data"], ev.get("timestamp"), f"events[{i}]")
+        else:
+            data = req.get("data")
+            ts = req.get("timestamp")
+            if isinstance(data, list) and data \
+                    and isinstance(data[0], (list, tuple)):
+                for i, row in enumerate(data):       # batch of rows
+                    _row(row, ts, f"data[{i}]")
+            else:
+                _row(data, ts, "event")
+        from .net.admission import (ADMIT, QUEUED, SHED, Work,
+                                    controller_from_options)
+        ctrl = rt.admission.get(stream)
+        if ctrl is None:
+            ctrl = rt.admission.setdefault(
+                stream, controller_from_options(stream, {}, rt))
+
+        def feed():
+            for data, ts in events:
+                rt.send(stream, data, ts)
+            rt.flush()
+
+        def rows():
+            now = rt.now_ms()
+            return [(ts if ts is not None else now, tuple(data))
+                    for data, ts in events]
+
+        work = Work(n=len(events), nbytes=nbytes or len(events) * 64,
+                    feed=feed, rows=rows, stream_id=stream)
+        # 'block' policy stalls THIS handler thread (the HTTP analogue
+        # of a stalled socket reader); shutdown stays responsive
+        d = ctrl.submit(work, stop=lambda: self._stopping)
+        for w in d.ready:
+            # guarded: a failure in OTHER queued work must not 400 this
+            # request or vanish — it captures to the app's ErrorStore
+            ctrl.feed_safely(w)
+        if d.action == ADMIT:
+            work.feed()
+            return 200, {"status": "ok", "events": len(events)}
+        if d.action == QUEUED:
+            return 202, {"status": "queued", "events": len(events)}
+        assert d.action == SHED
+        return 429, {"status": "shed", "events": len(events),
+                     "stored": True,
+                     "detail": "rate limit exceeded; events captured in "
+                               "the ErrorStore (POST /siddhi/errors "
+                               "action=replay to re-ingest)"}
+
+    # back-compat embedding surface
     def send_event(self, app: str, stream: str, data: tuple,
                    timestamp=None) -> None:
-        rt = self.runtimes[app]
-        rt.send(stream, data, timestamp)
-        rt.flush()
+        self.send_events({"app": app, "stream": stream,
+                          "data": list(data), "timestamp": timestamp})
 
     def store_query(self, app: str, text: str) -> list:
         return [[ts, list(row)] for ts, row in self.runtimes[app].query(text)]
@@ -191,21 +441,70 @@ class SiddhiService:
     def stats(self, app: str) -> dict:
         return self.runtimes[app].stats.report()
 
+    def _error_stores(self, app: str) -> tuple:
+        """(live_store_or_None, parked_store_or_None) for `app` — the
+        parked store holds frames admitted before an undeploy (or a
+        same-name redeploy) of the name."""
+        rt = self.runtimes.get(app)
+        live = rt.error_store if rt is not None else None
+        parked = self.retired_errors.get(app)
+        if live is None and parked is None:
+            raise ValueError(f"no deployed app {app!r}")
+        return live, parked
+
     def errors(self, app: str, stream: Optional[str] = None) -> dict:
-        """The app's ErrorStore entries (JSON-safe dicts)."""
-        store = self.runtimes[app].error_store
-        return {"errors": [e.to_dict() for e in store.entries(stream)],
-                "evicted": store.evicted}
+        """The app's ErrorStore entries (JSON-safe dicts) — live store
+        plus anything parked by an undeploy of the same name."""
+        live, parked = self._error_stores(app)
+        out: list = []
+        evicted = 0
+        for store, is_parked in ((live, False), (parked, True)):
+            if store is None:
+                continue
+            for e in store.entries(stream):
+                d = e.to_dict()
+                if is_parked:
+                    d["parked"] = True
+                out.append(d)
+            evicted += store.evicted
+        return {"errors": out, "evicted": evicted}
 
     def errors_action(self, app: str, action: str, ids=None) -> dict:
         """Replay (re-ingest events / re-publish payloads) or discard
-        captured failures."""
-        rt = self.runtimes[app]
+        captured failures.  Replay drains the parked store of an
+        undeployed-then-redeployed name into the live runtime; an app
+        that is not deployed can only be discarded (redeploy to replay).
+
+        The live and parked stores number entries independently, so an
+        explicit id could name one entry in EACH: ids resolve against
+        the live store first, and only ids the live store does not hold
+        reach the parked one — an action aimed at a live entry can
+        never also consume an unrelated parked entry (ids=None still
+        means everything in both)."""
+        live, parked = self._error_stores(app)
+        parked_ids = ids
+        if ids is not None and live is not None and parked is not None:
+            held = {e.id for e in live.entries()}
+            parked_ids = [i for i in ids if i not in held]
         if action == "replay":
-            return rt.error_store.replay(rt, ids)
+            rt = self.runtimes.get(app)
+            if rt is None:
+                raise ValueError(
+                    f"app {app!r} is not deployed: redeploy it to replay "
+                    f"its parked errors (or action='discard')")
+            out = rt.error_store.replay(rt, ids)
+            if parked is not None and len(parked):
+                for k, v in parked.replay(rt, parked_ids).items():
+                    out[k] = out.get(k, 0) + v
+            return out
         if action == "discard":
-            return {"discarded": len(rt.error_store.take(ids)),
-                    "remaining": len(rt.error_store)}
+            discarded = remaining = 0
+            for store, want in ((live, ids), (parked, parked_ids)):
+                if store is None:
+                    continue
+                discarded += len(store.take(want))
+                remaining += len(store)
+            return {"discarded": discarded, "remaining": remaining}
         raise ValueError(f"unknown errors action {action!r} "
                          f"(replay | discard)")
 
@@ -232,15 +531,28 @@ class SiddhiService:
     # -- lifecycle --------------------------------------------------------
 
     def start(self) -> "SiddhiService":
-        self._thread = threading.Thread(target=self.httpd.serve_forever,
-                                        name="siddhi-service", daemon=True)
+        # short poll interval: shutdown() waits one poll tick, and the
+        # default 0.5 s turns every stop (tests, bench teardown, ops
+        # restarts) into a half-second stall
+        self._thread = threading.Thread(
+            target=lambda: self.httpd.serve_forever(poll_interval=0.05),
+            name="siddhi-service", daemon=True)
         self._thread.start()
+        if self.net is not None:
+            self.net.start()
         return self
 
     def stop(self) -> None:
+        self._stopping = True
+        if self.net is not None:
+            self.net.stop()
         self.httpd.shutdown()
+        self.httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        # outstanding handler threads: bounded join, so teardown never
+        # wedges a test run behind a stuck keep-alive
+        self.httpd.join_handlers(timeout=5.0)
         for rt in list(self.runtimes.values()):
             rt.shutdown()
         self.runtimes.clear()
@@ -250,7 +562,8 @@ if __name__ == "__main__":
     import sys
     port = int(sys.argv[1]) if len(sys.argv) > 1 else 8006
     svc = SiddhiService(port).start()
-    print(f"siddhi-tpu service on http://127.0.0.1:{svc.port}")
+    print(f"siddhi-tpu service on http://127.0.0.1:{svc.port}"
+          + (f" (data plane :{svc.net_port})" if svc.net_port else ""))
     try:
         threading.Event().wait()
     except KeyboardInterrupt:

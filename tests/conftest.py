@@ -34,6 +34,14 @@ else:
             if "test_mesh_async" in str(item.fspath):
                 item.add_marker(skip)
 
+def pytest_configure(config):
+    # tier-1 CI runs `-m 'not slow'` (ROADMAP.md): long fuzz/paced-load
+    # tests ride the full suite only, keeping tier-1 under its time box
+    config.addinivalue_line(
+        "markers", "slow: long-running (fuzz tapes, paced load); "
+        "excluded from tier-1 via -m 'not slow'")
+
+
 # isolate the execution-geometry tuning cache (core/autotune.py): the
 # suite must neither trust nor pollute a developer's persisted winners
 if "SIDDHI_TUNE_CACHE" not in os.environ:

@@ -1,0 +1,327 @@
+"""Admission control (siddhi_tpu/net/admission.py): token-bucket
+determinism on a virtual clock, the three shed policies, ErrorStore
+accounting (zero unaccounted loss) and the SLO rate-factor hook."""
+import pytest
+
+from siddhi_tpu.core.faults import ErrorStore
+from siddhi_tpu.net.admission import (ADMIT, QUEUED, SHED, WAIT,
+                                      AdmissionController, TokenBucket,
+                                      Work, parse_bytes)
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def work(n=10, nbytes=100, rows=None, fed=None):
+    return Work(n=n, nbytes=nbytes,
+                feed=(lambda: fed.append(n)) if fed is not None
+                else (lambda: None),
+                rows=lambda: rows if rows is not None
+                else [(0, ("x",) * 1)] * n,
+                stream_id="S")
+
+
+# ---------------------------------------------------------------------------
+# token bucket
+# ---------------------------------------------------------------------------
+
+def test_bucket_refill_deterministic():
+    clk = Clock()
+    b = TokenBucket(rate=100, burst=50, clock=clk)
+    assert b.try_take(50) == 0.0          # burst available
+    wait = b.try_take(10)
+    assert wait == pytest.approx(0.1)     # 10 tokens at 100/s
+    clk.t += 0.1
+    assert b.try_take(10) == 0.0
+    clk.t += 0.05                         # 5 tokens
+    assert b.try_take(10) == pytest.approx(0.05)
+
+
+def test_bucket_unlimited():
+    b = TokenBucket(rate=None, clock=Clock())
+    assert b.try_take(10**9) == 0.0
+
+
+def test_bucket_rate_factor_scales_refill():
+    clk = Clock()
+    b = TokenBucket(rate=100, burst=100, clock=clk)
+    assert b.try_take(100) == 0.0
+    b.set_factor(0.5)
+    clk.t += 1.0                          # 50 tokens at half rate
+    assert b.try_take(50) == 0.0
+    assert b.try_take(1) > 0.0
+    b.set_factor(5.0)                     # clamped to 1.0
+    assert b.factor == 1.0
+    b.set_factor(0.0001)                  # floored
+    assert b.factor == pytest.approx(0.01)
+
+
+def test_parse_bytes():
+    assert parse_bytes("4 MB") == 4 << 20
+    assert parse_bytes("512kb") == 512 << 10
+    assert parse_bytes("65536") == 65536
+    assert parse_bytes("1 G") == 1 << 30
+    assert parse_bytes(None) == 0
+
+
+# ---------------------------------------------------------------------------
+# policies
+# ---------------------------------------------------------------------------
+
+def test_unlimited_admits_everything():
+    c = AdmissionController("S", clock=Clock())
+    for _ in range(100):
+        assert c.offer(work()).action == ADMIT
+    m = c.metrics()
+    assert m["admitted_events"] == m["events_in"] == 1000
+    assert m["shed_events"] == 0
+
+
+def test_shed_policy_accounts_into_error_store():
+    clk = Clock()
+    store = ErrorStore()
+    c = AdmissionController("S", rate_limit=100, burst=20, policy="shed",
+                            error_store=store, clock=clk,
+                            now_ms=lambda: 123)
+    assert c.offer(work(n=20)).action == ADMIT
+    d = c.offer(work(n=20, rows=[(1, ("a",)), (2, ("b",))]))
+    assert d.action == SHED
+    assert len(store) == 1
+    ent = store.entries("S")[0]
+    assert ent.point == "net.shed" and len(ent.events) == 2
+    m = c.metrics()
+    assert m["shed_events"] == 20 and m["shed_frames"] == 1
+    # zero unaccounted loss: in == admitted + shed
+    assert m["events_in"] == m["admitted_events"] + m["shed_events"]
+
+
+def test_block_policy_returns_wait_then_admits():
+    clk = Clock()
+    c = AdmissionController("S", rate_limit=100, burst=10, policy="block",
+                            clock=clk)
+    assert c.offer(work(n=10)).action == ADMIT
+    d = c.offer(work(n=10))
+    assert d.action == WAIT and d.wait_s == pytest.approx(0.1)
+    clk.t += 0.1
+
+    def sleep(s):                         # virtual sleep advances clock
+        clk.t += s
+    assert c.submit(work(n=10), sleep=sleep).action == ADMIT
+
+
+def test_block_submit_sheds_on_stop():
+    clk = Clock()
+    store = ErrorStore()
+    c = AdmissionController("S", rate_limit=1, burst=1, policy="block",
+                            error_store=store, clock=clk,
+                            now_ms=lambda: 0)
+    assert c.submit(work(n=1)).action == ADMIT
+    d = c.submit(work(n=1), stop=lambda: True, sleep=lambda s: None)
+    assert d.action == SHED and len(store) == 1
+
+
+def test_oldest_policy_queues_then_drains_in_order():
+    clk = Clock()
+    fed: list = []
+    c = AdmissionController("S", rate_limit=100, burst=10, policy="oldest",
+                            clock=clk)
+    assert c.offer(work(n=10, fed=fed)).action == ADMIT
+    assert c.offer(work(n=10, fed=fed)).action == QUEUED
+    assert c.offer(work(n=10, fed=fed)).action == QUEUED
+    assert c.metrics()["pending_frames"] == 2
+    clk.t += 0.1                          # one frame's tokens
+    ready = c.pump()
+    assert len(ready) == 1
+    ready[0].feed()                       # consumers feed what they drain
+    clk.t += 0.1
+    nxt = c.pump()
+    assert len(nxt) == 1
+    nxt[0].feed()
+    assert c.metrics()["pending_frames"] == 0
+    assert fed == [10, 10]
+
+
+def test_oldest_policy_inflight_blocks_new_admits():
+    """Drained-but-not-yet-fed work still holds FIFO order: a frame
+    arriving while another thread feeds the drain must queue behind it,
+    not admit around it (same-producer frames would reorder)."""
+    clk = Clock()
+    fed: list = []
+    c = AdmissionController("S", rate_limit=100, burst=10, policy="oldest",
+                            clock=clk)
+    assert c.offer(work(n=10, fed=fed)).action == ADMIT      # burst
+    assert c.offer(work(n=10, fed=fed)).action == QUEUED     # W1 parks
+    clk.t += 0.1
+    drained = c.pump()                    # W1 handed out, NOT fed yet
+    assert len(drained) == 1
+    clk.t += 0.1                          # tokens exist for more
+    d = c.offer(work(n=10, fed=fed))      # W2 must not jump W1
+    assert d.action == QUEUED and d.ready == []
+    assert c.pump() == []                 # still gated on W1's feed
+    drained[0].feed()                     # W1 lands
+    nxt = c.pump()                        # now W2 drains
+    assert len(nxt) == 1
+    nxt[0].feed()
+    assert fed == [10, 10]
+    assert c.metrics()["pending_frames"] == 0
+
+
+def test_oldest_policy_lone_oversized_frame_sheds_not_queued():
+    """A single frame larger than the pending watermark sheds outright —
+    the decision must SAY shed (REST maps QUEUED to 202 'queued', a
+    promise the feed would never keep)."""
+    clk = Clock()
+    store = ErrorStore()
+    c = AdmissionController("S", rate_limit=100, burst=10, policy="oldest",
+                            max_pending_bytes=100, error_store=store,
+                            clock=clk, now_ms=lambda: 0)
+    assert c.offer(work(n=10, nbytes=50)).action == ADMIT    # drain burst
+    d = c.offer(work(n=10, nbytes=500))   # exceeds the watermark alone
+    assert d.action == SHED
+    assert len(store) == 1
+    m = c.metrics()
+    assert m["pending_frames"] == 0 and m["pending_bytes"] == 0
+
+
+def test_oldest_policy_evicts_oldest_on_watermark():
+    clk = Clock()
+    store = ErrorStore()
+    c = AdmissionController("S", rate_limit=100, burst=10, policy="oldest",
+                            max_pending_bytes=250, error_store=store,
+                            clock=clk, now_ms=lambda: 0)
+    assert c.offer(work(n=10, nbytes=100,
+                        rows=[(0, ("first",))])).action == ADMIT
+    c.offer(work(n=10, nbytes=100, rows=[(1, ("second",))]))
+    c.offer(work(n=10, nbytes=100, rows=[(2, ("third",))]))
+    d = c.offer(work(n=10, nbytes=100, rows=[(3, ("fourth",))]))
+    assert d.action == QUEUED
+    # watermark 250: queuing the fourth (300 pending bytes) evicted the
+    # OLDEST pending frame ("second" — "first" was admitted)
+    assert len(store) == 1
+    assert store.entries("S")[0].events[0][1] == ("second",)
+    assert c.metrics()["pending_bytes"] == 200
+
+
+def test_flush_pending_to_store():
+    clk = Clock()
+    store = ErrorStore()
+    c = AdmissionController("S", rate_limit=1, burst=1, policy="oldest",
+                            error_store=store, clock=clk, now_ms=lambda: 0)
+    c.offer(work(n=1))
+    c.offer(work(n=1))
+    c.offer(work(n=1))
+    assert c.flush_pending_to_store() == 2
+    assert len(store) == 2
+    assert c.metrics()["pending_frames"] == 0
+
+
+def test_unknown_policy_rejected():
+    with pytest.raises(ValueError, match="shed.policy"):
+        AdmissionController("S", policy="yolo")
+
+
+def test_frame_larger_than_burst_sheds_never_spins():
+    """A frame with more events than the bucket can EVER hold must shed
+    (accounted) immediately — 'block' would otherwise spin forever and
+    'oldest' would jam its queue head."""
+    for policy in ("block", "shed", "oldest"):
+        clk = Clock()
+        store = ErrorStore()
+        c = AdmissionController("S", rate_limit=100, burst=50,
+                                policy=policy, error_store=store,
+                                clock=clk, now_ms=lambda: 0)
+        d = c.submit(work(n=51), sleep=lambda s: (_ for _ in ()).throw(
+            AssertionError("must not wait")))
+        assert d.action == SHED, policy
+        assert len(store) == 1
+        m = c.metrics()
+        assert m["events_in"] == m["admitted_events"] + m["shed_events"]
+        # and a normal frame still admits afterwards
+        assert c.submit(work(n=50)).action == ADMIT, policy
+
+
+def test_slo_rate_factor_hook():
+    clk = Clock()
+    c = AdmissionController("S", rate_limit=1000, burst=100, clock=clk)
+    c.set_rate_factor(0.25)
+    assert c.metrics()["rate_factor"] == 0.25
+    assert c.offer(work(n=100)).action == ADMIT    # burst unaffected
+    clk.t += 0.2                                   # 1000*0.25*0.2 = 50
+    assert c.offer(work(n=50)).action == ADMIT
+    assert c.offer(work(n=1)).action == WAIT
+
+
+def test_bucket_rate_zero_admits_nothing():
+    """rate=0 is a declared quarantine — admit NOTHING, shed everything
+    accounted — not unlimited: only rate=None means no limit."""
+    clk = Clock()
+    b = TokenBucket(rate=0, clock=clk)
+    assert b.rate == 0.0
+    assert b.try_take(1) > 0.0
+    clk.t += 1e6
+    assert b.try_take(1) > 0.0            # never refills
+    store = ErrorStore()
+    c = AdmissionController("S", rate_limit=0, policy="shed",
+                            error_store=store, clock=clk,
+                            now_ms=lambda: 1)
+    assert c.offer(work(n=5)).action == SHED
+    m = c.metrics()
+    assert m["events_in"] == m["shed_events"] == 5
+    assert m["admitted_events"] == 0
+    assert len(store) == 1
+
+
+def test_feed_safely_captures_failed_feed():
+    """A feed whose closure does not self-capture (queued REST work
+    drained by the scheduler pump) must still land in the ErrorStore
+    on failure — admitted work never vanishes."""
+    store = ErrorStore()
+    c = AdmissionController("S", error_store=store, clock=Clock(),
+                            now_ms=lambda: 7)
+
+    def boom():
+        raise RuntimeError("pipe burst")
+
+    c.feed_safely(Work(n=2, nbytes=10, feed=boom,
+                       rows=lambda: [(1, ("a",)), (2, ("b",))],
+                       stream_id="S"))
+    assert len(store) == 1
+    ent = store.entries("S")[0]
+    assert ent.point == "net.feed" and len(ent.events) == 2
+    assert "pipe burst" in ent.message
+
+
+def test_scheduler_pump_drains_queued_work_without_traffic():
+    """'oldest'-policy work queued while the bucket was empty must be
+    fed by the runtime scheduler pump once tokens refill, even when no
+    further frame/REST traffic arrives to pump the controller."""
+    import time as _time
+
+    from siddhi_tpu import SiddhiManager
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(
+        "define stream S (x int);\n"
+        "@info(name='q') from S select x insert into Out;\n")
+    fed = []
+    ctrl = AdmissionController("S", rate_limit=200, burst=1,
+                               policy="oldest",
+                               error_store=rt.error_store)
+    rt.admission["S"] = ctrl
+    rt.start()                      # real-time mode: scheduler pump runs
+    try:
+        w1 = work(n=1, fed=fed)
+        assert ctrl.offer(w1).action == ADMIT
+        w1.feed()                   # admitted work is fed by the CALLER
+        assert ctrl.offer(work(n=1, fed=fed)).action == QUEUED
+        deadline = _time.monotonic() + 5
+        while _time.monotonic() < deadline and len(fed) < 2:
+            _time.sleep(0.01)
+        assert len(fed) == 2        # drained by the pump, no new offer
+        assert ctrl.metrics()["pending_frames"] == 0
+    finally:
+        mgr.shutdown()

@@ -1,0 +1,198 @@
+"""Columnar wire frame protocol (siddhi_tpu/net/frame.py): encode/
+decode round trips, checksum/truncation detection, schema negotiation,
+string-table deltas and the connection-code remap."""
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from siddhi_tpu.core.schema import StreamSchema, StringTable
+from siddhi_tpu.net import frame as fp
+from siddhi_tpu.query.ast import Attribute, AttrType
+
+SCHEMA = StreamSchema("S", (Attribute("sym", AttrType.STRING),
+                            Attribute("p", AttrType.DOUBLE),
+                            Attribute("v", AttrType.INT)))
+
+
+def _stream_reader(blob: bytes):
+    pos = [0]
+
+    def read_exact(n):
+        if pos[0] + n > len(blob):
+            raise EOFError("eof")
+        out = blob[pos[0]:pos[0] + n]
+        pos[0] += n
+        return out
+    return read_exact
+
+
+def test_frame_roundtrip_all_types():
+    cases = [
+        (fp.HELLO, fp.encode_hello("A", "S", [("sym", "string")])),
+        (fp.HELLO_OK, fp.encode_hello_ok(64)),
+        (fp.CREDIT, fp.encode_credit(17)),
+        (fp.ACK, fp.encode_ack(5)),
+        (fp.PING, fp.encode_ping(5)),
+        (fp.ERROR, fp.encode_error("boom")),
+        (fp.BYE, fp.encode_frame(fp.BYE)),
+        (fp.STRINGS, fp.encode_strings(["a", "b"], start_code=1)),
+    ]
+    for want_type, blob in cases:
+        ftype, payload = fp.read_frame(_stream_reader(blob))
+        assert ftype == want_type
+        # and via the buffer parser (ring/ws path)
+        frames, rest = fp.parse_buffer(blob)
+        assert rest == b"" and frames[0][0] == want_type
+
+
+def test_parse_buffer_multiple_and_partial():
+    blob = fp.encode_ack(1) + fp.encode_ack(2) + fp.encode_ack(3)
+    frames, rest = fp.parse_buffer(blob + blob[:5])
+    assert [fp.decode_u64(p) for _, p in frames] == [1, 2, 3]
+    assert rest == blob[:5]
+
+
+def test_checksum_mismatch_detected():
+    blob = bytearray(fp.encode_credit(9))
+    blob[-5] ^= 0xFF                      # flip a payload byte
+    # stream path: strict (the receiver consumed an exact frame)
+    with pytest.raises(fp.FrameError, match="checksum"):
+        fp.read_frame(_stream_reader(bytes(blob)))
+    # buffer path: the frame was consumed whole by its length prefix,
+    # so it comes back as (ftype, None) — rejected without losing the
+    # stream alignment — and the NEXT frame still parses
+    frames, rest = fp.parse_buffer(bytes(blob) + fp.encode_ack(5))
+    assert rest == b""
+    assert frames[0] == (fp.CREDIT, None)
+    assert frames[1][0] == fp.ACK and fp.decode_u64(frames[1][1]) == 5
+
+
+def test_bad_magic_and_version():
+    blob = fp.encode_ack(1)
+    with pytest.raises(fp.FrameDesync, match="magic"):
+        fp.read_frame(_stream_reader(b"XX" + blob[2:]))
+    bad_ver = bytearray(blob)
+    bad_ver[2] = 99
+    with pytest.raises(fp.FrameDesync, match="version"):
+        fp.read_frame(_stream_reader(bytes(bad_ver)))
+    with pytest.raises(fp.FrameDesync, match="magic"):
+        fp.parse_buffer(b"XX" + blob[2:])
+
+
+def test_data_roundtrip_zero_copy_views():
+    ts = np.arange(4, dtype=np.int64) + 1000
+    sym = np.array([1, 2, 1, 3], dtype=np.int32)
+    p = np.array([1.5, 2.5, 3.5, 4.5])
+    v = np.array([7, 8, 9, 10], dtype=np.int32)
+    blob = fp.encode_data(ts, [sym, p, v])
+    ftype, payload = fp.read_frame(_stream_reader(blob))
+    assert ftype == fp.DATA
+    got_ts, cols = fp.decode_data(payload, SCHEMA)
+    np.testing.assert_array_equal(got_ts, ts)
+    np.testing.assert_array_equal(cols["sym"], sym)
+    np.testing.assert_array_equal(cols["p"], p)
+    np.testing.assert_array_equal(cols["v"], v)
+    # views alias the payload (zero-copy) and are read-only
+    assert cols["p"].base is not None
+    assert not cols["p"].flags.writeable
+
+
+def test_data_truncation_and_trailing_garbage():
+    ts = np.arange(4, dtype=np.int64)
+    blob = fp.encode_data(ts, [np.zeros(4, np.int32),
+                               np.zeros(4), np.zeros(4, np.int32)])
+    _, payload = fp.read_frame(_stream_reader(blob))
+    with pytest.raises(fp.FrameError, match="truncated"):
+        fp.decode_data(payload[:20], SCHEMA)
+    with pytest.raises(fp.FrameError, match="trailing"):
+        fp.decode_data(payload + b"\x00\x00", SCHEMA)
+
+
+def test_hello_schema_negotiation():
+    ok = fp.decode_hello(fp.read_frame(_stream_reader(
+        fp.encode_hello("A", "S", [("sym", "string"), ("p", "double"),
+                                   ("v", "int")])))[1])
+    fp.validate_hello_schema(ok, SCHEMA)      # no raise
+    bad = dict(ok, cols=[["sym", "string"], ["p", "float"], ["v", "int"]])
+    with pytest.raises(fp.FrameError, match="schema mismatch"):
+        fp.validate_hello_schema(bad, SCHEMA)
+    with pytest.raises(fp.FrameError, match="schema mismatch"):
+        fp.validate_hello_schema(dict(ok, cols=ok["cols"][:2]), SCHEMA)
+
+
+def test_strings_delta_and_remap():
+    wire = fp.WireStringTable()
+    codes1, new1 = wire.encode_column(np.array(["a", "b", "a"]))
+    assert new1 == ["a", "b"]
+    np.testing.assert_array_equal(codes1, [1, 2, 1])
+    codes2, new2 = wire.encode_column(np.array(["b", "c"]))
+    assert new2 == ["c"]
+    np.testing.assert_array_equal(codes2, [2, 3])
+
+    table = StringTable()
+    table.encode("preexisting")               # server table not empty
+    remap = fp.StringRemap()
+    remap.extend(1, new1, table)
+    remap.extend(3, new2, table)
+    got = remap.apply(np.array([1, 2, 3, 0], dtype=np.int32))
+    assert [table.decode(int(c)) for c in got] == ["a", "b", "c", None]
+
+
+def test_remap_gap_rejected():
+    remap = fp.StringRemap()
+    with pytest.raises(fp.FrameError, match="lost delta"):
+        remap.extend(5, ["x"], StringTable())
+
+
+def test_remap_overlap_idempotent():
+    table = StringTable()
+    remap = fp.StringRemap()
+    remap.extend(1, ["a", "b"], table)
+    remap.extend(1, ["a", "b", "c"], table)   # full-table replay overlap
+    got = remap.apply(np.array([1, 2, 3], dtype=np.int32))
+    assert [table.decode(int(c)) for c in got] == ["a", "b", "c"]
+
+
+def test_remap_undeclared_code_rejected():
+    remap = fp.StringRemap()
+    remap.extend(1, ["a"], StringTable())
+    with pytest.raises(fp.FrameError, match="never declared"):
+        remap.apply(np.array([7], dtype=np.int32))
+
+
+def test_strings_frame_roundtrip_unicode():
+    blob = fp.encode_strings(["héllo", "wörld", ""], start_code=4)
+    _, payload = fp.read_frame(_stream_reader(blob))
+    assert fp.decode_strings(payload) == (4, ["héllo", "wörld", ""])
+
+
+def test_worked_hex_example_matches_spec():
+    """The docs/SERVING.md worked example: a 2-row DATA frame for
+    (sym string, p double, v int) — pin the exact bytes so the spec
+    and the implementation cannot drift apart silently."""
+    ts = np.array([1000, 1001], dtype=np.int64)
+    blob = fp.encode_data(ts, [np.array([1, 2], dtype=np.int32),
+                               np.array([1.5, 2.5]),
+                               np.array([7, 8], dtype=np.int32)])
+    assert blob[:2] == b"FS"                  # magic 0x5346 LE
+    assert blob[2] == 1 and blob[3] == fp.DATA
+    (n,) = struct.unpack_from("<I", blob, 4)
+    payload = blob[8:8 + n]
+    assert payload[:4] == b"\x02\x00\x00\x00"         # n_rows = 2
+    assert payload[4:12] == struct.pack("<q", 1000)   # first ts
+    (crc,) = struct.unpack_from("<I", blob, 8 + n)
+    assert crc == (zlib.crc32(payload) & 0xFFFFFFFF)
+
+
+def test_ws_frame_oversize_declared_length_desyncs():
+    """A ws header declaring a payload beyond the protocol's 64 MiB
+    bound must fail loudly instead of growing the receive buffer
+    forever while the scanner waits for bytes that never complete."""
+    buf = bytearray(bytes([0x82, 127]) + struct.pack(">Q", 1 << 40))
+    with pytest.raises(fp.FrameDesync):
+        fp.parse_ws_frame_inplace(buf)
+    # at-the-bound messages still parse (one protocol frame + header)
+    ok = bytearray(bytes([0x82, 126]) + struct.pack(">H", 3) + b"abc")
+    assert fp.parse_ws_frame_inplace(ok) == (0x2, b"abc")

@@ -1,0 +1,92 @@
+"""Shared-memory frame ring (siddhi_tpu/net/ring.py): SPSC round trip,
+wraparound, full-ring backpressure, occupancy, cross-thread use."""
+import threading
+
+import pytest
+
+from siddhi_tpu.net.ring import RingError, ShmRing
+
+
+@pytest.fixture
+def ring():
+    r = ShmRing.create(slots=4, slot_size=1024)
+    yield r
+    r.close()
+    r.unlink()
+
+
+def test_roundtrip_and_attach(ring):
+    other = ShmRing.attach(ring.name)
+    assert other.slots == 4 and other.capacity == 1024
+    other.push(b"hello")
+    other.push(b"world")
+    assert ring.pop(timeout=1) == b"hello"
+    assert ring.pop(timeout=1) == b"world"
+    assert ring.pop(timeout=0.01) is None
+    other.close()
+
+
+def test_wraparound(ring):
+    for round_ in range(5):               # 20 frames through 4 slots
+        for i in range(4):
+            assert ring.push(f"m{round_}-{i}".encode(), timeout=1)
+        for i in range(4):
+            assert ring.pop(timeout=1) == f"m{round_}-{i}".encode()
+
+
+def test_full_ring_blocks_until_consumed(ring):
+    for i in range(4):
+        ring.push(b"x")
+    assert ring.occupancy() == (4, 4)
+    assert not ring.push(b"y", timeout=0.05)      # full: times out
+
+    def consume():
+        ring.pop(timeout=2)
+    t = threading.Thread(target=consume)
+    t.start()
+    assert ring.push(b"y", timeout=2)             # slot freed
+    t.join()
+    assert ring.occupancy() == (4, 4)
+
+
+def test_oversized_frame_rejected(ring):
+    with pytest.raises(RingError, match="slot capacity"):
+        ring.push(b"z" * 2048)
+
+
+def test_join_barrier(ring):
+    ring.push(b"a")
+    assert not ring.join(timeout=0.05)            # consumer behind
+    assert ring.pop(timeout=1) == b"a"
+    assert ring.join(timeout=1)
+
+
+def test_threaded_producer_consumer(ring):
+    N = 200
+    got = []
+
+    def produce():
+        p = ShmRing.attach(ring.name)
+        for i in range(N):
+            assert p.push(str(i).encode(), timeout=5)
+        p.close()
+
+    t = threading.Thread(target=produce)
+    t.start()
+    while len(got) < N:
+        m = ring.pop(timeout=5)
+        assert m is not None
+        got.append(int(m))
+    t.join()
+    assert got == list(range(N))
+
+
+def test_attach_rejects_foreign_segment():
+    from multiprocessing import shared_memory
+    shm = shared_memory.SharedMemory(create=True, size=4096)
+    try:
+        with pytest.raises(RingError, match="magic"):
+            ShmRing.attach(shm.name)
+    finally:
+        shm.close()
+        shm.unlink()

@@ -1,0 +1,706 @@
+"""Serving-plane end-to-end (siddhi_tpu/net): TCP/WS/shm ingest
+byte-identical to in-process columnar ingest, credit backpressure,
+admission shedding with replay, sink egress, telemetry surface."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.net import (FrameReceiver, NetClientError, RingProducer,
+                            TcpFrameClient, WsFrameClient)
+
+# host-only execution throughout (HOST prefixed to every app): these
+# tests verify TRANSPORT semantics (framing, admission, ordering, loss
+# accounting), which are independent of the kernel backend — host apps
+# skip every jit compile, keeping the suite inside the tier-1 budget.
+# The device path over the wire is exercised end-to-end by
+# `bench.py --net --smoke` (CI).
+HOST = ("@app:deviceFilters('never')\n@app:devicePatterns('never')\n"
+        "@app:deviceWindows('never')\n")
+STOCK = "define stream StockStream (symbol string, price double, volume int);\n"
+PATTERN_Q = ("@info(name='q') from every e1=StockStream[price > 100] -> "
+             "e2=StockStream[price > e1.price] within 1 sec "
+             "select e1.price as p1, e2.price as p2 insert into Out;\n")
+
+
+def make_batches(n_batches=6, batch=64, seed=3):
+    rng = np.random.default_rng(seed)
+    ts0 = 1_700_000_000_000
+    out = []
+    for k in range(n_batches):
+        out.append((
+            {"symbol": np.array([f"K{i}" for i in
+                                 rng.integers(0, 8, size=batch)]),
+             "price": np.round(rng.uniform(90, 130, batch) * 4) / 4,
+             "volume": rng.integers(1, 100, batch).astype(np.int32)},
+            ts0 + np.arange(k * batch, (k + 1) * batch, dtype=np.int64)))
+    return out
+
+
+def run_inproc(app, batches, stream="StockStream"):
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(HOST + app)
+    rows = []
+    rt.add_batch_callback("Out", lambda b: rows.extend(
+        map(tuple, b.rows(rt.strings))))
+    rt.start()
+    h = rt.input_handler(stream)
+    for cols, ts in batches:
+        h.send_batch(cols, ts)
+    rt.flush()
+    mgr.shutdown()
+    return rows
+
+
+def run_wire(app_head, app_body, batches, client_cls=TcpFrameClient,
+             stream="StockStream"):
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(HOST + app_head + app_body)
+    rows = []
+    rt.add_batch_callback("Out", lambda b: rows.extend(
+        map(tuple, b.rows(rt.strings))))
+    rt.start()
+    src = rt.sources[0]
+    cols = client_cls.cols_of_schema(rt.schemas[stream])
+    cli = client_cls("127.0.0.1", src.port, stream, cols)
+    for c, ts in batches:
+        cli.send_batch(c, ts)
+    cli.barrier()
+    cli.close()
+    stats = rt.statistics()
+    mgr.shutdown()
+    return rows, stats
+
+
+def test_tcp_ingest_byte_identical_to_inproc():
+    batches = make_batches()
+    host = run_inproc(STOCK + PATTERN_Q, batches)
+    wire, stats = run_wire(
+        "@source(type='tcp', port='0')\n" + STOCK, PATTERN_Q, batches)
+    assert wire == host and len(wire) > 0
+    net = stats["net"]["StockStream"]
+    assert net["frames_in"] == len(batches)
+    assert net["events_in"] == sum(len(t) for _, t in batches)
+    assert net["shed_events"] == 0
+
+
+def test_ws_ingest_byte_identical_to_inproc():
+    batches = make_batches(n_batches=4)
+    host = run_inproc(STOCK + PATTERN_Q, batches)
+    wire, stats = run_wire(
+        "@source(type='ws', port='0')\n" + STOCK, PATTERN_Q, batches,
+        client_cls=WsFrameClient)
+    assert wire == host and len(wire) > 0
+    assert stats["net"]["StockStream"]["ws_connections"] == 1
+
+
+def test_shm_ring_ingest_byte_identical_to_inproc():
+    batches = make_batches(n_batches=4)
+    host = run_inproc(STOCK + PATTERN_Q, batches)
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(
+        HOST + "@source(type='shm', slots='8')\n" + STOCK + PATTERN_Q)
+    rows = []
+    rt.add_batch_callback("Out", lambda b: rows.extend(
+        map(tuple, b.rows(rt.strings))))
+    rt.start()
+    src = rt.sources[0]
+    prod = RingProducer(src.ring_name, "StockStream",
+                        RingProducer.cols_of_schema(rt.schemas["StockStream"]))
+    for c, ts in batches:
+        prod.send_batch(c, ts)
+    prod.barrier(timeout=10)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:       # consumer feed is async of
+        rt.flush()                           # the ring drain barrier
+        if len(rows) >= len(host):
+            break
+        time.sleep(0.01)
+    prod.close()
+    stats = rt.statistics()
+    mgr.shutdown()
+    assert rows == host and len(rows) > 0
+    assert stats["net"]["StockStream"]["transport"] == "shm"
+
+
+def test_shm_ring_split_batch_ships_strings_delta():
+    """A batch too large for one ring slot splits into several DATA
+    frames — and the oversize encode's STRINGS delta must still ship
+    first, or every split frame's codes would be undeclared."""
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(
+        HOST + "@source(type='shm', slots='8', slot.size='4096')\n"
+        + STOCK + "@info(name='q') from StockStream select symbol, price "
+                  "insert into Out;\n")
+    rows = []
+    rt.add_batch_callback("Out", lambda b: rows.extend(
+        map(tuple, b.rows(rt.strings))))
+    rt.start()
+    prod = RingProducer(rt.sources[0].ring_name, "StockStream",
+                        RingProducer.cols_of_schema(rt.schemas["StockStream"]))
+    n = 1024                               # ~20 KB of columns >> 4 KB slot
+    syms = np.array([f"SYM{i % 50}" for i in range(n)])
+    prod.send_batch({"symbol": syms,
+                     "price": np.arange(n, dtype=np.float64),
+                     "volume": np.arange(n, dtype=np.int32)},
+                    np.arange(n, dtype=np.int64))
+    assert prod.frames_sent > 1            # actually split
+    prod.barrier(timeout=10)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and len(rows) < n:
+        rt.flush()
+        time.sleep(0.01)
+    stats = rt.statistics()
+    prod.close()
+    mgr.shutdown()
+    assert stats["net"]["StockStream"].get("protocol_errors", 0) == 0
+    assert [r[0] for r in rows] == list(syms)      # strings decode right
+    assert [r[1] for r in rows] == list(np.arange(n, dtype=np.float64))
+
+
+def test_encoder_casts_to_declared_wire_dtype():
+    """An int array handed to a double column must ship double BITS —
+    not get reinterpreted by the peer."""
+    app = (HOST + "@source(type='tcp', port='0')\n" + STOCK
+           + "@info(name='q') from StockStream select symbol, price "
+             "insert into Out;\n")
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(app)
+    rows = []
+    rt.add_batch_callback("Out", lambda b: rows.extend(
+        map(tuple, b.rows(rt.strings))))
+    rt.start()
+    cols = TcpFrameClient.cols_of_schema(rt.schemas["StockStream"])
+    cli = TcpFrameClient("127.0.0.1", rt.sources[0].port, "StockStream", cols)
+    cli.send_batch({"symbol": np.array(["A", "B"]),
+                    "price": np.array([101, 102]),        # int64 input
+                    "volume": np.array([7, 8])},          # int64 input
+                   np.array([1, 2], dtype=np.int64))
+    cli.barrier()
+    assert rows == [("A", 101.0), ("B", 102.0)]
+    cli.close()
+    mgr.shutdown()
+
+
+def test_two_connections_interleave_without_loss():
+    app = (HOST + "@source(type='tcp', port='0')\n"
+           + STOCK + "@info(name='q') from StockStream select symbol, "
+                     "price insert into Out;\n")
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(app)
+    n_out = [0]
+    rt.add_batch_callback("Out", lambda b: n_out.__setitem__(0, n_out[0] + b.n))
+    rt.start()
+    port = rt.sources[0].port
+    cols = TcpFrameClient.cols_of_schema(rt.schemas["StockStream"])
+
+    def producer(seed):
+        cli = TcpFrameClient("127.0.0.1", port, "StockStream", cols)
+        for c, ts in make_batches(n_batches=4, batch=32, seed=seed):
+            cli.send_batch(c, ts)
+        cli.barrier()
+        cli.close()
+
+    threads = [threading.Thread(target=producer, args=(s,)) for s in (1, 2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rt.flush()
+    assert n_out[0] == 2 * 4 * 32
+    mgr.shutdown()
+
+
+def test_credit_flow_is_granted():
+    batches = make_batches(n_batches=40, batch=8)
+    _, stats = run_wire(
+        "@source(type='tcp', port='0', credit='4')\n" + STOCK,
+        "@info(name='q') from StockStream select symbol insert into Out;\n",
+        batches)
+    net = stats["net"]["StockStream"]
+    # 40 DATA frames against an initial credit of 4: the client must
+    # have been re-credited many times to finish
+    assert net["credit_granted"] >= 36
+    assert net["frames_in"] == 40
+
+
+def test_shed_policy_zero_unaccounted_loss_and_replay():
+    app = (HOST + "@source(type='tcp', port='0', rate.limit='64', "
+           "burst='64', shed.policy='shed')\n"
+           + STOCK + "@info(name='q') from StockStream select symbol, "
+                     "price insert into Out;\n")
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(app)
+    n_out = [0]
+    rt.add_batch_callback("Out", lambda b: n_out.__setitem__(0, n_out[0] + b.n))
+    rt.start()
+    cols = TcpFrameClient.cols_of_schema(rt.schemas["StockStream"])
+    cli = TcpFrameClient("127.0.0.1", rt.sources[0].port, "StockStream", cols)
+    batches = make_batches(n_batches=4, batch=32)      # 128 > 64 tokens
+    for c, ts in batches:
+        cli.send_batch(c, ts)
+    cli.barrier()
+    m = rt.admission["StockStream"].metrics()
+    assert m["shed_events"] > 0
+    assert n_out[0] + m["shed_events"] == 128          # nothing vanished
+    assert len(rt.error_store) == m["shed_frames"]
+    # replay restores the shed events through normal ingest
+    rt.admission["StockStream"].set_rate_factor(1.0)
+    rt.admission["StockStream"].bucket.rate = None     # lift the limit
+    rep = rt.error_store.replay(rt)
+    rt.flush()
+    assert rep["remaining"] == 0 and n_out[0] == 128
+    cli.close()
+    mgr.shutdown()
+
+
+def test_schema_mismatch_rejected_at_hello():
+    app = HOST + "@source(type='tcp', port='0')\n" + STOCK + \
+        "@info(name='q') from StockStream select symbol insert into Out;\n"
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(app)
+    rt.start()
+    port = rt.sources[0].port
+    with pytest.raises(NetClientError, match="schema mismatch"):
+        TcpFrameClient("127.0.0.1", port, "StockStream",
+                       [("symbol", "string"), ("price", "double")])
+    with pytest.raises(NetClientError, match="serves stream"):
+        TcpFrameClient("127.0.0.1", port, "Other",
+                       [("symbol", "string")])
+    mgr.shutdown()
+
+
+def test_mid_frame_disconnect_is_survivable():
+    """A client dying mid-frame must not poison the server: later
+    connections keep working and fully-received frames stay counted."""
+    import socket
+    from siddhi_tpu.net import frame as fp
+    app = HOST + "@source(type='tcp', port='0')\n" + STOCK + \
+        "@info(name='q') from StockStream select symbol insert into Out;\n"
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(app)
+    n_out = [0]
+    rt.add_batch_callback("Out", lambda b: n_out.__setitem__(0, n_out[0] + b.n))
+    rt.start()
+    port = rt.sources[0].port
+    cols = TcpFrameClient.cols_of_schema(rt.schemas["StockStream"])
+    # half a frame, then vanish
+    raw = socket.create_connection(("127.0.0.1", port))
+    blob = fp.encode_hello("", "StockStream",
+                           [(n, t) for n, t in cols])
+    raw.sendall(blob[:len(blob) // 2])
+    raw.close()
+    # garbage bytes, then vanish
+    raw = socket.create_connection(("127.0.0.1", port))
+    raw.sendall(b"\xde\xad\xbe\xef" * 4)
+    raw.close()
+    time.sleep(0.1)
+    cli = TcpFrameClient("127.0.0.1", port, "StockStream", cols)
+    for c, ts in make_batches(n_batches=2, batch=16):
+        cli.send_batch(c, ts)
+    cli.barrier()
+    assert n_out[0] == 32
+    cli.close()
+    mgr.shutdown()
+
+
+def test_net_feed_fault_captures_whole_frame():
+    """An injected ingest fault after admission must capture the whole
+    frame into the ErrorStore — the zero-loss invariant."""
+    from siddhi_tpu.core.faults import FaultInjector
+    app = HOST + "@source(type='tcp', port='0')\n" + STOCK + \
+        "@info(name='q') from StockStream select symbol insert into Out;\n"
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(app)
+    n_out = [0]
+    rt.add_batch_callback("Out", lambda b: n_out.__setitem__(0, n_out[0] + b.n))
+    rt.start()
+    rt.fault_injector = FaultInjector(counts={"net.feed": 1})
+    cols = TcpFrameClient.cols_of_schema(rt.schemas["StockStream"])
+    cli = TcpFrameClient("127.0.0.1", rt.sources[0].port, "StockStream", cols)
+    for c, ts in make_batches(n_batches=2, batch=16):
+        cli.send_batch(c, ts)
+    cli.barrier()
+    assert n_out[0] == 16                  # second frame delivered
+    assert len(rt.error_store) == 1        # first captured whole
+    ent = rt.error_store.entries("StockStream")[0]
+    assert ent.point == "net.feed" and len(ent.events) == 16
+    rt.fault_injector = None
+    rep = rt.error_store.replay(rt)
+    rt.flush()
+    assert rep["remaining"] == 0 and n_out[0] == 32
+    cli.close()
+    mgr.shutdown()
+
+
+def test_slo_controller_lowers_admission_factor():
+    """@app:latencySLO coupling: sustained p99 over an (unreachably
+    tight) target must scale the net admission buckets down via the
+    controller's admission_factor."""
+    app = (HOST + "@app:latencySLO('0.001 ms')\n"
+           "@source(type='tcp', port='0', rate.limit='1000000')\n"
+           + STOCK + "@info(name='q') from StockStream select symbol "
+                     "insert into Out;\n")
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(app)
+    rt.start()
+    cols = TcpFrameClient.cols_of_schema(rt.schemas["StockStream"])
+    cli = TcpFrameClient("127.0.0.1", rt.sources[0].port, "StockStream", cols)
+    deadline = time.monotonic() + 10
+    batches = make_batches(n_batches=1, batch=8)
+    while time.monotonic() < deadline:
+        for c, ts in batches:
+            cli.send_batch(c, ts)
+        cli.barrier()
+        if rt.admission["StockStream"].metrics()["rate_factor"] < 1.0:
+            break
+        time.sleep(0.02)
+    m = rt.admission["StockStream"].metrics()
+    slo = rt.statistics()["slo"]
+    assert m["rate_factor"] < 1.0
+    assert slo["admission_factor"] == m["rate_factor"]
+    cli.close()
+    mgr.shutdown()
+
+
+def test_prometheus_net_series():
+    batches = make_batches(n_batches=2, batch=16)
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(
+        HOST + "@app:name('PromNet')\n@app:statistics('true')\n"
+        "@source(type='tcp', port='0')\n" + STOCK +
+        "@info(name='q') from StockStream select symbol insert into Out;\n")
+    rt.start()
+    cols = TcpFrameClient.cols_of_schema(rt.schemas["StockStream"])
+    cli = TcpFrameClient("127.0.0.1", rt.sources[0].port, "StockStream", cols)
+    for c, ts in batches:
+        cli.send_batch(c, ts)
+    cli.barrier()
+    text = rt.stats.prometheus()
+    assert ('siddhi_tpu_net_events_total{app="PromNet",'
+            'stream="StockStream"} 32') in text
+    assert "siddhi_tpu_net_frames_total" in text
+    assert "siddhi_tpu_net_admission_factor" in text
+    cli.close()
+    mgr.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# sink egress
+# ---------------------------------------------------------------------------
+
+def _egress_app(port, extra=""):
+    return (HOST + STOCK.replace("StockStream", "S")
+            + f"@sink(type='tcp', host='127.0.0.1', port='{port}'{extra})\n"
+              "define stream Out (symbol string, price double);\n"
+              "@info(name='q') from S[price > 100] select symbol, price "
+              "insert into Out;\n")
+
+
+def test_tcp_sink_batched_egress():
+    rx = FrameReceiver()
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(_egress_app(rx.port))
+    rt.start()
+    h = rt.input_handler("S")
+    h.send_batch({"symbol": ["A", "B", "C"], "price": [111.0, 5.0, 123.0],
+                  "volume": [1, 2, 3]},
+                 np.array([10, 11, 12], dtype=np.int64))
+    rt.flush()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and len(rx.rows("Out")) < 2:
+        time.sleep(0.01)
+    assert rx.rows("Out") == [(10, ("A", 111.0)), (12, ("C", 123.0))]
+    sink = rt.sinks[0]
+    assert sink.frames_out == 1            # batched: ONE frame, 2 events
+    mgr.shutdown()
+    rx.stop()
+
+
+def test_tcp_sink_retry_store_replay_roundtrip():
+    rx = FrameReceiver()
+    port = rx.port
+    rx.stop()                              # peer down
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(_egress_app(
+        port, ", on.error='store', max.retries='1', retry.interval='1 ms'"))
+    with pytest.warns(RuntimeWarning, match="deferring"):
+        rt.start()
+    h = rt.input_handler("S")
+    h.send_batch({"symbol": ["A"], "price": [111.0], "volume": [1]},
+                 np.array([10], dtype=np.int64))
+    rt.flush()
+    assert len(rt.error_store) == 1        # captured after retries
+    rx2 = FrameReceiver(port=port)         # peer recovers on same port
+    rep = rt.error_store.replay(rt)
+    assert rep["remaining"] == 0
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not rx2.rows("Out"):
+        time.sleep(0.01)
+    assert rx2.rows("Out") == [(10, ("A", 111.0))]
+    mgr.shutdown()
+    rx2.stop()
+
+
+def test_ws_sink_roundtrip_via_net_source():
+    """Engine-to-engine: a ws sink feeding another app's frame server."""
+    mgr = SiddhiManager()
+    rt_down = mgr.create_app_runtime(
+        HOST + "@app:name('Down')\n@source(type='tcp', port='0')\n"
+        "define stream Out (symbol string, price double);\n"
+        "@info(name='q2') from Out select symbol insert into Final;\n")
+    n_final = [0]
+    rt_down.add_batch_callback(
+        "Final", lambda b: n_final.__setitem__(0, n_final[0] + b.n))
+    rt_down.start()
+    port = rt_down.sources[0].port
+    rt_up = mgr.create_app_runtime(
+        HOST + "@app:name('Up')\n" + STOCK.replace("StockStream", "S")
+        + f"@sink(type='ws', host='127.0.0.1', port='{port}')\n"
+          "define stream Out (symbol string, price double);\n"
+          "@info(name='q') from S[price > 100] select symbol, price "
+          "insert into Out;\n")
+    rt_up.start()
+    rt_up.input_handler("S").send_batch(
+        {"symbol": ["A", "B"], "price": [111.0, 5.0], "volume": [1, 2]},
+        np.array([10, 11], dtype=np.int64))
+    rt_up.flush()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and n_final[0] < 1:
+        rt_down.flush()
+        time.sleep(0.01)
+    assert n_final[0] == 1
+    mgr.shutdown()
+
+def test_server_credit_disabled_client_does_not_deadlock():
+    """HELLO_OK with credit 0 means the server negotiated crediting
+    OFF — a default (credit-wanting) client must ship freely instead
+    of blocking for CREDIT frames that will never come."""
+    batches = make_batches(n_batches=3, batch=8)
+    host = run_inproc(
+        STOCK + "@info(name='q') from StockStream select symbol "
+                "insert into Out;\n", batches)
+    rows, stats = run_wire(
+        "@source(type='tcp', port='0', credit='0')\n" + STOCK,
+        "@info(name='q') from StockStream select symbol insert into Out;\n",
+        batches)
+    assert rows == host
+    net = stats["net"]["StockStream"]
+    assert net["frames_in"] == 3 and net["credit_granted"] == 0
+
+
+def test_ws_sink_defers_on_hello_rejection():
+    """A peer that is UP but rejects the negotiation (unknown stream →
+    ERROR frame) must defer an armed ws sink to per-publish retry —
+    the same contract the tcp sink honors — not crash rt.start()."""
+    mgr = SiddhiManager()
+    rt_down = mgr.create_app_runtime(
+        HOST + "@app:name('D2')\n@source(type='tcp', port='0')\n"
+        "define stream Different (x int);\n"
+        "@info(name='q2') from Different select x insert into Sink2;\n")
+    rt_down.start()
+    port = rt_down.sources[0].port
+    rt_up = mgr.create_app_runtime(
+        HOST + "@app:name('U2')\n" + STOCK.replace("StockStream", "S")
+        + f"@sink(type='ws', host='127.0.0.1', port='{port}', "
+          "on.error='store', max.retries='1', retry.interval='1 ms')\n"
+          "define stream Out (symbol string, price double);\n"
+          "@info(name='q') from S[price > 100] select symbol, price "
+          "insert into Out;\n")
+    with pytest.warns(RuntimeWarning, match="deferring"):
+        rt_up.start()
+    rt_up.input_handler("S").send_batch(
+        {"symbol": ["A"], "price": [111.0], "volume": [1]},
+        np.array([10], dtype=np.int64))
+    rt_up.flush()
+    assert len(rt_up.error_store) == 1     # captured, engine alive
+    mgr.shutdown()
+
+
+def test_corrupt_frame_rejected_without_killing_connection():
+    """A CRC-corrupted or truncated-payload DATA frame on a NEGOTIATED
+    connection is rejected (ERROR frame, protocol_errors counted) while
+    the SAME connection keeps serving: the length prefix consumed the
+    bad frame whole, so framing stays aligned."""
+    import socket
+    from siddhi_tpu.net import frame as fp
+    app = HOST + "@source(type='tcp', port='0')\n" + STOCK + \
+        "@info(name='q') from StockStream select symbol insert into Out;\n"
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(app)
+    n_out = [0]
+    rt.add_batch_callback("Out", lambda b: n_out.__setitem__(0, n_out[0] + b.n))
+    rt.start()
+    sock = socket.create_connection(("127.0.0.1", rt.sources[0].port))
+    read = fp.reader_for(sock)
+    sock.sendall(fp.encode_hello(
+        "", "StockStream", [("symbol", "string"), ("price", "double"),
+                            ("volume", "int")], credit=False))
+    assert fp.read_frame(read)[0] == fp.HELLO_OK
+    sock.sendall(fp.encode_strings(["K0"], start_code=1))
+
+    def data_blob(ts0):
+        return fp.encode_data(
+            np.arange(ts0, ts0 + 4, dtype=np.int64),
+            [np.ones(4, np.int32), np.full(4, 101.0),
+             np.arange(4, dtype=np.int32)])
+
+    sock.sendall(data_blob(0))                      # good
+    corrupt = bytearray(data_blob(4))
+    corrupt[-6] ^= 0xFF                             # CRC now fails
+    sock.sendall(bytes(corrupt))
+    good = data_blob(8)
+    # truncated PAYLOAD: valid frame envelope, short column buffers
+    sock.sendall(fp.encode_frame(fp.DATA, good[8:-12]))
+    sock.sendall(data_blob(12))                     # good again
+    sock.sendall(fp.encode_ping(1))
+    errors = 0
+    while True:
+        ftype, payload = fp.read_frame(read)
+        if ftype == fp.ERROR:
+            errors += 1
+        elif ftype == fp.ACK:
+            assert fp.decode_u64(payload) == 1
+            break
+    assert errors == 2                   # one per rejected frame
+    assert n_out[0] == 8                 # both GOOD frames landed
+    net = rt.statistics()["net"]["StockStream"]
+    assert net["protocol_errors"] == 2
+    assert net["shed_events"] == 0       # rejection is not shedding
+    sock.close()
+    mgr.shutdown()
+
+
+def test_block_policy_backpressure_paces_producer():
+    """Paced overload against a 'block'-policy rate limit: the server
+    stops draining + withholds CREDIT, the producer stalls in
+    _respect_credit, and every event is delivered — throughput capped,
+    zero shed."""
+    rate, burst = 1000.0, 64.0
+    app = HOST + ("@source(type='tcp', port='0', "
+                  f"rate.limit='{rate:.0f}', burst='{burst:.0f}', "
+                  "shed.policy='block', credit='2')\n") + STOCK + \
+        "@info(name='q') from StockStream select symbol insert into Out;\n"
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(app)
+    n_out = [0]
+    rt.add_batch_callback("Out", lambda b: n_out.__setitem__(0, n_out[0] + b.n))
+    rt.start()
+    cols = TcpFrameClient.cols_of_schema(rt.schemas["StockStream"])
+    cli = TcpFrameClient("127.0.0.1", rt.sources[0].port, "StockStream",
+                         cols)
+    batches = make_batches(n_batches=6, batch=64)   # 384 events, 64 burst
+    t0 = time.monotonic()
+    for c, ts in batches:
+        cli.send_batch(c, ts)           # stalls once credit dries up
+    cli.barrier(timeout=60)
+    elapsed = time.monotonic() - t0
+    cli.close()
+    m = rt.admission["StockStream"].metrics()
+    assert n_out[0] == 384              # nothing shed, nothing lost
+    assert m["shed_events"] == 0
+    assert m["admitted_events"] == 384
+    # 320 post-burst events at 1000 eps: the wire CANNOT finish faster
+    # than the refill (generous lower bound only — no flaky upper)
+    assert elapsed >= 0.25, elapsed
+    assert m["blocked_seconds"] > 0.05
+    mgr.shutdown()
+
+
+def test_net_decode_fault_kills_connection_accountably():
+    """An injected net.decode fault is connection-fatal like a corrupt
+    frame off the wire: protocol_errors must tick and the server must
+    keep serving new connections — the RuntimeError escaping the serve
+    loop unhandled (dead thread, no accounting) is the regression."""
+    from siddhi_tpu.core.faults import FaultInjector
+    app = HOST + "@source(type='tcp', port='0')\n" + STOCK + \
+        "@info(name='q') from StockStream select symbol insert into Out;\n"
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(app)
+    n_out = [0]
+    rt.add_batch_callback("Out", lambda b: n_out.__setitem__(0, n_out[0] + b.n))
+    rt.start()
+    rt.fault_injector = FaultInjector(counts={"net.decode": 1})
+    cols = TcpFrameClient.cols_of_schema(rt.schemas["StockStream"])
+    port = rt.sources[0].port
+    cli = TcpFrameClient("127.0.0.1", port, "StockStream", cols)
+    c, ts = make_batches(n_batches=1, batch=16)[0]
+    cli.send_batch(c, ts)
+    with pytest.raises(Exception):        # server drops the connection
+        cli.barrier(timeout=10)
+    try:
+        cli.close()
+    except OSError:
+        pass
+    rt.fault_injector = None
+    deadline = time.monotonic() + 5       # accounting lands post-close
+    while time.monotonic() < deadline \
+            and rt.statistics()["net"]["StockStream"]["protocol_errors"] < 1:
+        time.sleep(0.02)
+    assert rt.statistics()["net"]["StockStream"]["protocol_errors"] >= 1
+    cli2 = TcpFrameClient("127.0.0.1", port, "StockStream", cols)
+    cli2.send_batch(c, ts)                # fresh connection serves fine
+    cli2.barrier()
+    assert n_out[0] == 16
+    cli2.close()
+    mgr.shutdown()
+
+
+def test_ring_consumer_survives_producer_bye():
+    """BYE ends one PRODUCER, not the ring: a second producer attaching
+    to the same ring must still be consumed — the consumer thread used
+    to exit permanently on the first BYE, stalling later producers."""
+    app = HOST + "@source(type='shm')\n" + STOCK + \
+        "@info(name='q') from StockStream select symbol insert into Out;\n"
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(app)
+    n_out = [0]
+    rt.add_batch_callback("Out", lambda b: n_out.__setitem__(0, n_out[0] + b.n))
+    rt.start()
+    cols = RingProducer.cols_of_schema(rt.schemas["StockStream"])
+    c, ts = make_batches(n_batches=1, batch=16)[0]
+    p1 = RingProducer(rt.sources[0].ring_name, "StockStream", cols)
+    p1.send_batch(c, ts)
+    p1.close()                             # sends BYE into the ring
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and n_out[0] < 16:
+        rt.flush()
+        time.sleep(0.02)
+    assert n_out[0] == 16
+    p2 = RingProducer(rt.sources[0].ring_name, "StockStream", cols)
+    p2.send_batch(c, ts)                   # re-HELLOs, then DATA
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and n_out[0] < 32:
+        rt.flush()
+        time.sleep(0.02)
+    assert n_out[0] == 32                  # consumer alive after BYE
+    p2.close()
+    mgr.shutdown()
+
+
+def test_tcp_sink_ships_each_strings_delta_once():
+    """Each payload's embedded STRINGS delta must advance the sink's
+    peer-sync mark: re-shipping it as catch-up on the next publish
+    doubles dictionary bytes on every high-cardinality stream."""
+    rx = FrameReceiver()
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(_egress_app(rx.port))
+    rt.start()
+    h = rt.input_handler("S")
+    h.send_batch({"symbol": ["A", "B"], "price": [111.0, 112.0],
+                  "volume": [1, 2]}, np.array([10, 11], dtype=np.int64))
+    rt.flush()
+    h.send_batch({"symbol": ["C", "D"], "price": [113.0, 114.0],
+                  "volume": [1, 2]}, np.array([12, 13], dtype=np.int64))
+    rt.flush()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and len(rx.rows("Out")) < 4:
+        time.sleep(0.01)
+    assert len(rx.rows("Out")) == 4
+    # connect-time table was empty (no replay); each payload embeds its
+    # own delta; NO standalone catch-up frames may ride between them
+    assert rx.strings_frames == 2
+    mgr.shutdown()
+    rx.stop()

@@ -72,7 +72,10 @@ def _run(head, q, n=1800, batches=6, seed=11, dt=9):
     return chunked, rows
 
 
-@pytest.mark.parametrize("name", list(QUERIES))
+@pytest.mark.parametrize("name", [
+    pytest.param(n, marks=pytest.mark.slow)
+    if n in ("head_count", "count") else n
+    for n in QUERIES])
 def test_chunked_differential(name):
     q = QUERIES[name]
     chunked, dev = _run("@app:devicePatterns('always')\n", q)

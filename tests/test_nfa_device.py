@@ -170,6 +170,7 @@ def test_snapshot_restore_device(mgr):
     assert out == [(101.0, 102.0)]
 
 
+@pytest.mark.slow
 def test_differential_random(mgr):
     """Fuzz: random event tapes through device and host matchers."""
     rng = np.random.default_rng(7)

@@ -169,6 +169,7 @@ def test_absent_mid_chain(mgr):
     assert dev2 == host2
 
 
+@pytest.mark.slow
 def test_differential_random_algebra(mgr):
     """Fuzz the new shapes against the host oracle."""
     rng = np.random.default_rng(11)
@@ -340,7 +341,10 @@ R4_QUERIES = {
 }
 
 
-@pytest.mark.parametrize("name", list(R4_QUERIES))
+@pytest.mark.parametrize("name", [
+    pytest.param(n, marks=pytest.mark.slow)
+    if n in ("two_counts_separated", "every_head_and_below") else n
+    for n in R4_QUERIES])
 def test_differential_r4_algebra(mgr, name):
     body = ("define stream S (p double);\n@info(name='q') "
             + R4_QUERIES[name])

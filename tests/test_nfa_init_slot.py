@@ -185,6 +185,7 @@ FUZZ_SHAPES = [
 ]
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("si", range(len(FUZZ_SHAPES)))
 def test_fuzz_new_shapes(si):
     rng = np.random.default_rng(100 + si)
@@ -232,6 +233,7 @@ def test_opt_count_after_count_with_mids():
     assert out == [("A", "C")]
 
 
+@pytest.mark.slow
 def test_opt_count_after_count_fuzz():
     rng = np.random.default_rng(77)
     streams = ["S1", "S2", "S3"]

@@ -232,6 +232,7 @@ def test_default_selection_prefers_parallel_families():
     assert fam == "scan" and families["dfa"] is True
 
 
+@pytest.mark.slow
 def test_cross_flush_tail_replay_many_small_flushes(host_rows):
     # many tiny flushes hammer the replay/dedup path: within 1 sec, dt=9
     # -> the tail spans several flushes of 60 events
@@ -248,6 +249,7 @@ def test_cross_flush_tail_replay_many_small_flushes(host_rows):
         assert dev == host, (fam, used, len(dev), len(host))
 
 
+@pytest.mark.slow
 def test_family_switch_regeometry_between_flushes():
     # stateless<->stateless family switches at flush boundaries are
     # output-invariant: start on the default (scan), switch to dfa
@@ -309,6 +311,7 @@ def test_family_gauges_in_statistics():
         isinstance(dev["family_ineligible"], dict)
 
 
+@pytest.mark.slow
 def test_out_of_order_expiry_matches_sequential():
     """The sequential kernel expires a waiting instance on ANY arriving
     event past the `within` horizon — even a non-matching one — so a
@@ -347,6 +350,7 @@ def test_out_of_order_expiry_matches_sequential():
         assert dev == host, (fam, dev, host)
 
 
+@pytest.mark.slow
 def test_threshold_hop_nan_column_matches_sequential():
     """A NaN in the threshold column must behave like the sequential
     kernel's per-event compare (NaN compares False): it neither

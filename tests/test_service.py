@@ -109,3 +109,472 @@ def test_statistics_false_opts_out_of_service_stats(svc):
             f"http://127.0.0.1:{svc.port}/metrics?siddhiApp=Quiet") as r:
         text = r.read().decode()
     assert "siddhi_tpu_events_total" not in text
+
+
+# ---------------------------------------------------------------------------
+# batch event endpoint (shared validation path)
+# ---------------------------------------------------------------------------
+
+def test_event_endpoint_batch_rows(svc):
+    _post(svc, "/siddhi/artifact/deploy", APP, raw=True)
+    r = _post(svc, "/siddhi/artifact/event",
+              {"app": "RestApp", "stream": "S",
+               "data": [["IBM", 42.0], ["ACME", 5.0], ["WSO2", 77.0]]})
+    assert r == {"status": "ok", "events": 3}
+    rows = _post(svc, "/siddhi/artifact/query",
+                 {"app": "RestApp", "query": "from T select sym, p"})["rows"]
+    assert sorted(r[1][0] for r in rows) == ["IBM", "WSO2"]
+
+
+def test_event_endpoint_events_form_with_timestamps(svc):
+    _post(svc, "/siddhi/artifact/deploy", APP, raw=True)
+    r = _post(svc, "/siddhi/artifact/event",
+              {"app": "RestApp", "stream": "S",
+               "events": [{"data": ["IBM", 42.0], "timestamp": 1000},
+                          {"data": ["WSO2", 77.0]}]})
+    assert r["events"] == 2
+
+
+@pytest.mark.parametrize("body,frag", [
+    ({"app": "RestApp", "stream": "S", "data": [["IBM"]]},
+     "expects 2 attributes"),
+    ({"app": "RestApp", "stream": "S", "data": "nope"}, "must be a list"),
+    ({"app": "RestApp", "stream": "Nope", "data": ["IBM", 1.0]},
+     "no stream"),
+    ({"app": "Nope", "stream": "S", "data": ["IBM", 1.0]},
+     "no deployed app"),
+    ({"app": "RestApp", "stream": "S",
+      "events": [{"nodata": 1}]}, "events[0]"),
+    ({"app": "RestApp", "stream": "S", "data": ["IBM", 1.0],
+      "timestamp": "soon"}, "must be a number"),
+])
+def test_event_endpoint_malformed_is_400_json(svc, body, frag):
+    _post(svc, "/siddhi/artifact/deploy", APP, raw=True)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(svc, "/siddhi/artifact/event", body)
+    assert e.value.code == 400
+    assert frag in json.loads(e.value.read())["error"]
+
+
+def test_event_endpoint_non_json_body_is_400(svc):
+    _post(svc, "/siddhi/artifact/deploy", APP, raw=True)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(svc, "/siddhi/artifact/event", "{not json", raw=True)
+    assert e.value.code == 400
+    assert "not JSON" in json.loads(e.value.read())["error"]
+
+
+# ---------------------------------------------------------------------------
+# serving data plane (siddhi_tpu/net) front door
+# ---------------------------------------------------------------------------
+
+NET_APP = """
+@app:name('NetFront')
+@app:deviceFilters('never')
+define stream S (sym string, p double);
+@info(name='q') from S[p > 10] select sym, p insert into Out;
+"""
+
+
+def _net_client(svc, app="NetFront", stream="S", credit=True):
+    from siddhi_tpu.net import TcpFrameClient
+    rt = svc.runtimes[app]
+    cols = TcpFrameClient.cols_of_schema(rt.schemas[stream])
+    return TcpFrameClient("127.0.0.1", svc.net_port, stream, cols,
+                          app=app, credit=credit)
+
+
+def test_service_data_plane_feeds_deployed_app(svc):
+    import numpy as np
+    _post(svc, "/siddhi/artifact/deploy", NET_APP, raw=True)
+    rt = svc.runtimes["NetFront"]
+    n_out = [0]
+    rt.add_batch_callback("Out", lambda b: n_out.__setitem__(0, n_out[0] + b.n))
+    cli = _net_client(svc)
+    cli.send_batch({"sym": np.array(["A", "B"]),
+                    "p": np.array([11.0, 5.0])},
+                   np.array([1, 2], dtype=np.int64))
+    cli.barrier()
+    assert n_out[0] == 1
+    info = _get(svc, "/siddhi/net")
+    assert info["enabled"] and info["port"] == svc.net_port
+    assert info["streams"]["NetFront/S"]["events_in"] == 2
+    cli.close()
+
+
+def test_service_net_unknown_app_rejected(svc):
+    from siddhi_tpu.net import NetClientError, TcpFrameClient
+    with pytest.raises(NetClientError, match="no deployed app"):
+        TcpFrameClient("127.0.0.1", svc.net_port, "S",
+                       [("sym", "string")], app="Ghost")
+
+
+def test_deploy_undeploy_racing_ingest_never_drops_admitted_frames(svc):
+    """The satellite invariant: deploy/undeploy racing live data-plane
+    ingest on another thread never drops or double-delivers an admitted
+    frame; admitted-then-undeployed frames land in the ErrorStore."""
+    import threading
+    import numpy as np
+    _post(svc, "/siddhi/artifact/deploy", NET_APP, raw=True)
+    rt = svc.runtimes["NetFront"]
+    delivered = [0]
+    rt.add_batch_callback("S", lambda b: delivered.__setitem__(
+        0, delivered[0] + b.n))
+    cli = _net_client(svc, credit=False)
+    sent = [0]
+    stop = [False]
+
+    def feeder():
+        while not stop[0]:
+            try:
+                cli.send_batch({"sym": np.array(["Z"]),
+                                "p": np.array([99.0])},
+                               np.array([sent[0]], dtype=np.int64))
+                sent[0] += 1
+            except Exception:
+                return
+
+    t = threading.Thread(target=feeder)
+    t.start()
+    try:
+        import time
+        time.sleep(0.05)
+        _get(svc, "/siddhi/artifact/undeploy?siddhiApp=NetFront")
+        time.sleep(0.05)
+    finally:
+        stop[0] = True
+        t.join()
+        cli.close()
+    import time
+    time.sleep(0.3)                       # server drains its socket
+    store = svc.retired_errors["NetFront"]
+    parked = sum(len(e.events or ()) for e in store.entries("S")
+                 if e.point == "net.undeployed")
+    # every event the server ADMITTED is either delivered-live or
+    # parked in the ErrorStore — exactly once each.  (Frames still in
+    # the client's socket buffer at close were never admitted.)
+    admitted = rt.admission["S"].metrics()["admitted_events"]
+    assert delivered[0] + parked == admitted
+    assert parked > 0                     # the race actually happened
+    assert delivered[0] > 0
+
+
+def test_redeploy_same_name_serves_new_runtime(svc):
+    import numpy as np
+    _post(svc, "/siddhi/artifact/deploy", NET_APP, raw=True)
+    cli = _net_client(svc)
+    cli.send_batch({"sym": np.array(["A"]), "p": np.array([11.0])},
+                   np.array([1], dtype=np.int64))
+    cli.barrier()
+    old_rt = svc.runtimes["NetFront"]
+    _post(svc, "/siddhi/artifact/deploy", NET_APP, raw=True)  # redeploy
+    new_rt = svc.runtimes["NetFront"]
+    assert new_rt is not old_rt
+    # the OLD connection's frames now park in the old store (old rt is
+    # a zombie), while a NEW connection reaches the new runtime
+    cli.send_batch({"sym": np.array(["B"]), "p": np.array([12.0])},
+                   np.array([2], dtype=np.int64))
+    cli.barrier()
+    assert any(e.point == "net.undeployed"
+               for e in old_rt.error_store.entries("S"))
+    n_out = [0]
+    new_rt.add_batch_callback("Out", lambda b: n_out.__setitem__(
+        0, n_out[0] + b.n))
+    cli2 = _net_client(svc)
+    cli2.send_batch({"sym": np.array(["C"]), "p": np.array([13.0])},
+                    np.array([3], dtype=np.int64))
+    cli2.barrier()
+    assert n_out[0] == 1
+    cli.close()
+    cli2.close()
+
+
+def test_stop_joins_handler_threads_bounded():
+    """Service teardown is clean and bounded even with handler threads
+    that served requests (daemon_threads + tracked joins)."""
+    import time
+    s = SiddhiService(port=0).start()
+    _post(s, "/siddhi/artifact/deploy", APP, raw=True)
+    for _ in range(3):
+        _get(s, "/siddhi/artifact/apps")
+    t0 = time.monotonic()
+    s.stop()
+    assert time.monotonic() - t0 < 10.0
+    assert s.httpd._handler_threads == []
+    # idempotent-ish: a second stop must not raise
+    import threading
+    assert all(not t.is_alive() for t in threading.enumerate()
+               if t.name.startswith("siddhi-service-net"))
+
+def test_retired_errors_listable_and_replayable_after_redeploy(svc):
+    """Frames parked by an undeploy stay reachable through the errors
+    API: listable while the name is down, replayable once it returns."""
+    _post(svc, "/siddhi/artifact/deploy", NET_APP, raw=True)
+    rt = svc.runtimes["NetFront"]
+    rt.error_store.add("S", "net.undeployed", "undeployed mid-feed", 1,
+                       events=[(1, ("A", 11.0))])
+    _get(svc, "/siddhi/artifact/undeploy?siddhiApp=NetFront")
+    errs = _get(svc, "/siddhi/errors?siddhiApp=NetFront")["errors"]
+    assert len(errs) == 1 and errs[0]["parked"] is True
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(svc, "/siddhi/errors", {"app": "NetFront", "action": "replay"})
+    assert e.value.code == 400
+    assert "redeploy" in json.loads(e.value.read())["error"]
+    _post(svc, "/siddhi/artifact/deploy", NET_APP, raw=True)
+    new_rt = svc.runtimes["NetFront"]
+    n_out = [0]
+    new_rt.add_batch_callback("Out", lambda b: n_out.__setitem__(
+        0, n_out[0] + b.n))
+    rep = _post(svc, "/siddhi/errors", {"app": "NetFront", "action": "replay"})
+    assert rep["replayed"] == 1 and rep["remaining"] == 0
+    assert n_out[0] == 1
+    assert not _get(svc, "/siddhi/errors?siddhiApp=NetFront")["errors"]
+
+
+def test_rehello_rebinds_connection_and_resets_string_state(svc):
+    """A second HELLO re-negotiates the connection: the string remap
+    restarts with it, so codes from the previous binding can never leak
+    into the new runtime — reuse without a fresh delta fails loudly."""
+    import socket
+    import numpy as np
+    from siddhi_tpu.net import frame as fp
+    _post(svc, "/siddhi/artifact/deploy", NET_APP, raw=True)
+    _post(svc, "/siddhi/artifact/deploy",
+          NET_APP.replace("NetFront", "NetFrontB"), raw=True)
+    rt_a = svc.runtimes["NetFront"]
+    rt_b = svc.runtimes["NetFrontB"]
+    out_a, out_b = [], []
+    rt_a.add_batch_callback("Out", lambda b: out_a.extend(
+        map(tuple, b.rows(rt_a.strings))))
+    rt_b.add_batch_callback("Out", lambda b: out_b.extend(
+        map(tuple, b.rows(rt_b.strings))))
+    cols = [("sym", "string"), ("p", "double")]
+    sock = socket.create_connection(("127.0.0.1", svc.net_port))
+    read = fp.reader_for(sock)
+    sock.sendall(fp.encode_hello("NetFront", "S", cols, credit=False))
+    assert fp.read_frame(read)[0] == fp.HELLO_OK
+    sock.sendall(fp.encode_strings(["AAA"], start_code=1))
+    sock.sendall(fp.encode_data(np.array([1], dtype=np.int64),
+                                [np.array([1], dtype=np.int32),
+                                 np.array([11.0])]))
+    sock.sendall(fp.encode_ping(1))
+    while fp.read_frame(read)[0] != fp.ACK:
+        pass
+    assert out_a == [("AAA", 11.0)]
+    # re-HELLO to app B: a fresh delta re-using start code 1 must bind
+    # cleanly to the NEW runtime
+    sock.sendall(fp.encode_hello("NetFrontB", "S", cols, credit=False))
+    assert fp.read_frame(read)[0] == fp.HELLO_OK
+    sock.sendall(fp.encode_strings(["BBB"], start_code=1))
+    sock.sendall(fp.encode_data(np.array([2], dtype=np.int64),
+                                [np.array([1], dtype=np.int32),
+                                 np.array([12.0])]))
+    sock.sendall(fp.encode_ping(2))
+    while fp.read_frame(read)[0] != fp.ACK:
+        pass
+    assert out_b == [("BBB", 12.0)]
+    # re-HELLO back to A, then DATA WITHOUT re-shipping the dictionary:
+    # the stale codes must be rejected loudly, never silently remapped
+    sock.sendall(fp.encode_hello("NetFront", "S", cols, credit=False))
+    assert fp.read_frame(read)[0] == fp.HELLO_OK
+    sock.sendall(fp.encode_data(np.array([3], dtype=np.int64),
+                                [np.array([1], dtype=np.int32),
+                                 np.array([13.0])]))
+    ftype, payload = fp.read_frame(read)
+    assert ftype == fp.ERROR
+    assert "never declared" in json.loads(payload)["error"]
+    sock.close()
+    assert out_a == [("AAA", 11.0)]        # nothing leaked into A
+
+
+RATED_APP = """
+@app:name('RatedRest')
+@app:deviceFilters('never')
+@source(type='tcp', port='0', rate.limit='2', burst='5',
+        shed.policy='shed')
+define stream S (sym string, p double);
+@info(name='q') from S[p > 10] select sym, p insert into Out;
+"""
+
+
+def test_rest_event_shares_admission_quota_and_sheds(svc):
+    """REST ingest rides the SAME admission controller as the frame
+    plane: past the token bucket it sheds into the replayable
+    ErrorStore with a 429 — and replay restores every event."""
+    import urllib.error
+    _post(svc, "/siddhi/artifact/deploy", RATED_APP, raw=True)
+    rt = svc.runtimes["RatedRest"]
+    delivered = [0]
+    rt.add_batch_callback("S", lambda b: delivered.__setitem__(
+        0, delivered[0] + b.n))
+    codes = []
+    for i in range(8):                   # burst=5: the tail must shed
+        try:
+            r = _post(svc, "/siddhi/artifact/event",
+                      {"app": "RatedRest", "stream": "S",
+                       "data": [f"K{i}", 11.0 + i], "timestamp": 1000 + i})
+            codes.append(("ok", r))
+        except urllib.error.HTTPError as e:
+            body = json.loads(e.read())
+            codes.append((e.code, body))
+    oks = [c for c in codes if c[0] == "ok"]
+    sheds = [c for c in codes if c[0] == 429]
+    assert len(oks) + len(sheds) == 8 and sheds, codes
+    assert all(b["status"] == "shed" and b["stored"] for _, b in sheds)
+    m = rt.admission["S"].metrics()
+    assert m["shed_events"] == len(sheds)
+    assert m["admitted_events"] == len(oks)
+    # shared accounting surfaces in /metrics alongside the frame plane
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.port}/metrics?siddhiApp=RatedRest") as r:
+        text = r.read().decode()
+    assert f'siddhi_tpu_net_shed_events_total{{app="RatedRest",' \
+           f'stream="S"}} {len(sheds)}' in text
+    # zero silent loss: lift the limit, replay restores every shed event
+    rt.admission["S"].bucket.rate = None
+    rep = _post(svc, "/siddhi/errors", {"app": "RatedRest",
+                                        "action": "replay"})
+    rt.flush()
+    assert rep["remaining"] == 0
+    assert delivered[0] == 8
+
+
+def test_rest_event_unlimited_stream_still_accounted(svc):
+    """An app with NO net source gets a default (unlimited) controller
+    on first REST ingest, so REST telemetry shows up in the net
+    section either way."""
+    _post(svc, "/siddhi/artifact/deploy", APP, raw=True)
+    r = _post(svc, "/siddhi/artifact/event",
+              {"app": "RestApp", "stream": "S",
+               "data": [["A", 11.0], ["B", 12.0]]})
+    assert r == {"status": "ok", "events": 2}
+    m = svc.runtimes["RestApp"].admission["S"].metrics()
+    assert m["admitted_events"] == 2 and m["shed_events"] == 0
+    assert m["frames_in"] == 1           # one REST batch = one "frame"
+
+
+def test_park_merge_preserves_prior_generation(svc):
+    """Two undeploy cycles of the same name with unreplayed entries:
+    the first generation's entries must merge into the newly parked
+    store (oldest first) — never orphaned in a store nothing lists."""
+    _post(svc, "/siddhi/artifact/deploy", NET_APP, raw=True)
+    svc.runtimes["NetFront"].error_store.add(
+        "S", "net.shed", "gen1", 1, events=[(1, ("A", 11.0))])
+    _get(svc, "/siddhi/artifact/undeploy?siddhiApp=NetFront")
+    _post(svc, "/siddhi/artifact/deploy", NET_APP, raw=True)
+    svc.runtimes["NetFront"].error_store.add(
+        "S", "net.shed", "gen2", 2, events=[(2, ("B", 12.0))])
+    _get(svc, "/siddhi/artifact/undeploy?siddhiApp=NetFront")
+    errs = _get(svc, "/siddhi/errors?siddhiApp=NetFront")["errors"]
+    assert [e["error"] for e in errs] == ["gen1", "gen2"]    # oldest first
+    assert all(e["parked"] for e in errs)
+    _post(svc, "/siddhi/artifact/deploy", NET_APP, raw=True)
+    rt = svc.runtimes["NetFront"]
+    seen = []
+    rt.add_batch_callback("Out", lambda b: seen.extend(
+        map(tuple, b.rows(rt.strings))))
+    rep = _post(svc, "/siddhi/errors", {"app": "NetFront",
+                                        "action": "replay"})
+    rt.flush()
+    assert rep["replayed"] == 2 and rep["remaining"] == 0
+    assert sorted(seen) == [("A", 11.0), ("B", 12.0)]
+
+
+def test_errors_action_ids_resolve_live_before_parked(svc):
+    """Live and parked stores number entries independently: an explicit
+    id aimed at a live entry must not also consume the unrelated parked
+    entry holding the same id."""
+    _post(svc, "/siddhi/artifact/deploy", NET_APP, raw=True)
+    svc.runtimes["NetFront"].error_store.add(
+        "S", "net.shed", "parked-one", 1, events=[(1, ("A", 11.0))])
+    _get(svc, "/siddhi/artifact/undeploy?siddhiApp=NetFront")
+    _post(svc, "/siddhi/artifact/deploy", NET_APP, raw=True)
+    live = svc.runtimes["NetFront"].error_store
+    live.add("S", "net.shed", "live-one", 2, events=[(2, ("B", 12.0))])
+    live_id = live.entries("S")[0].id
+    parked_id = svc.retired_errors["NetFront"].entries("S")[0].id
+    assert live_id == parked_id          # the collision under test
+    r = _post(svc, "/siddhi/errors", {"app": "NetFront",
+                                      "action": "discard",
+                                      "ids": [live_id]})
+    assert r == {"discarded": 1, "remaining": 1}
+    errs = _get(svc, "/siddhi/errors?siddhiApp=NetFront")["errors"]
+    assert [e["error"] for e in errs] == ["parked-one"]
+    assert errs[0]["parked"] is True
+
+
+OLDEST_APP = """
+@app:name('OldestRest')
+@app:deviceFilters('never')
+@source(type='tcp', port='0', rate.limit='5', burst='5',
+        shed.policy='oldest')
+define stream S (sym string, p double);
+@info(name='q') from S select sym, p insert into Out;
+"""
+
+
+def test_rest_type_bad_value_is_400_not_engine_poison(svc):
+    """A type-bad value (string where a double belongs) passes the old
+    arity-only validation, gets buffered by rt.send, and then fails at
+    flush INSIDE the batch builder — breaking every later flush of the
+    app.  It must 400 at the boundary and leave the app healthy."""
+    import urllib.error
+    _post(svc, "/siddhi/artifact/deploy", OLDEST_APP, raw=True)
+    rt = svc.runtimes["OldestRest"]
+    delivered = []
+    rt.add_callback("Out", lambda evs: delivered.extend(e.data for e in evs))
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(svc, "/siddhi/artifact/event",
+              {"app": "OldestRest", "stream": "S",
+               "data": ["bad", "not-a-double"]})
+    assert ei.value.code == 400
+    assert "expects a number" in json.loads(ei.value.read())["error"]
+    # the app is NOT poisoned: a valid event still flows end to end
+    r = _post(svc, "/siddhi/artifact/event",
+              {"app": "OldestRest", "stream": "S", "data": ["good", 99.0]})
+    assert r["status"] == "ok"
+    rt.flush()
+    assert ("good", 99.0) in delivered
+
+
+def test_rest_queued_bad_batch_cannot_poison_later_requests(svc):
+    """A queued ('oldest') REST batch whose feed raises — type-bad data
+    passes arity validation and fails at flush — must capture into the
+    ErrorStore when drained, NOT fail whichever unrelated request (or
+    connection thread) happened to drain it."""
+    import time
+
+    from siddhi_tpu.net.admission import Work
+    _post(svc, "/siddhi/artifact/deploy", OLDEST_APP, raw=True)
+    rt = svc.runtimes["OldestRest"]
+    delivered = []
+    rt.add_callback("Out", lambda evs: delivered.extend(e.data for e in evs))
+    rt._pump_admission = lambda: None    # only REST drains the queue
+    r = _post(svc, "/siddhi/artifact/event",
+              {"app": "OldestRest", "stream": "S",
+               "data": [["K0", 1.0]]})
+    assert r["status"] == "ok"
+    ctrl = rt.admission["S"]
+
+    def boom():
+        raise RuntimeError("synthetic feed failure")
+
+    poison = Work(n=1, nbytes=10, feed=boom,
+                  rows=lambda: [(0, ("X", 0.0))], stream_id="S")
+    with ctrl._lock:                     # park a poisoned queue head
+        ctrl._pending.append(poison)
+        ctrl.pending_bytes += poison.nbytes
+    time.sleep(0.3)                      # tokens refill for the head
+    # a VALID request drains the poisoned head: it must never see an
+    # error for someone else's work
+    r = _post(svc, "/siddhi/artifact/event",
+              {"app": "OldestRest", "stream": "S", "data": ["good", 99.0]})
+    assert r["status"] in ("ok", "queued")
+    bad = [e for e in rt.error_store.entries("S") if e.point == "net.feed"]
+    assert len(bad) == 1                 # captured, not vanished
+    assert bad[0].events[0][1] == ("X", 0.0)
+    del rt.__dict__["_pump_admission"]   # let the scheduler pump resume
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and ("good", 99.0) not in delivered:
+        time.sleep(0.02)
+    assert ("good", 99.0) in delivered   # the valid event still lands

@@ -81,7 +81,9 @@ QUERIES = [
 ]
 
 
-@pytest.mark.parametrize("qi", range(len(QUERIES)))
+@pytest.mark.parametrize("qi", [
+    pytest.param(i, marks=pytest.mark.slow) if i == 2 else i
+    for i in range(len(QUERIES))])
 def test_differential(qi):
     differential(QUERIES[qi], gen_rows(120, seed=qi + 10), seed=qi)
 
