@@ -111,9 +111,24 @@ def run_tape(app, stream, tape, keys, out_streams=("Out",), warm=1,
             seg1_matches = counted[0] - warm_matches
     if stats_out is not None:
         stats_out["device"] = rt.statistics().get("device", {})
+        stats_out["placement"] = rt.statistics().get("placement", {})
     mgr.shutdown()
     return float(np.median(eps_runs)), seg1_matches, \
         [round(e) for e in eps_runs]
+
+
+def _placement_summary(stats: dict) -> dict:
+    """The per-config placement column (core/placement.py): device vs
+    interpreter query counts + recorded interpreter demotions, so any
+    future SILENT demotion shows up as a shifted count in the bench
+    trajectory instead of only as a quietly slower eps."""
+    pl = stats.get("placement") or {}
+    if not pl:
+        return {}
+    return {"placement": {"device": pl.get("device", 0),
+                          "interpreter": pl.get("interpreter", 0),
+                          "interp_demotions": pl.get("interp_demotions",
+                                                     0)}}
 
 
 def _overlap_summary(stats: dict) -> dict:
@@ -289,6 +304,7 @@ def bench_config(name, dev_app, host_app, n, batch, keys=8, dt_ms=1,
     }
     res.update({k: v for k, v in _overlap_summary(dev_stats).items()
                 if v is not None})
+    res.update(_placement_summary(dev_stats))
     if latency:
         lat_tape = make_tape(2048 * 16, 2048, keys=keys, dt_ms=dt_ms)
         lat_app = lat_dev_app or dev_app
@@ -480,6 +496,7 @@ def bench_join(n, batch, keys=1000, repeats=3):
                 seg1 = counted[0] - warm_m
         if stats_out is not None:
             stats_out["device"] = rt.statistics().get("device", {})
+            stats_out["placement"] = rt.statistics().get("placement", {})
         mgr.shutdown()
         return float(np.median(eps_runs)), seg1, [round(e) for e in eps_runs]
 
@@ -2069,6 +2086,15 @@ def main(argv=None):
                         **({"bound": breakdown[k]["bound"]}
                            if breakdown.get(k, {}).get("bound") else {})}
                     for k, v in configs.items()},
+        # device/interpreter query counts per config (placement plane,
+        # docs/ANALYSIS.md): a future silent demotion shifts these
+        # numbers in the bench trajectory — kept OUT of the oversize
+        # drop_order so the column always survives into the final line
+        "placement": {k: "{}d/{}i/{}dem".format(
+                          v["placement"].get("device", 0),
+                          v["placement"].get("interpreter", 0),
+                          v["placement"].get("interp_demotions", 0))
+                      for k, v in configs.items() if v.get("placement")},
         "detail": "BENCH_DETAIL.json",
     }
     _print_summary(summary)

@@ -18,6 +18,22 @@ echo "== tuning-cache schema lint =="
 # this lint step catches schema drift before it ships
 python -m siddhi_tpu.core.autotune --lint
 
+echo "== static analysis: self-lint =="
+# the no-silent-demotion CI gate (docs/ANALYSIS.md): an except handler
+# on a plan-lowering path that swallows without recording a Demotion
+# (SL01), or an unguarded shared-counter mutation in a lock-owning
+# class (SL02), fails the build here — exactly the two bug classes
+# review rounds keep finding
+python -m siddhi_tpu.analysis --self
+
+echo "== static analysis: samples corpus =="
+# the analyzer over every samples/*.py app string: expected findings are
+# PINNED (all info-severity conveniences in the samples); any new rule
+# firing — or an expected one disappearing — fails CI
+python -m siddhi_tpu.analysis --expect SA07,SA07,SA07,SA07,SA12 \
+    samples/simple_filter.py samples/time_window.py \
+    samples/partitioned_pattern_tpu.py samples/net_serving.py
+
 echo "== tier-1 tests =="
 python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider

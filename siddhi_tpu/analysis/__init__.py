@@ -1,0 +1,53 @@
+"""Static query analyzer + EXPLAIN plane (docs/ANALYSIS.md).
+
+Three cooperating parts:
+
+  * ``rules``    — ~12 app-level lint rules over the parsed SiddhiQL AST
+    (unbounded state, schema mismatches, dead graph elements, annotation
+    conflicts), shared by the ``python -m siddhi_tpu.analysis`` CLI, the
+    service deploy endpoint, and ``@app:strictAnalysis``;
+  * ``core.placement`` — build-time placement accounting: every
+    interpreter fallback records a ``Demotion``, surfaced by
+    ``rt.explain()`` / ``GET /siddhi/artifact/explain`` / the CLI;
+  * ``selflint`` — an AST checker over siddhi_tpu's OWN source (SL01
+    silent-demotion swallows, SL02 unguarded shared counters), the
+    ``--self`` CI gate in scripts/smoke.sh.
+"""
+from __future__ import annotations
+
+from .rules import RULES, SEVERITIES, Finding, analyze_app  # noqa: F401
+from .selflint import lint_package, lint_source             # noqa: F401
+
+
+class StrictAnalysisError(Exception):
+    """`@app:strictAnalysis` found error- or warn-severity findings at
+    deploy: the app refuses to start.  `findings` carries the full
+    list (info-severity included) for the service's diagnostics JSON."""
+
+    def __init__(self, app_name: str, findings: list):
+        self.findings = findings
+        bad = [f for f in findings if f.severity in ("error", "warn")]
+        lines = "\n  ".join(str(f) for f in bad)
+        super().__init__(
+            f"@app:strictAnalysis: app {app_name!r} has "
+            f"{len(bad)} blocking finding(s) "
+            f"(warnings promote to deploy errors):\n  {lines}")
+
+
+def analyze_source(text: str) -> list:
+    """Parse an app string and run every rule (the CLI/service path)."""
+    from .rules import analyze_app as _analyze
+    from ..query.parser import parse
+    return _analyze(parse(text))
+
+
+def strict_check(rt) -> list:
+    """The `@app:strictAnalysis` deploy contract (called by the runtime
+    constructor after the build): run the analyzer over the built app
+    and raise StrictAnalysisError when anything at error OR warn
+    severity is found.  Returns the findings (info included) so the
+    service can report a clean-but-noted deploy."""
+    findings = analyze_app(rt.app)
+    if any(f.severity in ("error", "warn") for f in findings):
+        raise StrictAnalysisError(rt.app.name, findings)
+    return findings

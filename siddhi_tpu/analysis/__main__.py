@@ -1,0 +1,195 @@
+"""CLI for the static analyzer + EXPLAIN plane (docs/ANALYSIS.md).
+
+    python -m siddhi_tpu.analysis [options] <file> [<file> ...]
+    python -m siddhi_tpu.analysis --self
+
+Inputs: a SiddhiQL app file (.siddhi or any text file), ``-`` for
+stdin, or a .py file — every module-level string constant containing
+``define stream`` is analyzed as its own app (the samples/*.py shape).
+
+Options:
+  --json          machine output (one JSON document on stdout)
+  --explain       also BUILD each app and include rt.explain(): per-query
+                  placement (device vs interpreter), chosen plan family,
+                  geometry provenance, and the Demotion reason chains
+  --strict        exit non-zero on warn findings too (the CLI mirror of
+                  @app:strictAnalysis)
+  --expect IDS    comma-separated rule-id multiset (e.g. SA07,SA07,SA12)
+                  the findings must match EXACTLY — the smoke pin for
+                  expected-findings corpora; exit non-zero on any drift
+  --self          lint siddhi_tpu's own source instead (SL01 silent
+                  demotions, SL02 unguarded shared counters); any
+                  finding exits non-zero — this is the CI gate
+
+Exit status: 0 clean (or --expect matched), 1 findings at error
+severity (warn too under --strict), 2 usage/input errors.
+"""
+from __future__ import annotations
+
+import ast as pyast
+import json
+import sys
+
+from . import analyze_source
+from .rules import Finding
+from .selflint import lint_package
+
+
+def extract_apps(path: str) -> list:
+    """[(label, app_text)] from one input path.  .py files contribute
+    every module-level string constant that looks like an app; anything
+    else is one app string ('-' reads stdin)."""
+    if path == "-":
+        return [("<stdin>", sys.stdin.read())]
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    if not path.endswith(".py"):
+        return [(path, text)]
+    out = []
+    tree = pyast.parse(text)
+    for node in tree.body:
+        tgt = None
+        if isinstance(node, pyast.Assign) and node.targets and \
+                isinstance(node.targets[0], pyast.Name):
+            tgt, val = node.targets[0].id, node.value
+        elif isinstance(node, pyast.AnnAssign) and \
+                isinstance(node.target, pyast.Name):
+            tgt, val = node.target.id, node.value
+        else:
+            continue
+        if isinstance(val, pyast.Constant) and isinstance(val.value, str) \
+                and "define stream" in val.value:
+            out.append((f"{path}:{tgt}", val.value))
+    return out
+
+
+def _explain_app(text: str) -> dict:
+    """Build the app (device planning included) and return rt.explain().
+    Imports JAX — only paid under --explain."""
+    import warnings
+    from .. import SiddhiManager
+    mgr = SiddhiManager()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")   # forced-family fallbacks etc.
+        rt = mgr.create_app_runtime(text)
+    try:
+        return rt.explain()
+    finally:
+        mgr.shutdown()
+
+
+def _render_text(entry: dict) -> str:
+    lines = [f"== {entry['source']}"]
+    ex = entry.get("explain")
+    if ex is not None:
+        lines.append(f"app {ex['app']!r}: "
+                     f"{ex['placement']['device']} device / "
+                     f"{ex['placement']['interpreter']} interpreter "
+                     f"({ex['placement']['interp_demotions']} demotions)")
+        for qn, qd in ex["queries"].items():
+            fam = f" family={qd['family']}" if qd.get("family") else ""
+            lines.append(f"  {qn}: {qd['path']} [{qd['kind']}]{fam}")
+            for d in qd.get("demotions", ()):
+                cause = f" (cause: {d['cause']})" if d.get("cause") else ""
+                lines.append(f"    {d['rule_id']} lost "
+                             f"{d['alternative']}: {d['reason']}{cause}")
+    for f in entry["findings"]:
+        lines.append(f"  {f['rule_id']} {f['severity']}"
+                     + (f" [{f['subject']}]" if f.get("subject") else "")
+                     + f": {f['message']}")
+    if not entry["findings"]:
+        lines.append("  clean: 0 findings")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    explain = "--explain" in argv
+    strict = "--strict" in argv
+    self_lint = "--self" in argv
+    expect = None
+    for flag in ("--json", "--explain", "--strict", "--self"):
+        while flag in argv:
+            argv.remove(flag)
+    if "--expect" in argv:
+        i = argv.index("--expect")
+        try:
+            expect = sorted(x for x in argv[i + 1].split(",") if x)
+        except IndexError:
+            print("--expect needs a rule-id list", file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
+
+    if self_lint:
+        findings = lint_package()
+        if as_json:
+            print(json.dumps({"self_lint": [f.to_dict() for f in findings],
+                              "findings": len(findings)}, indent=1))
+        else:
+            for f in findings:
+                print(f)
+            print(f"self-lint: {len(findings)} finding(s) over siddhi_tpu/")
+        return 1 if findings else 0
+
+    if not argv:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+
+    apps, failures = [], 0
+    for path in argv:
+        try:
+            extracted = extract_apps(path)
+        except OSError as e:
+            print(f"cannot read {path}: {e}", file=sys.stderr)
+            return 2
+        if not extracted:
+            print(f"{path}: no app strings found", file=sys.stderr)
+            failures += 1
+        apps.extend(extracted)
+
+    entries, all_findings = [], []
+    for label, text in apps:
+        try:
+            findings = analyze_source(text)
+        except Exception as e:
+            findings = [Finding("SA00", "error",
+                                f"app does not parse: {e}")]
+        entry = {"source": label,
+                 "findings": [f.to_dict() for f in findings]}
+        if explain and not any(f.severity == "error" for f in findings):
+            try:
+                entry["explain"] = _explain_app(text)
+            except Exception as e:
+                entry["explain_error"] = f"{type(e).__name__}: {e}"
+        all_findings.extend(findings)
+        entries.append(entry)
+
+    counts = {s: sum(1 for f in all_findings if f.severity == s)
+              for s in ("error", "warn", "info")}
+    if as_json:
+        print(json.dumps({"apps": entries, "findings": len(all_findings),
+                          "severities": counts}, indent=1))
+    else:
+        for entry in entries:
+            print(_render_text(entry))
+        print(f"{len(all_findings)} finding(s): "
+              f"{counts['error']} error, {counts['warn']} warn, "
+              f"{counts['info']} info over {len(apps)} app(s)")
+
+    if failures:
+        return 2
+    if expect is not None:
+        got = sorted(f.rule_id for f in all_findings)
+        if got != expect:
+            print(f"--expect mismatch: wanted {expect}, got {got}",
+                  file=sys.stderr)
+            return 1
+        return 0
+    if counts["error"] or (strict and counts["warn"]):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,492 @@
+"""App-level lint rules over the SiddhiQL AST (docs/ANALYSIS.md).
+
+The deploy-time half of the static analyzer: ~12 rules catching the
+failure classes that cost real debugging time at scale — unbounded
+state, type mismatches at stream boundaries, dead graph elements, and
+annotation conflicts that would make the build *soundly but silently*
+fall back (the placement plane records those at build; these rules
+catch them before a deploy is even attempted).
+
+Severities:
+  error — the app will not build, or will definitely misbehave
+  warn  — will deploy, but carries unbounded state / surprising
+          placement; `@app:strictAnalysis` turns these into deploy errors
+  info  — worth knowing; never blocks anything
+
+Every rule is a pure function over the parsed app (no runtime needed),
+so `python -m siddhi_tpu.analysis`, the service deploy endpoint, and
+`@app:strictAnalysis` all share one implementation.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..query import ast
+from ..core.planner import selector_has_aggregators
+from ..core.partition import input_stream_ids
+
+SEVERITIES = ("error", "warn", "info")
+
+# rule id -> (default severity, one-line title)
+RULES = {
+    "SA01": ("warn", "`every` pattern without a `within` bound "
+                     "(unbounded pending-instance state)"),
+    "SA02": ("warn", "window-less aggregation over an unbounded stream"),
+    "SA03": ("warn", "stateful partition without a @purge annotation "
+                     "(per-key state never expires)"),
+    "SA04": ("error", "output schema mismatch at a stream boundary"),
+    "SA05": ("info", "dead stream: defined but never produced or consumed"),
+    "SA06": ("error", "query consumes a stream nothing defines or produces"),
+    "SA07": ("info", "inferred output stream consumed by nothing"),
+    "SA08": ("warn", "@app:patternFamily forced on a provably ineligible "
+                     "shape (build will fall back)"),
+    "SA09": ("warn", "@source(rate.limit='0') admits nothing"),
+    "SA10": ("warn", "@app:deviceChunkLanes conflicts with "
+                     "@app:patternFamily"),
+    "SA11": ("warn", "join without an `on` condition (cross product)"),
+    "SA12": ("info", "device pattern path computes doubles in f32 "
+                     "(@app:devicePrecision('f64') opts out)"),
+}
+
+
+@dataclass
+class Finding:
+    rule_id: str
+    severity: str          # "error" | "warn" | "info"
+    message: str
+    subject: Optional[str] = None     # query / stream / partition label
+
+    def to_dict(self) -> dict:
+        d = {"rule_id": self.rule_id, "severity": self.severity,
+             "message": self.message}
+        if self.subject is not None:
+            d["subject"] = self.subject
+        return d
+
+    def __str__(self) -> str:
+        where = f" [{self.subject}]" if self.subject else ""
+        return f"{self.rule_id} {self.severity}{where}: {self.message}"
+
+
+def _finding(rule_id: str, message: str, subject=None) -> Finding:
+    return Finding(rule_id, RULES[rule_id][0], message, subject)
+
+
+# ---------------------------------------------------------------------------
+# app context
+# ---------------------------------------------------------------------------
+
+def iter_queries(app: ast.SiddhiApp):
+    """(name, query, partition_or_None) for every query, named with the
+    same defaults build.py uses, so findings line up with explain()."""
+    for i, el in enumerate(app.execution_elements):
+        if isinstance(el, ast.Query):
+            yield el.name(f"query_{i}"), el, None
+        elif isinstance(el, ast.Partition):
+            for qi, q in enumerate(el.queries):
+                yield q.name(f"query_p{i}_{qi}"), q, el
+
+
+def _walk_state(el):
+    yield el
+    if isinstance(el, (ast.StreamStateElement, ast.AbsentStreamStateElement)):
+        return
+    if isinstance(el, ast.LogicalStateElement):
+        yield from _walk_state(el.left)
+        yield from _walk_state(el.right)
+    elif isinstance(el, ast.CountStateElement):
+        yield from _walk_state(el.stream)
+    elif isinstance(el, ast.NextStateElement):
+        yield from _walk_state(el.state)
+        yield from _walk_state(el.next)
+    elif isinstance(el, ast.EveryStateElement):
+        yield from _walk_state(el.state)
+
+
+class AppContext:
+    """One pass of bookkeeping shared by every rule."""
+
+    def __init__(self, app: ast.SiddhiApp):
+        self.app = app
+        self.queries = list(iter_queries(app))
+        self.defined = set(app.stream_definitions)
+        self.tables = set(app.table_definitions)
+        self.windows = set(app.window_definitions)
+        self.aggregations = set(app.aggregation_definitions)
+        self.triggers = set(app.trigger_definitions)
+        # producers/consumers over plain stream ids (inner '#' and fault
+        # '!' prefixes stripped of analysis: they resolve at build time)
+        self.producers: dict = {}
+        self.consumers: dict = {}
+        self.onerror_streams = {
+            sid for sid, sd in app.stream_definitions.items()
+            if ast.find_annotation(sd.annotations, "onerror") is not None}
+        for name, q, _part in self.queries:
+            if isinstance(q.output, ast.InsertInto) and not q.output.is_inner:
+                tgt = q.output.target
+                if not q.output.is_fault:
+                    self.producers.setdefault(tgt, []).append(name)
+            for sid in input_stream_ids(q):
+                if sid.startswith("#"):
+                    continue
+                self.consumers.setdefault(sid.lstrip("!"), []).append(name)
+        for ad in app.aggregation_definitions.values():
+            self.consumers.setdefault(ad.input.stream_id, []).append(ad.id)
+
+    def known_source(self, sid: str) -> bool:
+        """Can `sid` carry events into a query?"""
+        return (sid in self.defined or sid in self.windows
+                or sid in self.triggers or sid in self.aggregations
+                or sid in self.producers)
+
+    def schema_of(self, sid: str):
+        from ..core.schema import StreamSchema
+        sd = self.app.stream_definitions.get(sid)
+        return StreamSchema.of(sd) if sd is not None else None
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+def _rule_sa01_every_without_within(ctx, out):
+    for name, q, _part in ctx.queries:
+        if not isinstance(q.input, ast.StateInputStream):
+            continue
+        has_every = any(isinstance(el, ast.EveryStateElement)
+                        for el in _walk_state(q.input.state))
+        if not has_every:
+            continue
+        withins = [q.input.within] + [
+            getattr(el, "within", None) for el in _walk_state(q.input.state)]
+        waiting = [getattr(el, "waiting_time", None)
+                   for el in _walk_state(q.input.state)]
+        if not any(w is not None for w in withins + waiting):
+            out.append(_finding(
+                "SA01",
+                "`every` pattern with no `within` bound anywhere: every "
+                "head event arms an instance that can pend forever "
+                "(unbounded state, and no parallel plan family applies)",
+                name))
+
+
+def _rule_sa02_windowless_aggregation(ctx, out):
+    for name, q, _part in ctx.queries:
+        inp = q.input
+        if not isinstance(inp, ast.SingleInputStream):
+            continue
+        if inp.stream_id in ctx.windows or inp.stream_id in ctx.tables \
+                or inp.stream_id in ctx.aggregations:
+            continue   # named windows/tables bound their own state
+        if inp.window is not None:
+            continue
+        has_agg = selector_has_aggregators(q.selector) or bool(
+            q.selector.group_by)
+        if has_agg:
+            grp = (" per group key (key cardinality is unbounded)"
+                   if q.selector.group_by else "")
+            out.append(_finding(
+                "SA02",
+                f"aggregation over unbounded stream "
+                f"{inp.stream_id!r} without a window: running state "
+                f"never resets{grp}", name))
+
+
+def _is_stateful_query(q: ast.Query) -> bool:
+    if isinstance(q.input, ast.StateInputStream):
+        return True
+    if isinstance(q.input, ast.JoinInputStream):
+        return True
+    if isinstance(q.input, ast.SingleInputStream):
+        if q.input.window is not None:
+            return True
+        return selector_has_aggregators(q.selector) or bool(
+            q.selector.group_by)
+    return False
+
+
+def _rule_sa03_partition_without_purge(ctx, out):
+    # @app:partitionCapacity bounds the per-key lane slab engine-wide —
+    # the engine's own cap on partition state (docs/PERFORMANCE.md)
+    if ast.find_annotation(ctx.app.annotations,
+                           "app:partitionCapacity") is not None:
+        return
+    for i, el in enumerate(ctx.app.execution_elements):
+        if not isinstance(el, ast.Partition):
+            continue
+        if ast.find_annotation(el.annotations, "purge") is not None:
+            continue
+        if any(_is_stateful_query(q) for q in el.queries):
+            out.append(_finding(
+                "SA03",
+                "partition holds per-key state (pattern/window/"
+                "aggregation) with no @purge annotation and no "
+                "@app:partitionCapacity bound: at high key cardinality, "
+                "per-key state grows forever",
+                f"#partition_{i}"))
+
+
+def _infer_type(expr, schema, ctx) -> Optional[ast.AttrType]:
+    """Cheap type inference: plain variables + constants only — a rule
+    must never claim a mismatch it can't prove."""
+    if isinstance(expr, ast.Constant):
+        return expr.type
+    if isinstance(expr, ast.Variable) and expr.index is None:
+        ref = expr.stream_ref
+        if ref is not None and ref in ctx.defined:
+            s = ctx.schema_of(ref)
+            if s is not None and expr.attribute in s.types:
+                return s.type_of(expr.attribute)
+            return None
+        if ref is None and schema is not None \
+                and expr.attribute in schema.types:
+            return schema.type_of(expr.attribute)
+    return None
+
+
+def _rule_sa04_output_schema_mismatch(ctx, out):
+    for name, q, _part in ctx.queries:
+        if not isinstance(q.output, ast.InsertInto) or q.output.is_fault:
+            continue
+        tgt = q.output.target
+        sd = ctx.app.stream_definitions.get(tgt)
+        if sd is None or q.selector.select_all:
+            continue
+        want = list(sd.attributes)
+        have = list(q.selector.attributes)
+        if len(want) != len(have):
+            out.append(_finding(
+                "SA04",
+                f"inserts {len(have)} attributes into {tgt!r} which "
+                f"defines {len(want)} — the build will reject this "
+                f"schema mismatch", name))
+            continue
+        in_schema = None
+        if isinstance(q.input, ast.SingleInputStream):
+            in_schema = ctx.schema_of(q.input.stream_id)
+        for oa, attr in zip(have, want):
+            t = _infer_type(oa.expr, in_schema, ctx)
+            if t is not None and t != attr.type:
+                lossy = (t, attr.type) in (
+                    (ast.AttrType.DOUBLE, ast.AttrType.FLOAT),
+                    (ast.AttrType.LONG, ast.AttrType.INT),
+                    (ast.AttrType.DOUBLE, ast.AttrType.INT),
+                    (ast.AttrType.DOUBLE, ast.AttrType.LONG))
+                extra = (" (lossy narrowing)" if lossy else "")
+                out.append(_finding(
+                    "SA04",
+                    f"output attribute {oa.name!r} is {t.value} but "
+                    f"{tgt!r} declares {attr.type.value}{extra} — the "
+                    f"build requires exact type equality", name))
+
+
+def _rule_sa05_dead_stream(ctx, out):
+    for sid, sd in ctx.app.stream_definitions.items():
+        if sid in ctx.consumers or sid in ctx.producers:
+            continue
+        anns = {a.name.lower() for a in sd.annotations}
+        if anns & {"source", "sink", "onerror"}:
+            continue
+        out.append(_finding(
+            "SA05",
+            f"stream {sid!r} is defined but no query reads or writes it "
+            f"and it has no @source/@sink — dead definition (or a typo "
+            f"elsewhere)", sid))
+
+
+def _rule_sa06_unknown_input(ctx, out):
+    for name, q, part in ctx.queries:
+        for sid in input_stream_ids(q):
+            if sid.startswith("#"):
+                continue           # partition inner streams
+            base = sid.lstrip("!")
+            if sid.startswith("!") and base in ctx.onerror_streams:
+                continue
+            if base in ctx.tables:
+                if isinstance(q.input, ast.JoinInputStream):
+                    continue       # table side of a join is legal
+                out.append(_finding(
+                    "SA06",
+                    f"streams from table {base!r}: tables cannot be "
+                    f"streamed — use a join or an on-demand (store) "
+                    f"query; this build will fail", name))
+                continue
+            if not ctx.known_source(base):
+                out.append(_finding(
+                    "SA06",
+                    f"consumes stream {base!r}, which is not defined and "
+                    f"which no query produces — this build will fail "
+                    f"(or the query waits forever on a typo)", name))
+
+
+def _rule_sa07_unconsumed_output(ctx, out):
+    for name, q, _part in ctx.queries:
+        if not isinstance(q.output, ast.InsertInto) \
+                or q.output.is_fault or q.output.is_inner:
+            continue
+        tgt = q.output.target
+        if tgt in ctx.defined or tgt in ctx.tables or tgt in ctx.windows:
+            continue               # declared somewhere: deliberate
+        if tgt in ctx.consumers:
+            continue
+        out.append(_finding(
+            "SA07",
+            f"inserts into inferred stream {tgt!r} which no query "
+            f"consumes and no definition declares — reachable only via "
+            f"callbacks (fine if intended, a silent sink if a typo)",
+            name))
+
+
+def _rule_sa08_ineligible_family(ctx, out):
+    fam_ann = ast.find_annotation(ctx.app.annotations, "app:patternFamily")
+    if fam_ann is None:
+        return
+    fam = str(fam_ann.element() or "").lower()
+    if fam in ("", "auto", "seq"):
+        return
+    from ..core.nfa_parallel import classify_shape
+    from ..core.schema import StringTable
+    for name, q, part in ctx.queries:
+        if not isinstance(q.input, ast.StateInputStream):
+            continue
+        if part is not None:
+            out.append(_finding(
+                "SA08",
+                f"@app:patternFamily({fam!r}) on a partitioned pattern: "
+                f"partitioned lanes hold persistent per-key state — "
+                f"only the sequential kernel applies; the build falls "
+                f"back", name))
+            continue
+        schemas = {}
+        missing = False
+        for sid in input_stream_ids(q):
+            s = ctx.schema_of(sid)
+            if s is None:
+                missing = True     # inferred input: SA06/SA07 territory
+                break
+            schemas[sid] = s
+        if missing:
+            continue
+        verdict = classify_shape(q.input, schemas, StringTable()).get(fam)
+        if verdict is not True and fam in ("chunk", "scan", "dfa"):
+            out.append(_finding(
+                "SA08",
+                f"@app:patternFamily({fam!r}) is provably ineligible for "
+                f"this shape: {verdict} — the build will warn and fall "
+                f"back to automatic selection", name))
+
+
+def _rule_sa09_zero_rate_limit(ctx, out):
+    for sid, sd in ctx.app.stream_definitions.items():
+        src = ast.find_annotation(sd.annotations, "source")
+        if src is None:
+            continue
+        rl = src.element("rate.limit")
+        try:
+            zero = rl is not None and float(rl) == 0.0
+        except ValueError:
+            zero = False
+        if zero:
+            out.append(_finding(
+                "SA09",
+                f"@source(rate.limit='0') on {sid!r} admits NOTHING — "
+                f"every frame sheds/blocks; if intended, say so with a "
+                f"comment, otherwise this is a typo'd limit", sid))
+
+
+def _rule_sa10_lanes_family_conflict(ctx, out):
+    lanes_ann = ast.find_annotation(ctx.app.annotations,
+                                    "app:deviceChunkLanes")
+    fam_ann = ast.find_annotation(ctx.app.annotations, "app:patternFamily")
+    if lanes_ann is None or fam_ann is None:
+        return
+    try:
+        lanes = int(lanes_ann.element())
+    except (TypeError, ValueError):
+        return                     # the build rejects the value itself
+    fam = str(fam_ann.element() or "").lower()
+    if fam == "chunk" and lanes <= 1:
+        out.append(_finding(
+            "SA10",
+            f"@app:patternFamily('chunk') with "
+            f"@app:deviceChunkLanes({lanes}): the chunk family needs "
+            f"more than one lane — the build will fall back",
+            "app"))
+    elif fam in ("seq", "scan", "dfa"):
+        out.append(_finding(
+            "SA10",
+            f"@app:deviceChunkLanes({lanes}) has no effect under "
+            f"@app:patternFamily({fam!r}) — the lanes knob only shapes "
+            f"the chunk family", "app"))
+
+
+def _rule_sa11_cross_join(ctx, out):
+    for name, q, _part in ctx.queries:
+        inp = q.input
+        if not isinstance(inp, ast.JoinInputStream):
+            continue
+        if inp.on is not None or inp.per is not None:
+            continue
+        out.append(_finding(
+            "SA11",
+            f"join of {inp.left.stream_id!r} and {inp.right.stream_id!r} "
+            f"has no `on` condition: every retained left event pairs "
+            f"with every retained right event (cross product)", name))
+
+
+def _rule_sa12_f32_precision(ctx, out):
+    if ast.find_annotation(ctx.app.annotations, "app:devicePrecision") \
+            is not None:
+        return
+    dp_ann = ast.find_annotation(ctx.app.annotations, "app:devicePatterns")
+    dp = str(dp_ann.element()).lower() if dp_ann is not None else "auto"
+    for name, q, part in ctx.queries:
+        if not isinstance(q.input, ast.StateInputStream):
+            continue
+        on_device = part is not None or dp in ("prefer", "always")
+        if not on_device:
+            continue
+        has_double = any(
+            a.type == ast.AttrType.DOUBLE
+            for sid in input_stream_ids(q)
+            for a in (ctx.app.stream_definitions.get(sid).attributes
+                      if sid in ctx.defined else ()))
+        if has_double:
+            out.append(_finding(
+                "SA12",
+                "device pattern kernels compute DOUBLE columns in f32 "
+                "by default: thresholds within ~7 significant digits "
+                "may compare differently than the host path; "
+                "@app:devicePrecision('f64') opts out", name))
+            return        # one note per app is enough
+
+
+_RULE_FNS = (
+    _rule_sa01_every_without_within,
+    _rule_sa02_windowless_aggregation,
+    _rule_sa03_partition_without_purge,
+    _rule_sa04_output_schema_mismatch,
+    _rule_sa05_dead_stream,
+    _rule_sa06_unknown_input,
+    _rule_sa07_unconsumed_output,
+    _rule_sa08_ineligible_family,
+    _rule_sa09_zero_rate_limit,
+    _rule_sa10_lanes_family_conflict,
+    _rule_sa11_cross_join,
+    _rule_sa12_f32_precision,
+)
+
+_SEV_ORDER = {"error": 0, "warn": 1, "info": 2}
+
+
+def analyze_app(app: ast.SiddhiApp) -> list:
+    """All rules over one parsed app; findings sorted most-severe first,
+    then by rule id (deterministic output for the CLI / service JSON)."""
+    ctx = AppContext(app)
+    out: list = []
+    for fn in _RULE_FNS:
+        fn(ctx, out)
+    out.sort(key=lambda f: (_SEV_ORDER.get(f.severity, 3), f.rule_id,
+                            f.subject or "", f.message))
+    return out

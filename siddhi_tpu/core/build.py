@@ -111,6 +111,17 @@ def _build_app_scoped(rt) -> None:
         from .nfa_device import DeviceNFAUnsupported
         for sig, idxs in groups.items():
             if len(idxs) < MIN_GROUP:
+                # a LONE query was never a fusion candidate — recording
+                # "group of 1 too small" for every pattern app is noise
+                if len(idxs) > 1:
+                    for i in idxs:
+                        q = app.execution_elements[i]
+                        rt.placement.demote(
+                            q.name(f"query_{i}"), "D-FUSED",
+                            f"structurally-identical group too small to "
+                            f"fuse ({len(idxs)} < {MIN_GROUP}); planned "
+                            f"individually",
+                            alternative="fused-lanes")
                 continue
             # fused-lane packing (@app:fusedLanes / tuning cache): cap the
             # lane count per fused kernel — a group larger than the pack
@@ -128,7 +139,13 @@ def _build_app_scoped(rt) -> None:
                 names = [q.name(f"query_{i}") for q, i in zip(qs, sub)]
                 try:
                     plan = plan_query_group(rt, qs, names)
-                except DeviceNFAUnsupported:
+                except DeviceNFAUnsupported as e:
+                    for nm in names:
+                        rt.placement.demote(
+                            nm, "D-FUSED",
+                            "fused multi-query lane kernel unavailable "
+                            "for this group; queries planned individually",
+                            cause=e, alternative="fused-lanes")
                     break
                 # the tuning cache keys fused plans by the GROUP shape
                 # signature (autotune.plan_signature) — the fused query
@@ -259,6 +276,8 @@ def _plan_query_scoped(rt, q: ast.Query, default_name: str):
                 if dw_mode == "always":
                     raise PlanError(f"query {name!r}: deviceWindows=always "
                                     f"but unsupported: {e}")
+                rt.placement.demote(name, "D-WINDOW", str(e), cause=e,
+                                    alternative="device-window")
         # TPU fast path: stateless filter/project with device-typed columns
         if (not has_window and not has_agg and q.rate is None and not nw_needs_host
                 and rt.device_filters != "never"
@@ -275,8 +294,23 @@ def _plan_query_scoped(rt, q: ast.Query, default_name: str):
                     q, name)
             except PlanError:
                 raise
-            except Exception:
-                pass   # host-only functions etc. -> sequential backend
+            except Exception as e:
+                # host-only functions etc. -> sequential backend.  NOT
+                # silent: PR 5 found a whole query class demoted through
+                # this exact handler — the cause must reach explain()
+                rt.placement.demote(
+                    name, "D-FILTER",
+                    "device filter/projection lowering failed; host "
+                    "interpreter handles this query",
+                    cause=e, alternative="device-filter")
+        elif not rt.placement.for_query(name):
+            # the stateless fast path never applied: account for WHY the
+            # query lands on the host (the window branch above recorded
+            # its own reason when it was attempted and rejected)
+            rule, why = _interp_shape_reasons(rt, q, inp, has_window,
+                                              has_agg, nw_needs_host,
+                                              dw_mode)
+            rt.placement.demote(name, rule, why, alternative="device")
         from ..interp.engine import InterpSingleQueryPlan
         return attach_table_writer(
             rt, InterpSingleQueryPlan(name, rt, q, inp, target), q, name)
@@ -293,6 +327,12 @@ def _plan_query_scoped(rt, q: ast.Query, default_name: str):
                     raise PlanError(
                         f"query {name!r}: @app:deviceJoins('always') but "
                         f"the shape is host-only: {e}")
+                rt.placement.demote(name, "D-JOIN", str(e), cause=e,
+                                    alternative="device-join")
+        else:
+            rt.placement.demote(name, "D-POLICY",
+                                "@app:deviceJoins('never')",
+                                alternative="device-join")
         from ..interp.joins import InterpJoinQueryPlan
         return attach_table_writer(
             rt, InterpJoinQueryPlan(name, rt, q, inp, target), q, name)
@@ -309,16 +349,64 @@ def _plan_query_scoped(rt, q: ast.Query, default_name: str):
             try:
                 return attach_table_writer(rt, DevicePatternPlan(
                     name, rt, q, inp, target, slots=rt.device_slots), q, name)
-            except DeviceNFAUnsupported:
-                pass
+            except DeviceNFAUnsupported as e:
+                rt.placement.demote(name, "D-PATTERN", str(e), cause=e,
+                                    alternative="device-pattern")
         if mode == "auto":
-            pass   # P=1 on a remote chip loses to the host matcher; the
-                   # partition planner routes partitioned patterns here
+            # P=1 on a remote chip loses to the host matcher; the
+            # partition planner routes partitioned patterns here
+            rt.placement.demote(
+                name, "D-POLICY",
+                "devicePatterns='auto': unpartitioned patterns run the "
+                "host matcher (a P=1 kernel loses to the host on a "
+                "tunneled chip); partition the query to take the device "
+                "lane axis, or force @app:devicePatterns('prefer')",
+                alternative="device-pattern")
+        elif mode == "never":
+            rt.placement.demote(name, "D-POLICY",
+                                "@app:devicePatterns('never')",
+                                alternative="device-pattern")
         from ..interp.engine import InterpPatternQueryPlan
         return attach_table_writer(
             rt, InterpPatternQueryPlan(name, rt, q, inp, target), q, name)
 
     raise PlanError(f"query {name!r}: input type {type(inp).__name__} not yet supported")
+
+
+def _interp_shape_reasons(rt, q: ast.Query, inp, has_window: bool,
+                          has_agg: bool, nw_needs_host: bool,
+                          dw_mode: str) -> tuple:
+    """(rule_id, reason) for a single-stream query that reached the host
+    interpreter without any device-plan attempt — the placement plane's
+    answer to "why is this query not on the device?".  Policy opt-outs
+    (annotations/env) report as D-POLICY; everything else is a shape
+    gate (D-SHAPE)."""
+    reasons, policy = [], []
+    if has_window and has_agg and dw_mode == "never":
+        policy.append("@app:deviceWindows('never')")
+    if has_window and not has_agg:
+        reasons.append("window without device-supported aggregation "
+                       "(host window operators)")
+    if has_agg and not has_window:
+        reasons.append("aggregation without a window "
+                       "(host running aggregators)")
+    if nw_needs_host:
+        reasons.append("named-window expired/all output needs the host "
+                       "expired-stream subscription")
+    if q.rate is not None:
+        reasons.append("output rate limiting is host-only")
+    if any(isinstance(h, ast.StreamFunction) for h in inp.handlers):
+        reasons.append("stream functions are host-only")
+    if not isinstance(q.output, (ast.InsertInto, ast.ReturnAction)):
+        reasons.append(f"{type(q.output).__name__} table output runs on "
+                       f"the host path")
+    if (not reasons and not policy and rt.device_filters == "never"):
+        policy.append("@app:deviceFilters('never')")
+    if reasons:
+        return "D-SHAPE", "; ".join(reasons)
+    if policy:
+        return "D-POLICY", "; ".join(policy)
+    return "D-SHAPE", "query shape has no device plan family"
 
 
 def plan_partition(rt, p: ast.Partition, index: int) -> None:

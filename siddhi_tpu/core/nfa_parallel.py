@@ -235,8 +235,17 @@ def classify_parallel(spec: ChainSpec, kernel: NFAKernel, strings,
                 if "." in k and "[" in k.split(".", 1)[0]:
                     raise ParallelUnsupported(
                         f"indexed capture read {k!r} in selector/having")
-    except ParallelUnsupported as e:
+    except ParallelUnsupported as e:   # lint: allow-swallow (the reason
+        # string IS the demotion record — the planner surfaces it via
+        # plan.families / rt.explain())
         return {"scan": str(e), "dfa": str(e)}
+    return _classify_prog(prog)
+
+
+def _classify_prog(prog: ParallelProgram) -> dict:
+    """Family verdicts for a successfully-lowered pointer-chase program
+    (shared between the built-kernel classifier above and the
+    analysis-time classify_shape below)."""
     out = {"scan": True}
     if prog.S > 8:
         out["dfa"] = ("more than 8 positions (symbol words bit-pack one "
@@ -246,6 +255,47 @@ def classify_parallel(spec: ChainSpec, kernel: NFAKernel, strings,
                       "threshold-dependent)")
     else:
         out["dfa"] = True
+    return out
+
+
+def classify_shape(state_input, schemas, strings) -> dict:
+    """Analysis-time family eligibility for a raw AST pattern input:
+    {'chunk'|'scan'|'dfa': True | reason} with the SAME reason strings
+    classify_parallel reports for a built kernel — computable without
+    constructing a device plan.  Used by the static analyzer's
+    annotation-conflict rule (SA08, docs/ANALYSIS.md) so a forced
+    `@app:patternFamily` on a provably ineligible shape is flagged at
+    analysis time, before a deploy quietly falls back.
+
+    `schemas` maps stream id -> StreamSchema for every stream the
+    pattern consumes; a shape the device chain lowering itself rejects
+    reports that reason for every family."""
+    from ..interp.engine import _collect_filters
+    from .nfa_device import lower_chain
+    try:
+        spec = lower_chain(state_input, schemas, strings,
+                           _collect_filters(state_input.state))
+    except Exception as e:   # lint: allow-swallow (reason IS the record)
+        r = f"device chain lowering unavailable: {e}"
+        return {"chunk": r, "scan": r, "dfa": r}
+    # the stateless-harness gates DevicePatternPlan applies before any
+    # family runs (pattern_plan.py "plan-family selection")
+    base = True
+    if not spec.every_head:
+        base = "non-`every` head (single stateful arm)"
+    elif any(n.kind != "stream" for n in spec.all_nodes):
+        base = "absent state (timer-driven deadlines need device state)"
+    elif not all(p.within_ms is not None for p in spec.positions):
+        base = "position without a `within` bound"
+    if base is not True:
+        return {"chunk": base, "scan": base, "dfa": base}
+    out = {"chunk": True}
+    try:
+        prog = lower_parallel(spec, strings)
+        out.update(_classify_prog(prog))
+    except ParallelUnsupported as e:   # lint: allow-swallow (reason IS
+        # the analysis-time record)
+        out.update({"scan": str(e), "dfa": str(e)})
     return out
 
 

@@ -258,11 +258,11 @@ def plan_partition(rt, part: ast.Partition, index: int) -> None:
     clone_queries: list = []
     for qi, q in enumerate(part.queries):
         used = None
+        name = q.name(f"query_p{index}_{qi}")
         if isinstance(q.input, ast.StateInputStream) and mode != "never":
             sids = set(input_stream_ids(q))
             if all(s in value_keys for s in sids):
                 try:
-                    name = q.name(f"query_p{index}_{qi}")
                     key_fns = {s: _columnar_key_fn(rt, s, value_keys[s])
                                for s in sids}
                     plan = DevicePatternPlan(
@@ -271,14 +271,28 @@ def plan_partition(rt, part: ast.Partition, index: int) -> None:
                         part_key_fns=key_fns, slots=rt.device_slots)
                     rt._register_plan(plan)
                     used = True
-                except (DeviceNFAUnsupported, PlanError):
+                except (DeviceNFAUnsupported, PlanError) as e:
                     if mode == "always":   # device-or-error, no silent clone
                         raise
+                    rt.placement.demote(
+                        name, "D-PARTITION",
+                        "partitioned pattern fell back to per-key host "
+                        "clones", cause=e, alternative="device-pattern")
                     used = False
-            elif mode == "always":
-                raise PlanError(
-                    f"devicePatterns('always'): partition pattern consumes "
-                    f"streams without value keys ({sorted(sids - set(value_keys))})")
+            else:
+                if mode == "always":
+                    raise PlanError(
+                        f"devicePatterns('always'): partition pattern consumes "
+                        f"streams without value keys ({sorted(sids - set(value_keys))})")
+                rt.placement.demote(
+                    name, "D-PARTITION",
+                    f"pattern consumes streams without value partition "
+                    f"keys ({sorted(sids - set(value_keys))}); per-key "
+                    f"host clones", alternative="device-pattern")
+        elif isinstance(q.input, ast.StateInputStream):
+            rt.placement.demote(name, "D-POLICY",
+                                "@app:devicePatterns('never')",
+                                alternative="device-pattern")
         if not used:
             clone_queries.append(q)
     if clone_queries:

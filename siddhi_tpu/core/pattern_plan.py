@@ -257,6 +257,12 @@ class DevicePatternPlan(QueryPlan):
                 self.families[self.family] = \
                     f"build validation failed: {e}"
                 self._par_kerns.pop(self.family, None)
+                pl = getattr(rt, "placement", None)
+                if pl is not None:
+                    pl.demote(name, "D-FAMILY",
+                              f"plan family {self.family!r} failed build "
+                              f"validation", cause=e,
+                              alternative=self.family)
                 fam = self._choose_family(None)
                 warnings.warn(
                     f"pattern {name!r}: plan family {self.family!r} failed "
@@ -440,6 +446,12 @@ class DevicePatternPlan(QueryPlan):
             if want == "seq" or self.families.get(want) is True:
                 return want
             import warnings
+            pl = getattr(getattr(self, "rt", None), "placement", None)
+            if pl is not None:
+                pl.demote(self.name, "D-FAMILY",
+                          f"requested plan family {want!r} is not "
+                          f"eligible: {self.families.get(want)}",
+                          alternative=want)
             warnings.warn(
                 f"pattern {self.name!r}: requested plan family {want!r} is "
                 f"not eligible ({self.families.get(want)}); falling back to "

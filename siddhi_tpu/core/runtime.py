@@ -266,6 +266,13 @@ class SiddhiAppRuntime:
         self._net_gate = threading.RLock()
         self._ladders: dict = {}        # plan name -> FaultLadder
         self._degraded: list = []       # quarantined-plan records
+        # placement accounting (core/placement.py): every interpreter
+        # fallback and rejected plan family in the build path records a
+        # Demotion here — rt.explain() / statistics()["placement"] /
+        # `python -m siddhi_tpu.analysis` surface them, and the self-lint
+        # fails CI on swallow sites that record nothing
+        from .placement import PlacementLog
+        self.placement = PlacementLog()
         qa = qast.find_annotation(app.annotations, "app:quarantineAfter")
         # consecutive resource failures before a device plan is
         # quarantined onto the interpreter path
@@ -317,6 +324,16 @@ class SiddhiAppRuntime:
                 iv_s = _parse_interval_s(iv) if iv is not None else 5.0
                 self.stats.configure(rep or "console", iv_s)
         self._debugger = None
+
+        # @app:strictAnalysis: the deploy-time contract — run the static
+        # analyzer and refuse to deploy on anything at error OR warn
+        # severity (docs/ANALYSIS.md).  The rules are pure-AST, so the
+        # check runs BEFORE the build: a rejected app never pays (or
+        # waits for) device plan lowering
+        if qast.find_annotation(app.annotations, "app:strictAnalysis") \
+                is not None:
+            from ..analysis import strict_check
+            strict_check(self)
 
         with self.stats.stage("plan"):
             self._build()
@@ -563,6 +580,16 @@ class SiddhiAppRuntime:
 
     def statistics(self) -> dict:
         return self.stats.report()
+
+    def explain(self) -> dict:
+        """The EXPLAIN plane (core/placement.py): per-query execution
+        path (device family vs interpreter), chosen pattern plan family,
+        geometry provenance (annotation / tuning-cache / default), and
+        the full Demotion reason chain for every rejected alternative.
+        Served verbatim by `GET /siddhi/artifact/explain` and the
+        `python -m siddhi_tpu.analysis` CLI."""
+        from .placement import explain as _explain
+        return _explain(self)
 
     def debug(self):
         """Attach the step debugger (reference: SiddhiAppRuntime.debug:575)."""
@@ -1331,6 +1358,12 @@ class SiddhiAppRuntime:
         self._swap_plan(plan, twin)
         lad = self._ladder(plan)
         lad.quarantined = True
+        self.placement.demote(
+            plan.name, "D-QUARANTINE",
+            f"degradation ladder quarantined the plan onto the "
+            f"interpreter path after {lad.consecutive} consecutive "
+            f"device dispatch failures", cause=err,
+            alternative=f"device-{type(plan).__name__}")
         self._degraded.append({
             "plan": plan.name, "at_ms": self.now_ms(),
             "after_failures": lad.failures,

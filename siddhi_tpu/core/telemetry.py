@@ -565,6 +565,29 @@ def render_prometheus(reports: dict) -> str:
             doc.add("siddhi_tpu_degraded_plans", "gauge",
                     "device plans quarantined onto the interpreter path",
                     al, len(rep["degraded_plans"]))
+        # placement plane (core/placement.py): the no-silent-demotions
+        # series — every interpreter fallback carries a recorded reason,
+        # and this gauge is how a future silent demotion shows up on a
+        # dashboard before anyone reads explain()
+        pl = rep.get("placement")
+        if pl:
+            doc.add("siddhi_tpu_interp_demotions", "gauge",
+                    "queries demoted off the device path with a recorded "
+                    "Demotion reason (rt.explain() has the chain)",
+                    al, pl.get("interp_demotions", 0))
+            doc.add("siddhi_tpu_placement_queries", "gauge",
+                    "query count per chosen execution path",
+                    {**al, "path": "device"}, pl.get("device", 0))
+            doc.add("siddhi_tpu_placement_queries", "gauge",
+                    "query count per chosen execution path",
+                    {**al, "path": "interpreter"}, pl.get("interpreter", 0))
+            for qn, qd in pl.get("queries", {}).items():
+                ql = {**al, "query": qn, "path": qd.get("path", "")}
+                if qd.get("family"):
+                    ql["family"] = qd["family"]
+                doc.add("siddhi_tpu_query_placement", "gauge",
+                        "chosen execution path per query (1 = placed)",
+                        ql, 1)
         es = rep.get("error_store")
         if es:
             doc.add("siddhi_tpu_error_store_entries", "gauge",
@@ -878,6 +901,14 @@ class StatisticsManager:
         if degraded:
             rep["degraded_plans"] = [d["plan"] for d in degraded]
             rep["degraded_detail"] = degraded
+        # placement accounting (core/placement.py): device vs interpreter
+        # query counts + the Demotion tally.  ALWAYS present (not gated
+        # on `enabled`): a silent demotion must never be invisible —
+        # the bench summary and the siddhi_tpu_interp_demotions series
+        # both read this block
+        if getattr(self.rt, "placement", None) is not None:
+            from .placement import summary as _placement_summary
+            rep["placement"] = _placement_summary(self.rt)
         es = getattr(self.rt, "error_store", None)
         if es is not None and (len(es) or es.evicted):
             rep["error_store"] = {"entries": len(es), "evicted": es.evicted}

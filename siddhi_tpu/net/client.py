@@ -475,6 +475,7 @@ class FrameReceiver:
             except OSError:
                 return
             if self._fail_first > 0:
+                # lint: unlocked-ok (test-harness fault knob, one writer)
                 self._fail_first -= 1
                 sock.close()
                 continue

@@ -160,7 +160,8 @@ class TcpSink(Sink):
         payload = self._encode_events(events)
         if self.on_error is None:       # legacy fail-fast path
             self.publish_attempt(payload)
-            self.published += 1
+            with self._io_lock:         # metrics scrapes read cross-thread
+                self.published += 1
             return
         self._publish_guarded(payload)
 

@@ -33,6 +33,14 @@ Endpoints (JSON unless noted):
                                     shed.policy='oldest'
   POST /siddhi/artifact/query       {"app": ..., "query": "from T select ..."}
   GET  /siddhi/artifact/stats?siddhiApp=<name>
+  GET  /siddhi/artifact/explain?siddhiApp=<name>
+                                    the EXPLAIN plane (docs/ANALYSIS.md):
+                                    rt.explain() verbatim — per-query
+                                    placement (device vs interpreter),
+                                    chosen plan family, geometry
+                                    provenance, and the full Demotion
+                                    reason chain for every rejected
+                                    alternative
   GET  /metrics[?siddhiApp=<name>]  Prometheus text exposition (0.0.4) over
                                     every deployed app (or just <name>)
   GET  /siddhi/artifact/tuning[?siddhiApp=<name>]
@@ -112,6 +120,9 @@ class SiddhiService:
         # plane before an undeploy land here (never dropped), and stay
         # inspectable until the name is redeployed
         self.retired_errors: dict = {}
+        # app name -> static-analysis findings (dicts) from deploy time;
+        # the deploy response carries them (docs/ANALYSIS.md)
+        self.diagnostics: dict = {}
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -144,7 +155,14 @@ class SiddhiService:
                 try:
                     if path == "/siddhi/artifact/deploy":
                         name = service.deploy(self._body().decode())
-                        self._reply(200, {"status": "deployed", "app": name})
+                        self._reply(200, {
+                            "status": "deployed", "app": name,
+                            # static-analysis findings for the deployed
+                            # app (docs/ANALYSIS.md) — under
+                            # @app:strictAnalysis a warn/error finding
+                            # fails the deploy instead (400 below)
+                            "diagnostics": service.diagnostics.get(name,
+                                                                   [])})
                     elif path == "/siddhi/artifact/event":
                         body = self._body()
                         try:
@@ -174,8 +192,16 @@ class SiddhiService:
                         self._reply(404, {"error": f"no route {path}"})
                 except Exception as e:
                     # EVERY failure is a 400 JSON error — a malformed
-                    # body must never surface as a 500 stack trace
-                    self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+                    # body must never surface as a 500 stack trace.  A
+                    # strict-analysis rejection additionally ships the
+                    # structured findings so the caller sees rule ids,
+                    # not just prose
+                    body = {"error": f"{type(e).__name__}: {e}"}
+                    findings = getattr(e, "findings", None)
+                    if findings is not None:
+                        body["diagnostics"] = [f.to_dict()
+                                               for f in findings]
+                    self._reply(400, body)
 
             def do_GET(self):
                 u = urlparse(self.path)
@@ -194,6 +220,15 @@ class SiddhiService:
                                               f"no deployed app {app!r}"})
                         else:
                             self._reply(200, service.stats(app))
+                    elif u.path == "/siddhi/artifact/explain":
+                        app = q.get("siddhiApp", [None])[0]
+                        if app not in service.runtimes:
+                            self._reply(404, {"error":
+                                              f"no deployed app {app!r}"})
+                        else:
+                            # rt.explain() VERBATIM: the test suite holds
+                            # this body byte-for-byte equal to it
+                            self._reply(200, service.explain(app))
                     elif u.path == "/siddhi/errors":
                         app = q.get("siddhiApp", [None])[0]
                         if (app not in service.runtimes
@@ -276,6 +311,18 @@ class SiddhiService:
     def deploy(self, app_text: str) -> str:
         rt = self.manager.create_app_runtime(app_text)
         name = rt.app.name
+        # deploy-time lint (docs/ANALYSIS.md): the findings ride the
+        # deploy response; @app:strictAnalysis apps never reach here
+        # with warn/error findings (the runtime constructor raised)
+        from .analysis import analyze_app
+        try:
+            self.diagnostics[name] = [f.to_dict()
+                                      for f in analyze_app(rt.app)]
+        except Exception as e:   # lint: allow-swallow (diagnostics are
+            # advisory — an analyzer crash must never block a deploy)
+            self.diagnostics[name] = [{
+                "rule_id": "SA00", "severity": "info",
+                "message": f"analyzer failed: {type(e).__name__}: {e}"}]
         # served runtimes default statistics ON (the /metrics scrape is
         # the point of running as a service); an @app:statistics annotation
         # of any flavor was already applied by the runtime constructor
@@ -293,6 +340,7 @@ class SiddhiService:
 
     def undeploy(self, name: str) -> None:
         rt = self.runtimes.pop(name)
+        self.diagnostics.pop(name, None)
         # retire FIRST: the data plane serializes this against in-flight
         # feeds, so every admitted frame either reached the live runtime
         # or lands whole in the (parked) ErrorStore — never dropped
@@ -440,6 +488,11 @@ class SiddhiService:
 
     def stats(self, app: str) -> dict:
         return self.runtimes[app].stats.report()
+
+    def explain(self, app: str) -> dict:
+        """rt.explain() verbatim (core/placement.py) — placement +
+        demotion reason chains for every query of a deployed app."""
+        return self.runtimes[app].explain()
 
     def _error_stores(self, app: str) -> tuple:
         """(live_store_or_None, parked_store_or_None) for `app` — the

@@ -39,7 +39,9 @@ def _get(svc, path):
 
 def test_deploy_event_query_undeploy(svc):
     r = _post(svc, "/siddhi/artifact/deploy", APP, raw=True)
-    assert r == {"status": "deployed", "app": "RestApp"}
+    assert (r["status"], r["app"]) == ("deployed", "RestApp")
+    # deploy responses carry the static-analysis findings (ANALYSIS.md)
+    assert isinstance(r["diagnostics"], list)
     assert _get(svc, "/siddhi/artifact/apps")["apps"] == ["RestApp"]
 
     _post(svc, "/siddhi/artifact/event",
@@ -578,3 +580,75 @@ def test_rest_queued_bad_batch_cannot_poison_later_requests(svc):
     while time.monotonic() < deadline and ("good", 99.0) not in delivered:
         time.sleep(0.02)
     assert ("good", 99.0) in delivered   # the valid event still lands
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN plane (docs/ANALYSIS.md): endpoint == rt.explain(), verbatim
+# ---------------------------------------------------------------------------
+
+def _get_raw(svc, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{svc.port}{path}") as r:
+        return r.read()
+
+
+def test_explain_endpoint_byte_identical_for_bench_configs(svc):
+    """Acceptance: GET /siddhi/artifact/explain and rt.explain() agree
+    byte-for-byte on placement + reasons for every bench config app
+    (filter / window / pattern / partitioned pattern / join)."""
+    import warnings
+
+    import bench
+
+    apps = {
+        "B1": bench.DEV["filters"] + bench.C1,
+        "B2": bench.DEV["windows"] + bench.C2,
+        "B3": bench.DEV["patterns"] + bench.C3,
+        "B4": bench.DEV["patterns"] + bench.C4,
+        "B6": bench.JOIN_APP,
+    }
+    for name, app in apps.items():
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            _post(svc, "/siddhi/artifact/deploy",
+                  f"@app:name('{name}')\n" + app, raw=True)
+        body = _get_raw(svc, f"/siddhi/artifact/explain?siddhiApp={name}")
+        rt = svc.runtimes[name]
+        assert body == json.dumps(rt.explain()).encode(), name
+        ex = json.loads(body)
+        assert ex["app"] == name
+        assert ex["placement"]["device"] + ex["placement"]["interpreter"] \
+            >= 1, name
+        _get(svc, f"/siddhi/artifact/undeploy?siddhiApp={name}")
+
+
+def test_explain_endpoint_unknown_app_404(svc):
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(svc, "/siddhi/artifact/explain?siddhiApp=Nope")
+    assert ei.value.code == 404
+
+
+def test_deploy_reports_diagnostics_and_strict_rejects(svc):
+    r = _post(svc, "/siddhi/artifact/deploy",
+              "@app:name('Lint')\n"
+              "define stream S (v double);\n"
+              "@info(name='q') from S select avg(v) as m insert into Out;\n",
+              raw=True)
+    ids = [d["rule_id"] for d in r["diagnostics"]]
+    assert "SA02" in ids
+    _get(svc, "/siddhi/artifact/undeploy?siddhiApp=Lint")
+
+    # @app:strictAnalysis: the same app is REFUSED, with structured
+    # findings in the 400 body
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(svc, "/siddhi/artifact/deploy",
+              "@app:name('LintStrict') @app:strictAnalysis\n"
+              "define stream S (v double);\n"
+              "@info(name='q') from S select avg(v) as m insert into Out;\n",
+              raw=True)
+    assert ei.value.code == 400
+    body = json.loads(ei.value.read())
+    assert "strictAnalysis" in body["error"]
+    assert any(d["rule_id"] == "SA02" for d in body["diagnostics"])
+    assert "LintStrict" not in _get(svc, "/siddhi/artifact/apps")["apps"]
