@@ -1527,6 +1527,295 @@ def chaos_net(seed: int = 7) -> dict:
     return out
 
 
+# ---------------------------------------------------------------------------
+# kill-9 durability chaos (`--chaos`): SIGKILL at a fault-injected point,
+# recover, prove exactly-once (docs/RELIABILITY.md)
+# ---------------------------------------------------------------------------
+
+_K9_HEAD = ("@app:name('K9')\n"
+            "@app:durability('batch')\n")
+
+K9_PATTERN = _K9_HEAD + """
+@app:devicePatterns('prefer')
+@source(type='tcp', port='0')
+define stream S (sym string, p double);
+define table OutT (s1 string, s2 string);
+@info(name='q') from every a=S[p > 120] -> b=S[p < 80] within 1 sec
+select a.sym as s1, b.sym as s2 insert into OutT;
+"""
+
+K9_WINDOW = _K9_HEAD + """
+@source(type='tcp', port='0')
+define stream S (sym string, p double);
+define table OutT (sym string, s double, c long);
+@info(name='q') from S#window.length(64)
+select sym, sum(p) as s, count() as c group by sym insert into OutT;
+"""
+
+K9_JOIN = _K9_HEAD + """
+@source(type='tcp', port='0')
+define stream S (sym string, p double);
+@source(type='tcp', port='0')
+define stream T (sym string, p double);
+define table OutT (sym string, pa double, pb double);
+@info(name='q') from S#window.length(32) as a join T#window.length(32) as b
+    on a.sym == b.sym
+select a.sym as sym, a.p as pa, b.p as pb insert into OutT;
+"""
+
+K9_CONFIGS = {"pattern": (K9_PATTERN, ["S"]),
+              "window": (K9_WINDOW, ["S"]),
+              "join": (K9_JOIN, ["S", "T"])}
+
+
+def _k9_tape(seed, streams, rounds=10, batch=128, keys=6):
+    """Deterministic per-round frame tape, regenerated identically by
+    the parent (clean run + resume) and the to-be-killed child."""
+    rng = np.random.default_rng(seed)
+    ts0 = 1_700_000_000_000
+    out = []
+    for k in range(rounds):
+        rd = {}
+        for sid in streams:
+            rd[sid] = (
+                {"sym": np.array([f"K{i}" for i in
+                                  rng.integers(0, keys, batch)]),
+                 "p": q4(rng.uniform(60.0, 140.0, batch))},
+                ts0 + np.arange(k * batch, (k + 1) * batch,
+                                dtype=np.int64) * 2)
+        out.append(rd)
+    return out
+
+
+def chaos_kill9_child(spec_path: str) -> None:
+    """Hidden `--chaos-child <spec.json>` mode: build the durable app,
+    feed the deterministic tape over loopback TCP (per-frame ACK
+    barriers, so every acked frame is a durability promise), persist at
+    the scripted round, and SIGKILL OURSELVES at the armed injection
+    point — mid-`wal.append` leaves a torn record on disk, exactly the
+    crash shape recovery must absorb.  Exits 3 if the kill never fired
+    (the parent treats any exit other than SIGKILL as a failure)."""
+    import json as _json
+    import os
+    import signal
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.persistence import FileSystemPersistenceStore
+    from siddhi_tpu.net import TcpFrameClient
+
+    with open(spec_path) as f:
+        spec = _json.load(f)
+
+    class _Kill9:
+        """FaultInjector-shaped: SIGKILL (not an exception) at the Nth
+        check of one point — the process vanishes mid-operation."""
+
+        def __init__(self, point, at):
+            self.point, self.at, self.n = point, at, 0
+
+        def check(self, point, detail=""):
+            if point == self.point:
+                self.n += 1
+                if self.n >= self.at:
+                    os.kill(os.getpid(), signal.SIGKILL)
+
+    mgr = SiddhiManager()
+    mgr.set_persistence_store(FileSystemPersistenceStore(spec["snap_dir"]))
+    rt = mgr.create_app_runtime(spec["app"])
+    rt.start()
+    rt.fault_injector = _Kill9(spec["kill_point"], spec["kill_at"])
+    ports = {s.stream_id: s.port for s in rt.sources}
+    clis = {sid: TcpFrameClient("127.0.0.1", ports[sid], sid,
+                                TcpFrameClient.cols_of_schema(
+                                    rt.schemas[sid]))
+            for sid in spec["streams"]}
+    tape = _k9_tape(spec["seed"], spec["streams"], spec["rounds"],
+                    spec["batch"], spec["keys"])
+    for k, rd in enumerate(tape):
+        if k == spec["snapshot_at"]:
+            rt.persist()
+        for sid in spec["streams"]:
+            cols, ts = rd[sid]
+            clis[sid].send_batch(cols, ts)
+            # serialize streams per round: append order (and thus the
+            # clean-run differential) stays deterministic
+            clis[sid].barrier(timeout=60)
+    os._exit(3)
+
+
+def chaos_kill9(seed: int = 7) -> dict:
+    """`--chaos` kill-9-and-recover section: for each of the pattern /
+    window / join configs, a subprocess feeds N TCP frames into a
+    `@app:durability('batch')` app and is SIGKILLED at a fault-injected
+    point (mid-`wal.append` with a snapshot behind it; mid-snapshot
+    with only the log).  The parent then recovers — restore newest
+    loadable snapshot + replay the WAL suffix past the watermark — and
+    resumes the unacked tape tail exactly as a real producer would.
+
+    Asserted per config and kill point:
+      * byte-identical outputs to an uninterrupted run (zero duplicate,
+        zero lost admitted events — the exactly-once invariant)
+      * events_in == applied + shed over the recovered pipeline
+      * zero ErrorStore captures (nothing was quietly parked)"""
+    import json as _json
+    import os
+    import shutil
+    import subprocess
+    import tempfile
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.persistence import FileSystemPersistenceStore
+
+    rounds, batch, keys = 10, 128, 6
+    out = {"seed": seed, "configs": {}, "pass": True}
+    for name, (app, streams) in K9_CONFIGS.items():
+        tape = _k9_tape(seed, streams, rounds, batch, keys)
+        events_in = rounds * batch * len(streams)
+
+        # uninterrupted reference run (in-process feed; wire-vs-inproc
+        # byte-identity is net_bench's standing assertion)
+        clean_dir = tempfile.mkdtemp(prefix="siddhi_k9_clean_")
+        mgr = SiddhiManager()
+        mgr.set_persistence_store(FileSystemPersistenceStore(clean_dir))
+        rt = mgr.create_app_runtime(app)
+        hs = {sid: rt.input_handler(sid) for sid in streams}
+        for rd in tape:
+            for sid in streams:
+                cols, ts = rd[sid]
+                hs[sid].send_batch(cols, ts)
+        rt.flush()
+        want = sorted(map(tuple, rt.tables["OutT"].all_rows()))
+        mgr.shutdown()
+        shutil.rmtree(clean_dir, ignore_errors=True)
+
+        cfg = {"events_in": events_in, "clean_rows": len(want)}
+        snapshot_at = 4
+        pre_appends = snapshot_at * len(streams)
+        for kname, point, at in (
+                ("mid_wal_append", "wal.append",
+                 pre_appends + 2 * len(streams) + 1),
+                ("mid_snapshot", "persist.save", 1)):
+            work = tempfile.mkdtemp(prefix=f"siddhi_k9_{name}_")
+            snap_dir = os.path.join(work, "snap")
+            spec = {"app": app.replace(
+                        "@app:durability('batch')",
+                        f"@app:durability('batch', dir='{work}/wal')"),
+                    "streams": streams, "snap_dir": snap_dir,
+                    "seed": seed, "rounds": rounds, "batch": batch,
+                    "keys": keys, "snapshot_at": snapshot_at,
+                    "kill_point": point, "kill_at": at}
+            spec_path = os.path.join(work, "spec.json")
+            with open(spec_path, "w") as f:
+                _json.dump(spec, f)
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--chaos-child", spec_path],
+                capture_output=True, timeout=600)
+            killed = proc.returncode == -9
+            rep = {}
+            got = None
+            shed = applied = resumed_events = 0
+            if killed:
+                m2 = SiddhiManager()
+                m2.set_persistence_store(
+                    FileSystemPersistenceStore(snap_dir))
+                rt2 = m2.create_app_runtime(spec["app"])
+                rep = rt2.recover()
+                durable = dict(rt2.wal.seqs)
+                h2 = {sid: rt2.input_handler(sid) for sid in streams}
+                for k, rd in enumerate(tape):
+                    for sid in streams:
+                        if k + 1 > durable.get(sid, 0):
+                            cols, ts = rd[sid]   # the unacked tail: a
+                            h2[sid].send_batch(cols, ts)  # producer
+                            resumed_events += batch       # retransmits
+                rt2.flush()
+                got = sorted(map(tuple, rt2.tables["OutT"].all_rows()))
+                shed = sum(len(e.events or ())
+                           for e in rt2.error_store.entries())
+                wm_events = sum(rep["watermark"].values()) * batch
+                applied = (wm_events + rep["replayed_events"]
+                           + resumed_events)
+                m2.shutdown()
+            ok = (killed and got == want and shed == 0
+                  and applied + shed == events_in)
+            cfg[kname] = {
+                "killed": killed,
+                "restored_revision": rep.get("restored_revision"),
+                "watermark": rep.get("watermark"),
+                "replayed_frames": rep.get("replayed_frames"),
+                "corrupt_skipped": rep.get("corrupt_skipped"),
+                "recovery_s": rep.get("recovery_s"),
+                "resumed_events": resumed_events,
+                "applied": applied, "shed": shed,
+                "identical": got == want,
+                "pass": ok,
+            }
+            if not killed:
+                cfg[kname]["child_rc"] = proc.returncode
+                cfg[kname]["child_tail"] = \
+                    proc.stderr.decode(errors="replace")[-500:]
+            out["pass"] = out["pass"] and ok
+            shutil.rmtree(work, ignore_errors=True)
+        cfg["pass"] = all(cfg[k]["pass"] for k in
+                          ("mid_wal_append", "mid_snapshot"))
+        out["configs"][name] = cfg
+    return out
+
+
+def durability_bench(smoke=True) -> dict:
+    """The measured durability-overhead column: config-3 TCP-ingest eps
+    per sync policy.  `'batch'` must cost <= 15% vs `'off'` (the bench
+    `durability` field the acceptance criteria pin); `'fsync'` is
+    reported for the honesty of the trade."""
+    import shutil
+    import tempfile
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.net import TcpFrameClient
+
+    n = 1 << 12 if smoke else 1 << 15
+    batch = 512 if smoke else 2048
+    warm = 2
+    tape = make_tape(n + warm * batch, batch)
+    batches = _tape_str_batches(tape)
+    n_timed = sum(t["n"] for t in tape[warm:])
+    eps, matches = {}, {}
+    tmp = tempfile.mkdtemp(prefix="siddhi_dur_bench_")
+    try:
+        for policy in ("off", "batch", "fsync"):
+            head = "@source(type='tcp', port='0')\n"
+            if policy != "off":
+                head = (f"@app:durability('{policy}', "
+                        f"dir='{tmp}/wal_{policy}')\n") + head
+            mgr = SiddhiManager()
+            rt = mgr.create_app_runtime(head + DEV["patterns"] + C3)
+            rows = []
+            rt.add_batch_callback("Out", lambda b, rows=rows: rows.extend(
+                map(tuple, b.rows(rt.strings))))
+            rt.start()
+            cli = TcpFrameClient("127.0.0.1", rt.sources[0].port, STREAM,
+                                 TcpFrameClient.cols_of_schema(
+                                     rt.schemas[STREAM]))
+            for cols, ts in batches[:warm]:
+                cli.send_batch(cols, ts)
+            cli.barrier(timeout=120)
+            t0 = time.perf_counter()
+            for cols, ts in batches[warm:]:
+                cli.send_batch(cols, ts)
+            cli.barrier(timeout=120)
+            eps[policy] = round(n_timed / (time.perf_counter() - t0))
+            matches[policy] = len(rows)
+            cli.close()
+            mgr.shutdown()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    overhead = {p: round(100.0 * (1.0 - eps[p] / eps["off"]), 1)
+                for p in ("batch", "fsync")}
+    identical = len(set(matches.values())) == 1
+    return {"policy": "batch", "tcp_eps": eps,
+            "overhead_pct": overhead, "events": n_timed,
+            "batch": batch, "identical_matches": identical,
+            "pass": bool(overhead["batch"] <= 15.0 and identical)}
+
+
 def chaos_bench(seed: int = 7) -> dict:
     """Seeded chaos harness (`--chaos [--seed N]`): runs the pattern,
     window, and join configs clean and then under injected faults
@@ -1664,6 +1953,18 @@ def chaos_bench(seed: int = 7) -> dict:
     net = _safe("chaos net", lambda: chaos_net(seed), {"pass": False})
     out["net"] = net
     out["pass"] = out["pass"] and bool(net.get("pass"))
+
+    # durability chaos: SIGKILL at fault-injected points (mid-wal.append,
+    # mid-snapshot), recover, prove exactly-once per config
+    k9 = _safe("chaos kill9", lambda: chaos_kill9(seed), {"pass": False})
+    out["kill9"] = k9
+    out["pass"] = out["pass"] and bool(k9.get("pass"))
+
+    # measured durability overhead per sync policy ('batch' <= 15%)
+    dur = _safe("durability overhead", lambda: durability_bench(smoke=True),
+                {"pass": False})
+    out["durability"] = dur
+    out["pass"] = out["pass"] and bool(dur.get("pass"))
     return out
 
 
@@ -1732,6 +2033,11 @@ def pattern_families_smoke() -> dict:
 
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
+    if "--chaos-child" in argv:
+        # hidden subprocess mode for the kill-9 durability chaos: feeds
+        # the scripted tape and SIGKILLs itself at the armed point
+        chaos_kill9_child(argv[argv.index("--chaos-child") + 1])
+        return
     if "--family-smoke" in argv:
         res = pattern_families_smoke()
         print(json.dumps({"metric": "plan_family_parity",
@@ -1994,6 +2300,13 @@ def main(argv=None):
                     lambda: net_bench(smoke=True), {})
     _mark("net transport smoke done", t0)
 
+    # durability-overhead column (ROADMAP item 5): TCP ingest eps per
+    # sync policy on the config-3 schema — 'batch' must stay within 15%
+    # of 'off' for durable serving to be the production default
+    dur_res = _safe("durability overhead",
+                    lambda: durability_bench(smoke=True), {})
+    _mark("durability overhead done", t0)
+
     # transport-vs-host-vs-kernel breakdown per config: the
     # "transport-bound" calibration note as a MEASURED column.  For each
     # config: the kernel-only ceiling, the end-to-end in-process engine
@@ -2050,6 +2363,7 @@ def main(argv=None):
         },
         "roofline": roofline,
         "transport": net_res,
+        "durability": dur_res,
         "transport_breakdown": breakdown,
         "configs": configs,
     }
@@ -2086,6 +2400,14 @@ def main(argv=None):
                         **({"bound": breakdown[k]["bound"]}
                            if breakdown.get(k, {}).get("bound") else {})}
                     for k, v in configs.items()},
+        # durability column (sync policy + measured overhead vs 'off'):
+        # kept OUT of the oversize drop_order, like placement, so the
+        # exactly-once serving trade always survives into the final line
+        "durability": ({"policy": dur_res.get("policy"),
+                        "overhead_pct": dur_res.get("overhead_pct"),
+                        "tcp_eps": (dur_res.get("tcp_eps") or {}).get(
+                            "batch")}
+                       if dur_res else None),
         # device/interpreter query counts per config (placement plane,
         # docs/ANALYSIS.md): a future silent demotion shifts these
         # numbers in the bench trajectory — kept OUT of the oversize

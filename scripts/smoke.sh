@@ -30,9 +30,10 @@ echo "== static analysis: samples corpus =="
 # the analyzer over every samples/*.py app string: expected findings are
 # PINNED (all info-severity conveniences in the samples); any new rule
 # firing — or an expected one disappearing — fails CI
-python -m siddhi_tpu.analysis --expect SA07,SA07,SA07,SA07,SA12 \
+python -m siddhi_tpu.analysis --expect SA07,SA07,SA07,SA07,SA12,SA13,SA13 \
     samples/simple_filter.py samples/time_window.py \
-    samples/partitioned_pattern_tpu.py samples/net_serving.py
+    samples/partitioned_pattern_tpu.py samples/net_serving.py \
+    samples/durable_serving.py
 
 echo "== tier-1 tests =="
 python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
@@ -132,6 +133,127 @@ try:
     print(f"OK: {len(matches)} matches via frame plane, ingest gauges live")
 finally:
     svc.stop()
+EOF
+
+echo "== kill -9 recovery smoke =="
+# exactly-once durable serving end-to-end (docs/RELIABILITY.md): start a
+# service subprocess with @app:durability('batch'), feed N TCP frames
+# (ACK'd = durable), SIGKILL the whole service, restart it, redeploy —
+# recover-on-redeploy must yield match counts identical to an
+# uninterrupted in-process run.  Exits nonzero on any drift.
+python - <<'EOF'
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+import numpy as np
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.persistence import FileSystemPersistenceStore
+from siddhi_tpu.net import TcpFrameClient
+
+APP = """@app:name('KillSmoke')
+@app:durability('batch')
+define stream S (sym string, p double);
+define table M (s1 string, p2 double);
+@info(name='q') from every e1=S[p > 100] -> e2=S[p > e1.p] within 1 sec
+select e1.sym as s1, e2.p as p2 insert into M;
+"""
+
+CHILD = """
+import sys, threading
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.persistence import FileSystemPersistenceStore
+from siddhi_tpu.service import SiddhiService
+mgr = SiddhiManager()
+mgr.set_persistence_store(FileSystemPersistenceStore(sys.argv[1]))
+svc = SiddhiService(port=0, manager=mgr).start()
+print(f"READY {svc.port} {svc.net_port}", flush=True)
+threading.Event().wait()
+"""
+
+rng = np.random.default_rng(11)
+ts0 = 1_700_000_000_000
+frames = [({"sym": np.array([f"K{i}" for i in rng.integers(0, 4, 256)]),
+            "p": np.round(rng.uniform(90, 130, 256), 2)},
+           ts0 + np.arange(k * 256, (k + 1) * 256, dtype=np.int64))
+          for k in range(6)]
+
+# uninterrupted reference
+work = tempfile.mkdtemp(prefix="siddhi_kill9_smoke_")
+mgr = SiddhiManager()
+mgr.set_persistence_store(FileSystemPersistenceStore(work + "/ref"))
+rt = mgr.create_app_runtime(APP)
+rt.start()
+h = rt.input_handler("S")
+for cols, ts in frames:
+    h.send_batch(cols, ts)
+rt.flush()
+want = len(rt.tables["M"].all_rows())
+mgr.shutdown()
+assert want > 0
+
+
+def start_service():
+    p = subprocess.Popen([sys.executable, "-c", CHILD, work + "/svc"],
+                         stdout=subprocess.PIPE, text=True)
+    line = p.stdout.readline().split()
+    assert line and line[0] == "READY", line
+    return p, int(line[1]), int(line[2])
+
+
+def deploy(port):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/siddhi/artifact/deploy",
+        data=APP.encode(), method="POST")
+    return json.loads(urllib.request.urlopen(req).read())
+
+
+def matches(port):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/siddhi/artifact/query",
+        data=json.dumps({"app": "KillSmoke",
+                         "query": "from M select s1"}).encode(),
+        method="POST")
+    return len(json.loads(urllib.request.urlopen(req).read())["rows"])
+
+try:
+    child, port, net_port = start_service()
+    deploy(port)
+    cli = TcpFrameClient("127.0.0.1", net_port, "S",
+                         [("sym", "string"), ("p", "double")],
+                         app="KillSmoke")
+    for cols, ts in frames:
+        cli.send_batch(cols, ts)
+    cli.barrier(timeout=60)        # durable ACK: frames are in the WAL
+    os.kill(child.pid, signal.SIGKILL)
+    child.wait(timeout=10)
+    try:
+        cli.close()
+    except OSError:
+        pass
+
+    child2, port2, _ = start_service()
+    deploy(port2)                  # recover-on-redeploy replays the WAL
+    got = matches(port2)
+    info = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port2}/siddhi/artifact/snapshot"
+        f"?siddhiApp=KillSmoke").read())
+    rec = info["recovery"]
+    assert got == want, f"match drift after kill -9: {got} != {want}"
+    assert rec["replayed_frames"] == len(frames), rec
+    os.kill(child2.pid, signal.SIGKILL)
+    print(f"OK: kill -9 recovery exact ({got} matches, "
+          f"{rec['replayed_frames']} frames replayed in "
+          f"{rec['recovery_s']}s)")
+finally:
+    shutil.rmtree(work, ignore_errors=True)
 EOF
 
 echo "== net serving-plane smoke =="

@@ -47,6 +47,9 @@ RULES = {
     "SA11": ("warn", "join without an `on` condition (cross product)"),
     "SA12": ("info", "device pattern path computes doubles in f32 "
                      "(@app:devicePrecision('f64') opts out)"),
+    "SA13": ("warn", "@app:durability with no resolvable store/WAL "
+                     "directory, or 'fsync' behind an unbounded "
+                     "block-policy source"),
 }
 
 
@@ -462,6 +465,57 @@ def _rule_sa12_f32_precision(ctx, out):
             return        # one note per app is enough
 
 
+def _rule_sa13_durability(ctx, out):
+    """Durability misconfigurations the runtime only surfaces at start
+    time (docs/RELIABILITY.md "Durability & exactly-once recovery"):
+
+    (a) `@app:durability` with no `dir=` element — the WAL directory
+        then depends on manager-side state the app text cannot prove
+        (a file persistence store or $SIDDHI_WAL_DIR); if neither is
+        configured at deploy time, durability disables with only a
+        runtime warning, and without a persistence store the log can
+        NEVER truncate (snapshot barriers never happen) — unbounded
+        growth plus full-log replay on every recovery.
+
+    (b) `'fsync'` combined with `shed.policy='block'` (explicit or the
+        default) on a source with no `rate.limit`: every admitted frame
+        pays an fsync with no admission bound — when the disk stalls,
+        backpressure is the ONLY relief valve, and it arrives as a
+        stalled socket, not an accounted shed."""
+    dur = ast.find_annotation(ctx.app.annotations, "app:durability")
+    if dur is None:
+        return
+    mode = str(dur.element() or "batch").lower()
+    if mode == "off":
+        return
+    if next((v for k, v in dur.elements if k == "dir"), None) is None:
+        out.append(_finding(
+            "SA13",
+            f"@app:durability({mode!r}) declares no dir= element: the "
+            f"WAL directory falls back to the manager's file "
+            f"persistence store or $SIDDHI_WAL_DIR — if neither exists "
+            f"at deploy time durability silently disables (runtime "
+            f"warning only), and without a snapshot store the log "
+            f"never truncates and every recovery replays it whole",
+            "app"))
+    if mode != "fsync":
+        return
+    for sid, sd in ctx.app.stream_definitions.items():
+        src = ast.find_annotation(sd.annotations, "source")
+        if src is None:
+            continue
+        policy = str(src.element("shed.policy") or "block").lower()
+        if policy == "block" and src.element("rate.limit") is None:
+            out.append(_finding(
+                "SA13",
+                f"@app:durability('fsync') with shed.policy='block' "
+                f"and no rate.limit on source stream {sid!r}: every "
+                f"admitted frame pays a per-frame fsync with no "
+                f"admission bound — a disk stall surfaces only as a "
+                f"stalled producer socket; bound the rate or use "
+                f"'batch' (ACK/PING barriers still fsync)", sid))
+
+
 _RULE_FNS = (
     _rule_sa01_every_without_within,
     _rule_sa02_windowless_aggregation,
@@ -475,6 +529,7 @@ _RULE_FNS = (
     _rule_sa10_lanes_family_conflict,
     _rule_sa11_cross_join,
     _rule_sa12_f32_precision,
+    _rule_sa13_durability,
 )
 
 _SEV_ORDER = {"error": 0, "warn": 1, "info": 2}

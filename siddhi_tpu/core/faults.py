@@ -389,7 +389,7 @@ def split_buffered(bufs: list) -> Optional[list]:
 # ---------------------------------------------------------------------------
 
 class FaultInjector:
-    """Deterministic fault injection at the seven recovery boundaries:
+    """Deterministic fault injection at the recovery boundaries:
 
       dispatch        device kernel dispatch (plans' jitted calls)
       d2h             device->host materialization (DispatchPipeline)
@@ -402,6 +402,13 @@ class FaultInjector:
       net.feed        serving-plane admitted-frame ingest; a failure
                       captures the whole frame into the ErrorStore
                       (zero-loss invariant, chaos-tested)
+      wal.append      durability-log record write (core/wal.py) — armed
+                      MID-RECORD, after the first half of the bytes hit
+                      the OS, so a kill there leaves a torn tail; a
+                      raised fault self-heals the file and propagates
+                      (the net feed path then captures the frame whole)
+      wal.fsync       the WAL's fsync call (sync-policy barriers)
+      wal.truncate    snapshot-barrier segment deletion
 
     `counts` arms a burst: the first N checks at a point fail.  `rates`
     arms a per-check probability drawn from a per-point rng seeded from
@@ -413,7 +420,8 @@ class FaultInjector:
     retry paths)."""
 
     POINTS = ("dispatch", "d2h", "sink.publish", "source.connect",
-              "persist.save", "net.decode", "net.feed")
+              "persist.save", "net.decode", "net.feed",
+              "wal.append", "wal.fsync", "wal.truncate")
 
     def __init__(self, seed: int = 0, counts: Optional[dict] = None,
                  rates: Optional[dict] = None, kinds: Optional[dict] = None):

@@ -24,9 +24,42 @@ import time
 from typing import Optional
 
 
+class Revision(str):
+    """Structured descriptor returned by `rt.persist()` — still the
+    revision-id string (str subclass: every existing caller comparing
+    against `store.last_revision()` keeps working), plus the fields the
+    recovery manager and the service snapshot endpoint report:
+
+      revision    the id (== str(self))
+      watermark   per-stream durable WAL frame seq this revision's
+                  state reflects (None when durability is off)
+      durability  the app's sync policy at persist time
+      incremental True for an op-log delta ('I-') / prefixed full
+    """
+
+    def __new__(cls, rev: str, watermark: Optional[dict] = None,
+                durability: str = "off", incremental: bool = False):
+        self = super().__new__(cls, rev)
+        self.revision = rev
+        self.watermark = dict(watermark) if watermark is not None else None
+        self.durability = durability
+        self.incremental = bool(incremental)
+        return self
+
+    def to_dict(self) -> dict:
+        return {"revision": self.revision, "watermark": self.watermark,
+                "durability": self.durability,
+                "incremental": self.incremental}
+
+
 class FileSystemPersistenceStore:
     """One file per revision under <dir>/<app>/ (reference:
     FileSystemPersistenceStore)."""
+
+    # revisions survive a process crash: WAL truncation behind a
+    # snapshot barrier may trust them (custom stores without this
+    # attribute are judged by whether they expose a `dir`)
+    durable = True
 
     def __init__(self, directory: str):
         self.dir = directory
@@ -43,7 +76,23 @@ class FileSystemPersistenceStore:
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(blob)
+            # fsync before publish: WAL truncation behind a snapshot
+            # barrier assumes the revision SURVIVES — a power loss must
+            # not leave a truncated log pointing at a ghost snapshot
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)       # atomic publish
+        try:
+            # the rename itself lives in the directory entry: without a
+            # directory fsync a power loss can forget the publish while
+            # the truncated WAL survives — the exact ghost this guards
+            dfd = os.open(os.path.dirname(path), os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:             # platform without dir fsync
+            pass
 
     def load(self, app: str, revision: str) -> bytes:
         with open(os.path.join(self._app_dir(app),

@@ -270,6 +270,12 @@ def explain(rt) -> dict:
         "queries": {k: queries[k] for k in sorted(queries)},
         "demotions": [d.to_dict() for d in rt.placement.records()],
         "placement": summary(rt),
+        # the durability plane's EXPLAIN entry: the SAME block
+        # statistics() serves (rt.durability_report — one builder, so
+        # the two observability surfaces can never disagree)
+        "durability": rt.durability_report()
+        if hasattr(rt, "durability_report")
+        else {"policy": getattr(rt, "durability", "off")},
     }
 
 

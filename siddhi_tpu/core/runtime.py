@@ -251,6 +251,31 @@ class SiddhiAppRuntime:
                 self._onerror_wait[sid] = \
                     _parse_interval_s(to) if to else 10.0
 
+        # @app:durability('off'|'batch'|'fsync'): write-ahead log of
+        # admitted frames (core/wal.py), coordinated with snapshot
+        # revisions via per-stream durable watermarks so a crash or
+        # redeploy recovers exactly-once (docs/RELIABILITY.md).  The
+        # log opens at start()/recover(); `dir=` overrides the
+        # directory (default: under the manager's persistence store,
+        # else $SIDDHI_WAL_DIR)
+        dur_ann = qast.find_annotation(app.annotations, "app:durability")
+        self.durability = (dur_ann.element() or "batch").lower() \
+            if dur_ann is not None else "off"
+        if self.durability not in ("off", "batch", "fsync"):
+            raise PlanError(
+                f"@app:durability({self.durability!r}): unknown sync "
+                f"policy (have: off | batch | fsync)")
+        self._wal_dir_opt = next(
+            (v for k, v in dur_ann.elements if k == "dir"), None) \
+            if dur_ann is not None else None
+        self._wal_segment_bytes = int(next(
+            (v for k, v in dur_ann.elements if k == "segment.bytes"),
+            8 << 20)) if dur_ann is not None else (8 << 20)
+        self.wal = None                  # WriteAheadLog once opened
+        self._wal_replaying = False      # recovery replay: no re-append
+        self._wal_recovery = None        # last recover() report
+        self.last_revision_descriptor = None   # last persist() Revision
+
         # fault-tolerance state: the replayable ErrorStore behind
         # @OnError(action='store') and sink on.error, the per-plan
         # degradation ladders, and the (optional) seeded fault injector
@@ -406,6 +431,21 @@ class SiddhiAppRuntime:
         sources + trigger schedulers; Scheduler.java:89 timer service)."""
         from .trigger import TriggerRuntime
         self._started = True
+        if self.durability != "off" and self.wal is None:
+            if self._wal_recovery is None:
+                # the recovery manager runs on start (start/redeploy):
+                # opening the log WITHOUT replaying its pre-existing
+                # records would fold their seqs into the live counters,
+                # so the next snapshot's watermark would claim
+                # unapplied frames and the barrier would truncate them
+                # — silent loss.  Fresh log: a cheap no-op.
+                self.recover()
+            else:
+                # shutdown()/start() cycle in one process: the state is
+                # still live (nothing to replay) — REOPEN the log so
+                # durability doesn't silently lapse; seq continuity
+                # comes from the previous generation's counters
+                self._open_wal()
         now = self.now_ms()
         with self._lock:
             for p in self._plans:
@@ -623,6 +663,12 @@ class SiddhiAppRuntime:
             self._sched_stop = None
         self.stats.stop_reporting()
         self.flush()
+        if self.wal is not None:
+            # final barrier + close; keep the object for late metrics
+            # scrapes but stop logging (the engine is down — a
+            # post-shutdown send has no durability claim to honor)
+            self.wal.close()
+            self._wal_closed, self.wal = self.wal, None
         self._started = False
 
     # -- time ----------------------------------------------------------------
@@ -839,8 +885,36 @@ class SiddhiAppRuntime:
     def _freeze(self, stream_id: str, b: BatchBuilder) -> EventBatch:
         """Freeze one builder; under an SLO controller the frozen batch is
         stamped with its first-append wall time so _drain can feed the
-        controller an end-to-end (wait + processing) latency sample."""
+        controller an end-to-end (wait + processing) latency sample.
+
+        Durability hook: every frozen ingest batch (this is where
+        externally admitted frames are born — derived emissions bypass
+        the builders) appends to the WAL, write-ahead of processing,
+        getting its per-stream monotonic frame seq here.  A failed
+        append propagates: the frame must not be processed with no
+        durable record (the net feed path captures it whole into the
+        ErrorStore; direct senders see the error)."""
         batch = b.freeze_and_clear()
+        if self.wal is not None and not self._wal_replaying:
+            try:
+                self.wal.append(stream_id, batch.timestamps,
+                                batch.columns, self.strings,
+                                schema=batch.schema)
+            except BaseException as e:
+                # the builder is already cleared: rows buffered by
+                # EARLIER successful sends ride this frozen batch, so a
+                # propagating append error alone would strand them —
+                # capture the whole batch, replayable, and mark the
+                # exception so the net feed path doesn't capture the
+                # same frame a second time (a double entry would
+                # double-ingest on replay)
+                rows = [(int(ts), row) for ts, row in
+                        zip(batch.timestamps, batch.rows(self.strings))]
+                self.error_store.add(stream_id, "wal.append", e,
+                                     self.now_ms(), events=rows)
+                self.stats.on_fault(stream_id, "wal.append")
+                e._wal_captured = True
+                raise
         if self.slo is not None:
             t0 = self._builder_t0.pop(stream_id, None)
             batch.__dict__["_slo_t0"] = \
@@ -1520,6 +1594,10 @@ class SiddhiAppRuntime:
             # quarantined plans: their state above is in the interpreter
             # twin's format — restore must re-quarantine before loading
             "degraded": list(self._degraded),
+            # per-stream durable watermark: the last WAL frame seq this
+            # snapshot's state already reflects (flush() above applied
+            # every appended frame).  Recovery replays strictly past it.
+            "wal": self.wal.watermark() if self.wal is not None else None,
         }
 
     def restore(self, snap: dict) -> None:
@@ -1561,22 +1639,33 @@ class SiddhiAppRuntime:
         self._clock_ms = snap.get("clock")
         if snap.get("seq") is not None:
             self._seq = max(self._seq, int(snap["seq"]))
+        # durable watermark of the restored revision (may be None on
+        # pre-durability snapshots): recover() replays the WAL suffix
+        # strictly past it
+        self._wal_restored_watermark = snap.get("wal") or {}
 
     def persist(self, incremental: bool = False,
-                asynchronous: bool = False) -> str:
+                asynchronous: bool = False) -> "Revision":
         """Write a revision to the configured persistence store.
         incremental=True writes table op-log deltas (full state for
         everything else — see persistence.py); asynchronous=True hands the
-        store write to a daemon thread (AsyncSnapshotPersistor)."""
+        store write to a daemon thread (AsyncSnapshotPersistor).
+
+        Returns a structured `persistence.Revision` descriptor — still
+        the revision-id string (a str subclass, so existing callers
+        keep working) carrying the per-stream durable WAL watermark the
+        recovery manager pairs snapshots with."""
         if self.manager is None or self.manager.persistence_store is None:
             raise RuntimeError("no persistence store configured")
         import pickle
+        from .persistence import Revision
         store = self.manager.persistence_store
         self.inject("persist.save", self.app.name)
         rev = f"{self.app.name}-{time.time_ns()}"
         if incremental and hasattr(store, "save_incremental"):
             with self._lock:
                 self.flush()
+                wm = self.wal.watermark() if self.wal is not None else None
                 deltas = {k: t.incremental_state()
                           for k, t in self.tables.items()
                           if hasattr(t, "incremental_state")}
@@ -1587,7 +1676,8 @@ class SiddhiAppRuntime:
                             "tables": {k: t.state_dict()
                                        for k, t in self.tables.items()
                                        if not hasattr(t, "incremental_state")},
-                            "clock": self._clock_ms},
+                            "clock": self._clock_ms,
+                            "wal": wm},
                         "table_deltas": deltas}
                 is_full = all("full" in d for d in deltas.values()) \
                     if deltas else True
@@ -1598,13 +1688,56 @@ class SiddhiAppRuntime:
             else:
                 store.save_incremental(self.app.name, rev, blob, is_full)
             # the store prefixes full/delta revisions; return the LOADABLE id
-            return ("F-" if is_full else "I-") + rev
-        blob = pickle.dumps(self.snapshot())
+            desc = Revision(("F-" if is_full else "I-") + rev,
+                            watermark=wm, durability=self.durability,
+                            incremental=True)
+            self._wal_snapshot_barrier(wm, asynchronous)
+            self.last_revision_descriptor = desc
+            return desc
+        snap = self.snapshot()
+        wm = snap.get("wal")
+        blob = pickle.dumps(snap)
         if asynchronous:
             self.persistor().persist(store.save, self.app.name, rev, blob)
         else:
             store.save(self.app.name, rev, blob)
-        return rev
+        desc = Revision(rev, watermark=wm, durability=self.durability)
+        self._wal_snapshot_barrier(wm, asynchronous)
+        self.last_revision_descriptor = desc
+        return desc
+
+    def _wal_snapshot_barrier(self, wm, asynchronous: bool) -> None:
+        """After a revision write: fsync the log (the 'batch' policy's
+        snapshot barrier), then — for SYNCHRONOUS writes only, where
+        the revision is already durable — seal the open segment and
+        truncate sealed segments entirely at-or-below the watermark.
+        An asynchronous revision is not durable until persistor().wait()
+        returns, so its log suffix must survive it."""
+        if self.wal is None or wm is None:
+            return
+        store = self.manager.persistence_store if self.manager else None
+        # truncation hands the watermark's frames over to the snapshot,
+        # so the snapshot must outlive a crash: an in-memory store's
+        # revisions die with the process — deleting disk segments
+        # behind one would lose fsync-ACK'd frames for good
+        store_durable = bool(getattr(store, "durable",
+                                     getattr(store, "dir", None)))
+        try:
+            self.wal.barrier()
+            if not asynchronous and store_durable:
+                self.wal.rotate()
+                self.wal.truncate(wm)
+        except Exception as e:
+            # housekeeping must not fail a SUCCESSFUL snapshot: kept
+            # segments are merely redundant (recovery skips them via
+            # the watermark), and the pre-watermark log tail the
+            # barrier could not sync is superseded by the snapshot —
+            # warn + carry on, the next barrier retries
+            import warnings
+            warnings.warn(
+                f"WAL snapshot barrier incomplete "
+                f"({type(e).__name__}: {e}); sealed segments kept, "
+                f"next snapshot retries", RuntimeWarning)
 
     def persistor(self):
         """The async snapshot persistor: .wait() joins outstanding
@@ -1636,6 +1769,7 @@ class SiddhiAppRuntime:
             self._apply_incremental_blob(body)   # incremental-format revision
         else:
             self.restore(body)
+        self.restored_revision = rev
 
     def restore_last_state(self) -> None:
         import pickle
@@ -1682,9 +1816,182 @@ class SiddhiAppRuntime:
                     f"({type(e).__name__}: {e}); falling back to the "
                     f"previous revision", RuntimeWarning)
 
+    # -- durability: WAL + exactly-once crash recovery -----------------------
+
+    def _wal_directory(self) -> Optional[str]:
+        """Resolve the WAL directory: the @app:durability `dir=`
+        element, else under a file-backed persistence store, else
+        $SIDDHI_WAL_DIR — None when nowhere durable exists."""
+        import os
+        if self._wal_dir_opt:
+            return self._wal_dir_opt
+        safe = self.app.name.replace(os.sep, "_") or "_app"
+        store = self.manager.persistence_store if self.manager else None
+        base = getattr(store, "dir", None)
+        if base:
+            return os.path.join(base, safe, "wal")
+        env = os.environ.get("SIDDHI_WAL_DIR")
+        if env:
+            return os.path.join(env, safe)
+        return None
+
+    def _open_wal(self):
+        """Open (or create) the app's write-ahead log.  Resolution
+        failure disables durability LOUDLY (warning + a reason in the
+        statistics()/explain() durability block) — never silently."""
+        if self.durability == "off" or self.wal is not None:
+            return self.wal
+        d = self._wal_directory()
+        if d is None:
+            import warnings
+            self._wal_disabled_reason = (
+                "no WAL directory: configure a file persistence store, "
+                "@app:durability(dir='...'), or $SIDDHI_WAL_DIR")
+            warnings.warn(
+                f"@app:durability({self.durability!r}) on "
+                f"{self.app.name!r} is DISABLED — "
+                f"{self._wal_disabled_reason}", RuntimeWarning)
+            return None
+        from .wal import WriteAheadLog
+        self.wal = WriteAheadLog(d, policy=self.durability,
+                                 segment_bytes=self._wal_segment_bytes,
+                                 inject=self.inject,
+                                 armed=lambda:
+                                 self.fault_injector is not None)
+        # seq continuity past what the disk scan can see: truncation
+        # behind a snapshot barrier may have emptied the log, so floor
+        # the counters with the restored watermark (crash recovery) and
+        # with the previous generation's counters (shutdown/start cycle
+        # in one process) — new frames must number PAST everything a
+        # snapshot already claims, or the next recovery skips them
+        self.wal.floor_seqs(getattr(self, "_wal_restored_watermark",
+                                    None))
+        prev = getattr(self, "_wal_closed", None)
+        if prev is not None:
+            self.wal.floor_seqs(prev.seqs)
+        return self.wal
+
+    def durability_report(self) -> dict:
+        """The ONE durability observability block, shared verbatim by
+        `statistics()["durability"]` and `rt.explain()["durability"]`:
+        sync policy, whether the log is LIVE (the silently-lost alert
+        signal — after shutdown the closed generation's counters still
+        report but `enabled` reads False), WAL gauges, the disabled
+        reason when resolution failed, and the last recovery report."""
+        d = {"policy": self.durability}
+        if self.durability == "off":
+            return d
+        live = self.wal
+        wal = live or getattr(self, "_wal_closed", None)
+        d["enabled"] = live is not None
+        if wal is not None:
+            d["wal_dir"] = wal.dir
+            d.update(wal.metrics())
+        else:
+            reason = getattr(self, "_wal_disabled_reason", None)
+            if reason:
+                d["reason"] = reason
+        if self._wal_recovery is not None:
+            d["recovery"] = dict(self._wal_recovery)
+        return d
+
+    def recover(self) -> dict:
+        """Crash/redeploy recovery, exactly-once: restore the newest
+        loadable snapshot revision (when a persistence store is
+        configured), open the WAL — healing any torn tail back to the
+        last valid record — and replay its suffix, skipping frames
+        at-or-below the restored per-stream watermark.  Zero duplicates
+        (the watermark skip), zero loss (every durable frame past it
+        re-feeds; a frame that fails to feed captures whole into the
+        ErrorStore).  Returns — and keeps, for statistics()/explain() —
+        a recovery report.  Idempotent: once the log is open (a prior
+        recover(), or a disabled-loudly attempt) the call returns the
+        previous report without re-replaying — a second replay of an
+        open log would double-apply this run's own appends."""
+        from .batch import rows_of_columns
+        if self.wal is not None or self._wal_recovery is not None:
+            return dict(self._wal_recovery or {})
+        t0 = time.perf_counter()
+        report = {"restored_revision": None, "watermark": {},
+                  "replayed_frames": 0, "replayed_events": 0,
+                  "skipped_frames": 0, "failed_frames": 0,
+                  "corrupt_skipped": 0, "recovery_s": 0.0}
+        store = self.manager.persistence_store if self.manager else None
+        already = getattr(self, "_wal_restored_watermark", None)
+        if already is not None:
+            # the caller restored a revision of their choosing (manual
+            # restore_revision/restore_last_state): honor it — replay
+            # past ITS watermark instead of re-restoring the newest
+            report["restored_revision"] = getattr(
+                self, "restored_revision", None)
+            report["watermark"] = dict(already)
+        elif store is not None and store.last_revision(self.app.name) \
+                is not None:
+            self._wal_restored_watermark = None
+            self.restore_last_state()
+            wm = getattr(self, "_wal_restored_watermark", None)
+            if wm is not None:          # at least one revision applied
+                report["restored_revision"] = getattr(
+                    self, "restored_revision",
+                    str(store.last_revision(self.app.name)))
+                report["watermark"] = dict(wm)
+        wal = self._open_wal()
+        if wal is not None:
+            wm = report["watermark"]
+            self._wal_replaying = True
+            try:
+                def _capture(stream, schema, ts, cols, err):
+                    # a durable frame must never vanish: capture whole
+                    # (schema drift / dropped stream on redeploy — the
+                    # record may not even decode against the NEW
+                    # schema, so fall back to its own column order)
+                    report["failed_frames"] += 1
+                    try:
+                        rows = rows_of_columns(schema, ts, cols,
+                                               self.strings)
+                    except Exception:
+                        names = sorted(cols)
+                        arrs = [np.asarray(cols[n]).tolist()
+                                for n in names]
+                        rows = list(zip(
+                            np.asarray(ts).tolist(),
+                            (tuple(r) for r in zip(*arrs))))
+                    self.error_store.add(stream, "wal.replay", err,
+                                         self.now_ms(), events=rows)
+
+                for stream, seq, ts, cols in wal.replay():
+                    if seq <= wm.get(stream, 0):
+                        report["skipped_frames"] += 1
+                        continue
+                    schema = self.schemas.get(stream)
+                    if schema is None:
+                        _capture(stream, None, ts, cols,
+                                 f"stream {stream!r} no longer exists "
+                                 f"in the redeployed app")
+                        continue
+                    try:
+                        self.send_columnar(stream, cols, ts)
+                    except Exception as e:
+                        _capture(stream, schema, ts, cols, e)
+                        continue
+                    report["replayed_frames"] += 1
+                    report["replayed_events"] += int(
+                        np.asarray(ts).shape[0])
+            finally:
+                self._wal_replaying = False
+            self.flush()
+            report["corrupt_skipped"] = wal.corrupt_skipped
+        report["recovery_s"] = round(time.perf_counter() - t0, 6)
+        self._wal_recovery = report
+        return report
+
 
 class InMemoryPersistenceStore:
     """reference: core:util/persistence/InMemoryPersistenceStore.java"""
+
+    # revisions die with the process: the WAL snapshot barrier must
+    # NOT truncate segments behind one
+    durable = False
 
     def __init__(self):
         self._data: dict = defaultdict(dict)

@@ -682,6 +682,55 @@ def render_prometheus(reports: dict) -> str:
             doc.add("siddhi_tpu_tuning_cache_entries", "gauge",
                     "persisted geometry winners in the tuning cache",
                     al, tun.get("tuning_cache_entries"))
+        # durability series (core/wal.py): WAL volume, fsync latency,
+        # segment churn, and the crash-recovery gauges
+        dur = rep.get("durability")
+        if dur:
+            doc.add("siddhi_tpu_wal_enabled", "gauge",
+                    "write-ahead log live (0 with @app:durability "
+                    "declared means durability silently lost — alert)",
+                    al, 1 if dur.get("enabled") else 0)
+            _WAL_COUNTERS = (
+                ("appended_frames", "siddhi_tpu_wal_appends_total",
+                 "admitted frames appended to the WAL"),
+                ("appended_events", "siddhi_tpu_wal_events_total",
+                 "events covered by WAL records"),
+                ("appended_bytes", "siddhi_tpu_wal_bytes_total",
+                 "bytes appended to the WAL"),
+                ("fsyncs", "siddhi_tpu_wal_fsyncs_total",
+                 "WAL fsync calls (per-append under 'fsync', "
+                 "barrier-only under 'batch')"),
+                ("corrupt_skipped", "siddhi_tpu_wal_corrupt_skipped_total",
+                 "torn/corrupt WAL records or segments dropped by "
+                 "recovery scans"),
+                ("truncated_segments",
+                 "siddhi_tpu_wal_truncated_segments_total",
+                 "sealed segments deleted behind snapshot barriers"))
+            for key, name, help_ in _WAL_COUNTERS:
+                if key in dur:
+                    doc.add(name, "counter", help_, al, dur[key])
+            doc.add("siddhi_tpu_wal_segments", "gauge",
+                    "live WAL segments (sealed + open)", al,
+                    dur.get("segments"))
+            for sid, s in (dur.get("last_seq") or {}).items():
+                doc.add("siddhi_tpu_wal_last_seq", "gauge",
+                        "last durable frame seq per stream",
+                        {**al, "stream": sid}, s)
+            fs = dur.get("fsync")
+            if fs:
+                _summary(doc, "siddhi_tpu_wal_fsync_latency_seconds",
+                         "WAL fsync latency", al, fs)
+            rec = dur.get("recovery")
+            if rec:
+                doc.add("siddhi_tpu_wal_recovery_seconds", "gauge",
+                        "wall time of the last crash recovery "
+                        "(restore + WAL replay)", al, rec.get("recovery_s"))
+                doc.add("siddhi_tpu_wal_replayed_frames", "gauge",
+                        "frames replayed by the last recovery", al,
+                        rec.get("replayed_frames"))
+                doc.add("siddhi_tpu_wal_replayed_events", "gauge",
+                        "events replayed by the last recovery", al,
+                        rec.get("replayed_events"))
         slo = rep.get("slo")
         if slo:
             doc.add("siddhi_tpu_slo_target_seconds", "gauge",
@@ -961,6 +1010,12 @@ class StatisticsManager:
         slo = getattr(self.rt, "slo", None)
         if slo is not None:
             rep["slo"] = slo.metrics()
+        # durability (core/wal.py): the runtime's shared report block —
+        # ALWAYS present when @app:durability is declared (not gated on
+        # `enabled`): a silently-disabled log must be as loud as a
+        # silent demotion would be
+        if getattr(self.rt, "durability", "off") != "off":
+            rep["durability"] = self.rt.durability_report()
         return rep
 
     def prometheus(self) -> str:

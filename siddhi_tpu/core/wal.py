@@ -1,0 +1,485 @@
+"""Write-ahead log of admitted columnar frames — the durability half of
+exactly-once serving (docs/RELIABILITY.md "Durability & exactly-once
+recovery").
+
+Admitted frames are already CRC'd, self-contained, and replayable on
+the wire (net/frame.py); this module makes them SURVIVABLE: every frame
+the runtime admits (one record per frozen micro-batch, row-path and
+columnar ingest alike) appends to a segmented, CRC-per-record,
+append-only log BEFORE it is processed.  Snapshot revisions record the
+per-stream durable watermark (the last frame seq the snapshot's state
+already reflects), so crash recovery is:
+
+    restore newest loadable snapshot
+      -> replay the WAL suffix, skipping frames at-or-below the
+         watermark
+      -> zero duplicates, zero loss
+
+Record layout (little-endian; one record per admitted frame):
+
+    offset  size  field
+    0       2     magic   0x4C57 ("WL")
+    2       1     version (1)
+    3       1     type    (1 = FRAME)
+    4       4     payload length N
+    8       4     CRC32 of payload (zlib.crc32)
+    12      N     payload:
+                    u64 per-stream frame seq
+                    u16 stream-id utf-8 length + bytes
+                    pickle({"ts": int64 array, "cols": {name: array}})
+
+String columns are stored DECODED (object arrays of str) so a record is
+self-contained: replay re-encodes through the restored StringTable in
+arrival order, reproducing the original dictionary codes byte-for-byte.
+
+Segments (`wal-<n>.seg` under the WAL directory) seal at
+`segment_bytes`; a snapshot barrier rotates to a fresh segment and
+truncates every sealed segment whose frames are all at-or-below the
+snapshot's watermark (per-stream seqs are monotonic and segments are
+ordered, so whole-segment deletion is exact).
+
+Corruption policy — the restore_chain philosophy (persistence.py)
+applied to a log: replay applies the longest VALID PREFIX.  A torn tail
+(crash mid-append), a CRC mismatch, a bad magic, or a missing segment
+number each end the replay there, counted in `corrupt_skipped`; opening
+for append heals the log back to that prefix (torn tail truncated,
+unreachable later segments quarantined) so the next crash's replay
+never dead-ends at an old scar.
+
+Sync policies (`@app:durability('off'|'batch'|'fsync')`):
+
+    off    no WAL at all (the pre-durability engine)
+    batch  append + OS-buffer flush per frame; fsync at barriers
+           (snapshot, PING/ACK, rotate, close).  Survives process
+           kill; an OS crash can lose the post-barrier tail.
+    fsync  fsync after EVERY append before the ingest call returns —
+           an ACK'd frame survives power loss.
+
+Fault-injection points (faults.FaultInjector): `wal.append` (armed
+mid-record, after the first half of the bytes reached the OS — a
+SIGKILL there leaves a torn tail; a raised fault self-heals the file
+and propagates so the net feed path captures the frame whole),
+`wal.fsync`, and `wal.truncate`.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import re
+import struct
+import threading
+import time
+import zlib
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+from .telemetry import Histogram
+
+MAGIC = 0x4C57
+VERSION = 1
+FRAME = 1
+HEADER = struct.Struct("<HBBII")        # magic, version, type, len, crc
+SEG_RE = re.compile(r"^wal-(\d{8})\.seg$")
+
+POLICIES = ("off", "batch", "fsync")
+
+
+class WalError(Exception):
+    """A WAL append/scan failure that must not be silently swallowed."""
+
+
+def _string_delta(codes: np.ndarray, strings) -> dict:
+    """{code: str} for the DISTINCT codes of one string column — the
+    self-containment delta logged beside the raw code array, so a
+    record replays into a FRESH StringTable without pickling a
+    per-event object array (frames repeat a few symbols thousands of
+    times; the dictionary is consulted once per distinct code)."""
+    table = strings._to_str
+    return {int(c): (table[c] if 0 <= c < len(table) else None)
+            for c in np.unique(np.asarray(codes)).tolist()}
+
+
+def _apply_string_delta(codes: np.ndarray, delta: dict) -> np.ndarray:
+    """codes + logged {code: str} -> object array of str/None for
+    re-encoding through the (possibly different) live StringTable."""
+    arr = np.asarray(codes)
+    lut = np.empty((max(delta) + 1) if delta else 1, dtype=object)
+    for c, s in delta.items():
+        lut[c] = s
+    return lut[arr]
+
+
+class WriteAheadLog:
+    """One app's segmented frame log.  Thread-safe: appends are already
+    serialized by the runtime lock, but barriers/scrapes arrive from
+    scheduler and connection threads."""
+
+    def __init__(self, directory: str, policy: str = "batch",
+                 segment_bytes: int = 8 << 20,
+                 inject: Optional[Callable[[str, str], None]] = None,
+                 armed: Optional[Callable[[], bool]] = None):
+        if policy not in POLICIES or policy == "off":
+            raise WalError(f"unknown WAL sync policy {policy!r} "
+                           f"(have: batch | fsync)")
+        self.dir = directory
+        self.policy = policy
+        self.segment_bytes = int(segment_bytes)
+        self.inject = inject or (lambda point, detail="": None)
+        # `armed()` true -> a fault injector may fire: append takes the
+        # split-write path (flush + inject between the record's halves,
+        # so a SIGKILL there leaves a deterministic torn tail).  The
+        # unarmed fast path is ONE buffered write — the per-frame cost
+        # the <=15% 'batch' overhead budget is built on.
+        self.armed = armed or (lambda: False)
+        self._lock = threading.RLock()
+        self._f = None                  # open segment file object
+        self._seg_no = 0
+        self._seg_len = 0
+        # per-stream monotonic frame seq, assigned at admission (freeze)
+        self.seqs: dict = {}
+        # per-open-segment max seq per stream; sealed segments keep
+        # theirs in _sealed so truncation never has to rescan files
+        self._seg_max: dict = {}
+        self._sealed: list = []         # [(seg_no, {stream: max_seq})]
+        # counters (statistics()["durability"] + siddhi_tpu_wal_*)
+        self.appended_frames = 0
+        self.appended_events = 0
+        self.appended_bytes = 0
+        self.fsyncs = 0
+        self.fsync_hist = Histogram()
+        self.corrupt_skipped = 0        # records/segments dropped by scans
+        self.truncated_segments = 0
+        self._unsynced = False
+        os.makedirs(directory, exist_ok=True)
+        with self._lock:
+            self._open_for_append_locked()
+
+    # -- segment bookkeeping -------------------------------------------------
+
+    def _seg_path(self, n: int) -> str:
+        return os.path.join(self.dir, f"wal-{n:08d}.seg")
+
+    def _segments(self) -> list:
+        """Existing segment numbers, ascending."""
+        out = []
+        for name in os.listdir(self.dir):
+            m = SEG_RE.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def _open_for_append_locked(self) -> None:
+        """Scan the existing log once: recover the per-stream seq
+        counters and per-segment maxima, heal the valid prefix (truncate
+        the torn tail, quarantine segments unreachable past a corrupt
+        record — replay stops at the first scar, so anything after it
+        could never be applied again), and open a FRESH segment."""
+        segs = self._segments()
+        stop_at: Optional[int] = None   # first unreadable segment number
+        for i, n in enumerate(segs):
+            if stop_at is not None:
+                # unreachable: replay can never pass the scar before it
+                os.replace(self._seg_path(n),
+                           self._seg_path(n) + ".quarantined")
+                self.corrupt_skipped += 1
+                continue
+            if i and n != segs[i - 1] + 1:
+                # numbering gap (a deleted/lost segment): same policy
+                self.corrupt_skipped += 1
+                stop_at = n
+                os.replace(self._seg_path(n),
+                           self._seg_path(n) + ".quarantined")
+                continue
+            maxima, valid_end, clean = self._scan_segment_locked(
+                n, apply=True)
+            self._sealed.append((n, maxima))
+            if not clean:
+                # torn tail / CRC scar: heal the file back to the prefix
+                with open(self._seg_path(n), "r+b") as f:
+                    f.truncate(valid_end)
+                self.corrupt_skipped += 1
+                stop_at = n + 1
+        # the fresh segment numbers CONTIGUOUSLY after the kept prefix —
+        # numbering from segs[-1]+1 after a quarantine would leave a
+        # permanent gap that every later open reads as corruption,
+        # quarantining (and losing) everything appended after the heal
+        last_kept = self._sealed[-1][0] if self._sealed else 0
+        self._seg_no = last_kept + 1
+        self._f = open(self._seg_path(self._seg_no), "ab")
+        self._seg_len = 0
+        self._seg_max = {}
+
+    def _scan_segment_locked(self, n: int, apply: bool = False):
+        """-> ({stream: max_seq}, valid_end_offset, clean).  `apply`
+        folds the maxima into self.seqs (open-time recovery of the
+        counters)."""
+        maxima: dict = {}
+        off = 0
+        clean = True
+        try:
+            with open(self._seg_path(n), "rb") as f:
+                data = f.read()
+        except OSError:
+            return maxima, 0, False
+        while True:
+            rec = self._parse_record(data, off)
+            if rec is None:
+                clean = off == len(data)
+                break
+            stream, seq, _body, end = rec
+            maxima[stream] = max(maxima.get(stream, 0), seq)
+            off = end
+        if apply:
+            for sid, s in maxima.items():
+                self.seqs[sid] = max(self.seqs.get(sid, 0), s)
+        return maxima, off, clean
+
+    @staticmethod
+    def _parse_record(data: bytes, off: int):
+        """One record at `off` -> (stream, seq, pickled_body_bytes,
+        end_off), or None when truncated/corrupt (the caller decides
+        whether that is a clean EOF)."""
+        if len(data) - off < HEADER.size:
+            return None
+        magic, ver, rtype, n, crc = HEADER.unpack_from(data, off)
+        if magic != MAGIC or ver != VERSION or rtype != FRAME:
+            return None
+        start = off + HEADER.size
+        if start + n > len(data):
+            return None                 # torn tail
+        payload = data[start:start + n]
+        if crc != (zlib.crc32(payload) & 0xFFFFFFFF):
+            return None
+        (seq,) = struct.unpack_from("<Q", payload, 0)
+        (slen,) = struct.unpack_from("<H", payload, 8)
+        stream = payload[10:10 + slen].decode()
+        return stream, seq, payload[10 + slen:], start + n
+
+    # -- append --------------------------------------------------------------
+
+    def append(self, stream_id: str, timestamps: np.ndarray,
+               columns: dict, strings, schema=None) -> int:
+        """Log one admitted frame; returns its per-stream seq.  String
+        columns stay as their int32 code arrays; the record carries a
+        {code: str} delta for the frame's DISTINCT codes, so it is
+        self-contained without pickling a per-event object array.
+        Raises on any write failure AFTER restoring the file to the
+        previous record boundary — a failed append never leaves a scar
+        the next append would bury."""
+        from ..query.ast import AttrType
+        cols = {}
+        strs = {}
+        str_names = ()
+        if schema is not None:
+            str_names = {a.name for a in schema.attributes
+                         if a.type == AttrType.STRING}
+        for name, arr in columns.items():
+            cols[name] = np.asarray(arr)
+            if name in str_names:
+                strs[name] = _string_delta(arr, strings)
+        body = pickle.dumps(
+            {"ts": np.asarray(timestamps, dtype=np.int64), "cols": cols,
+             "strs": strs},
+            protocol=pickle.HIGHEST_PROTOCOL)
+        with self._lock:
+            seq = self.seqs.get(stream_id, 0) + 1
+            sid = stream_id.encode()
+            payload = struct.pack("<QH", seq, len(sid)) + sid + body
+            blob = HEADER.pack(MAGIC, VERSION, FRAME, len(payload),
+                               zlib.crc32(payload) & 0xFFFFFFFF) + payload
+            base = self._seg_len        # tracked: tell() is a syscall
+            try:
+                if self.armed():
+                    # split write with the injection point between the
+                    # halves, first half flushed to the OS: an armed
+                    # `wal.append` fault (or a SIGKILL there) leaves a
+                    # deterministic torn record for the recovery scan
+                    half = len(blob) // 2
+                    self._f.write(blob[:half])
+                    self._f.flush()
+                    self.inject("wal.append", stream_id)
+                    self._f.write(blob[half:])
+                else:
+                    self._f.write(blob)
+                self._f.flush()
+                if self.policy == "fsync":
+                    self._fsync()
+                else:
+                    self._unsynced = True
+            except BaseException:
+                # self-heal: the partial record must not poison the log
+                try:
+                    self._f.truncate(base)
+                    self._f.flush()
+                except OSError:
+                    pass
+                raise
+            self.seqs[stream_id] = seq
+            self._seg_max[stream_id] = seq
+            self._seg_len += len(blob)
+            self.appended_frames += 1
+            self.appended_events += int(np.asarray(timestamps).shape[0])
+            self.appended_bytes += len(blob)
+            if self._seg_len >= self.segment_bytes:
+                self._rotate_locked()
+            return seq
+
+    def _fsync(self) -> None:
+        self.inject("wal.fsync", "")
+        t0 = time.perf_counter()
+        os.fsync(self._f.fileno())
+        self.fsync_hist.record(time.perf_counter() - t0)
+        self.fsyncs += 1
+        self._unsynced = False
+
+    def barrier(self) -> None:
+        """Make everything appended so far durable (the PING/ACK and
+        snapshot barrier).  Cheap when nothing new was appended."""
+        with self._lock:
+            if self._f is None or not self._unsynced:
+                return
+            self._f.flush()
+            self._fsync()
+
+    # -- rotation / truncation -----------------------------------------------
+
+    def _rotate_locked(self) -> None:
+        self._f.flush()
+        self._fsync()
+        self._f.close()
+        self._sealed.append((self._seg_no, self._seg_max))
+        self._seg_no += 1
+        self._seg_max = {}
+        self._seg_len = 0
+        self._f = open(self._seg_path(self._seg_no), "ab")
+
+    def rotate(self) -> None:
+        """Seal the open segment and start a fresh one (called at
+        snapshot barriers so truncation can drop whole sealed
+        segments)."""
+        with self._lock:
+            if self._seg_len:
+                self._rotate_locked()
+
+    def truncate(self, watermark: dict) -> int:
+        """Delete sealed segments whose every frame is at-or-below the
+        per-stream `watermark` (a snapshot's durable point).  Returns
+        the number of segments removed."""
+        removed = 0
+        with self._lock:
+            keep = []
+            for seg_no, maxima in self._sealed:
+                disposable = maxima and all(
+                    s <= watermark.get(sid, 0) for sid, s in maxima.items())
+                if not maxima:
+                    disposable = True   # empty segment: nothing to lose
+                if disposable:
+                    self.inject("wal.truncate", str(seg_no))
+                    try:
+                        os.remove(self._seg_path(seg_no))
+                    except FileNotFoundError:
+                        pass
+                    removed += 1
+                    self.truncated_segments += 1
+                else:
+                    keep.append((seg_no, maxima))
+            self._sealed = keep
+        return removed
+
+    def floor_seqs(self, wm: Optional[dict]) -> None:
+        """Raise per-stream seq counters to at least `wm` — the
+        restored snapshot watermark (or the previous generation's
+        counters) after snapshot-barrier truncation emptied the log:
+        the open-scan alone would restart seqs at 1, numbering new
+        frames at-or-below the watermark so the NEXT recovery's skip
+        would silently swallow them."""
+        with self._lock:
+            for sid, s in (wm or {}).items():
+                if int(s) > self.seqs.get(sid, 0):
+                    self.seqs[sid] = int(s)
+
+    def watermark(self) -> dict:
+        """Per-stream last-appended frame seq — what a snapshot taken
+        NOW (after a flush) already reflects."""
+        with self._lock:
+            return dict(self.seqs)
+
+    # -- replay --------------------------------------------------------------
+
+    def replay(self) -> Iterator[tuple]:
+        """Yield (stream_id, seq, timestamps, columns) for the longest
+        valid prefix of the log, in append order.  Stops — counting
+        `corrupt_skipped` — at the first torn/corrupt record or missing
+        segment: per-stream seqs are monotonic and frames must apply in
+        order, so nothing past a scar can be applied exactly-once."""
+        with self._lock:
+            if self._unsynced:
+                self.barrier()
+            segs = self._segments()
+
+        def scar():                     # the RLock guards the counter
+            with self._lock:            # against scrapes; replay itself
+                self.corrupt_skipped += 1       # is single-consumer
+
+        prev = None
+        for n in segs:
+            if prev is not None and n != prev + 1:
+                scar()
+                return                  # missing segment: stop here
+            prev = n
+            try:
+                with open(self._seg_path(n), "rb") as f:
+                    data = f.read()
+            except OSError:
+                scar()
+                return
+            off = 0
+            while True:
+                rec = self._parse_record(data, off)
+                if rec is None:
+                    if off != len(data):
+                        scar()
+                        return          # torn/corrupt: stop the replay
+                    break
+                stream, seq, body, off = rec
+                rd = pickle.loads(body)
+                cols = rd["cols"]
+                for name, delta in (rd.get("strs") or {}).items():
+                    # codes -> str via the record's own delta, so the
+                    # replay re-encodes through the LIVE StringTable
+                    cols[name] = _apply_string_delta(cols[name], delta)
+                yield stream, seq, rd["ts"], cols
+
+    # -- lifecycle / telemetry -----------------------------------------------
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.flush()
+                if self._unsynced:
+                    self._fsync()
+                self._f.close()
+                self._f = None
+
+    def metrics(self) -> dict:
+        with self._lock:
+            m = {"policy": self.policy,
+                 "segments": len(self._sealed) + 1,
+                 "open_segment_bytes": self._seg_len,
+                 "appended_frames": self.appended_frames,
+                 "appended_events": self.appended_events,
+                 "appended_bytes": self.appended_bytes,
+                 "fsyncs": self.fsyncs,
+                 "corrupt_skipped": self.corrupt_skipped,
+                 "truncated_segments": self.truncated_segments,
+                 "last_seq": dict(self.seqs)}
+            if self.fsync_hist.count:
+                fs = {"batches": self.fsync_hist.count,
+                      "seconds": self.fsync_hist.sum}
+                for p in (50, 95, 99):
+                    v = self.fsync_hist.percentile(p)
+                    if v is not None:
+                        fs[f"p{p}_ms"] = round(v * 1e3, 4)
+                m["fsync"] = fs
+            return m

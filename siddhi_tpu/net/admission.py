@@ -230,6 +230,14 @@ class AdmissionController:
         with self._lock:
             return self._drain_locked()
 
+    def pending_count(self) -> int:
+        """Frames admitted-but-not-yet-fed: parked in the 'oldest'
+        queue or drained and still feeding on another thread.  The
+        durable-ACK barrier waits on this — an ACK must never cover a
+        frame that exists only in memory."""
+        with self._lock:
+            return len(self._pending) + self._inflight
+
     def feed_safely(self, work: Work) -> None:
         """Feed one admitted unit, capturing a failure into the
         ErrorStore — admitted work must never vanish.  (The server's
@@ -241,13 +249,16 @@ class AdmissionController:
         except Exception as e:
             if self.error_store is None:
                 raise
-            try:
-                rows = work.rows()
-            except Exception:
-                rows = []
-            self.error_store.add(
-                work.stream_id or self.stream_id, "net.feed", e,
-                self.now_ms(), events=rows)
+            if not getattr(e, "_wal_captured", False):
+                # (a WAL append failure already captured the frame —
+                # a second entry would double-ingest on replay)
+                try:
+                    rows = work.rows()
+                except Exception:
+                    rows = []
+                self.error_store.add(
+                    work.stream_id or self.stream_id, "net.feed", e,
+                    self.now_ms(), events=rows)
             if self.on_fault is not None:
                 try:
                     self.on_fault(self.stream_id, "net.feed")

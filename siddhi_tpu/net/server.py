@@ -240,7 +240,37 @@ class Connection:
         if ftype == fp.PING:
             token = fp.decode_u64(payload)
             self.pump()
+            wal0 = getattr(self.rt, "wal", None)
+            if wal0 is not None and self.ctrl is not None:
+                # durable-ACK: frames parked by the 'oldest' policy (or
+                # mid-feed on another thread) are memory-only — acking
+                # past them would bound the producer's retransmit
+                # buffer below data that can still vanish.  Wait for
+                # the park to drain (token refills feed it; sheds land
+                # accounted in the ErrorStore); shutdown mid-wait
+                # closes WITHOUT acking.
+                while self.ctrl.pending_count():
+                    if self.server.stopping():
+                        return False
+                    time.sleep(0.005)
+                    self.pump()
             self.rt.flush()
+            # durable-ACK contract (docs/SERVING.md): under
+            # @app:durability an ACK means every frame before the PING
+            # is in the write-ahead log AND fsynced — the producer may
+            # discard its retransmit buffer.  ('batch' policy frames
+            # are flushed per append; this barrier is the fsync.)
+            wal = getattr(self.rt, "wal", None)
+            if wal is not None:
+                try:
+                    wal.barrier()
+                except Exception as e:
+                    # a failed barrier must NOT ack: the producer would
+                    # discard frames the log cannot promise.  Fatal to
+                    # the connection (like a desync) — the producer
+                    # reconnects and retransmits from its last ACK.
+                    raise fp.FrameDesync(
+                        f"durability barrier failed: {e}") from e
             self._reply(fp.encode_ack(token))
             return True
         raise fp.FrameError(
@@ -312,6 +342,12 @@ class Connection:
                 self.ctrl.feed_safely(w)
 
     def _grant_credit(self) -> None:
+        # credit is granted AFTER the frame fed (the call site above) —
+        # under @app:durability the feed path appended (and, for
+        # 'fsync', synced) the WAL first, so credit never outruns the
+        # log on the admit path.  The queued ('oldest') path can grant
+        # before its park drains; ACK — the PING barrier — is the
+        # durability signal producers must trust for retransmit.
         if self.send is None or not self.credit_chunk:
             return
         self._since_credit += 1
@@ -427,11 +463,14 @@ class NetServer:
                     rt.inject("net.feed", stream_id)
                     rt.send_columnar(stream_id, cols, ts)
                 except Exception as e:
-                    # an admitted frame must NEVER vanish: capture whole
-                    rt.error_store.add(
-                        stream_id, "net.feed", e, rt.now_ms(),
-                        events=rows_of_columns(schema, ts, cols,
-                                               rt.strings))
+                    # an admitted frame must NEVER vanish: capture
+                    # whole — unless the WAL append path already did
+                    # (a second entry would double-ingest on replay)
+                    if not getattr(e, "_wal_captured", False):
+                        rt.error_store.add(
+                            stream_id, "net.feed", e, rt.now_ms(),
+                            events=rows_of_columns(schema, ts, cols,
+                                                   rt.strings))
                     rt.stats.on_fault(stream_id, "net.feed")
 
         return Work(n=int(ts.shape[0]), nbytes=nbytes, feed=feed,

@@ -31,6 +31,15 @@ Endpoints (JSON unless noted):
                                     traffic into the ErrorStore with a
                                     429, or parks it with a 202 under
                                     shed.policy='oldest'
+  POST /siddhi/artifact/snapshot    {"app": ..., "incremental": bool?}
+                                    persist a revision NOW; returns its
+                                    structured descriptor — revision id +
+                                    per-stream durable WAL watermark
+                                    (persistence.Revision.to_dict())
+  GET  /siddhi/artifact/snapshot?siddhiApp=<name>
+                                    durability state: sync policy, last
+                                    revision descriptor, WAL gauges, and
+                                    the last crash-recovery report
   POST /siddhi/artifact/query       {"app": ..., "query": "from T select ..."}
   GET  /siddhi/artifact/stats?siddhiApp=<name>
   GET  /siddhi/artifact/explain?siddhiApp=<name>
@@ -173,6 +182,15 @@ class SiddhiService:
                         code, out = service.send_events(req,
                                                         nbytes=len(body))
                         self._reply(code, out)
+                    elif path == "/siddhi/artifact/snapshot":
+                        req = json.loads(self._body())
+                        app = req.get("app")
+                        if app not in service.runtimes:
+                            self._reply(404, {"error":
+                                              f"no deployed app {app!r}"})
+                        else:
+                            self._reply(200, service.snapshot_action(
+                                app, bool(req.get("incremental"))))
                     elif path == "/siddhi/artifact/query":
                         req = json.loads(self._body())
                         rows = service.store_query(req["app"], req["query"])
@@ -229,6 +247,13 @@ class SiddhiService:
                             # rt.explain() VERBATIM: the test suite holds
                             # this body byte-for-byte equal to it
                             self._reply(200, service.explain(app))
+                    elif u.path == "/siddhi/artifact/snapshot":
+                        app = q.get("siddhiApp", [None])[0]
+                        if app not in service.runtimes:
+                            self._reply(404, {"error":
+                                              f"no deployed app {app!r}"})
+                        else:
+                            self._reply(200, service.snapshot_info(app))
                     elif u.path == "/siddhi/errors":
                         app = q.get("siddhiApp", [None])[0]
                         if (app not in service.runtimes
@@ -334,6 +359,14 @@ class SiddhiService:
                 self.net.retire(old)
             self._park_errors(name, old.error_store)
             old.shutdown()
+        # recover-on-redeploy (docs/RELIABILITY.md): a durable app
+        # restores its newest snapshot and replays the WAL suffix
+        # BEFORE serving — a service restart or same-name redeploy
+        # resumes exactly where the durable log ends, instead of
+        # parking-only.  (The old runtime above shut down first, so
+        # its final barrier landed before this replay scans the log.)
+        if rt.durability != "off":
+            rt.recover()
         rt.start()
         self.runtimes[name] = rt
         return name
@@ -560,6 +593,33 @@ class SiddhiService:
             return {"discarded": discarded, "remaining": remaining}
         raise ValueError(f"unknown errors action {action!r} "
                          f"(replay | discard)")
+
+    def snapshot_action(self, app: str, incremental: bool = False) -> dict:
+        """POST /siddhi/artifact/snapshot: persist a revision NOW and
+        return its structured descriptor (revision id + per-stream
+        durable watermark — persistence.Revision.to_dict())."""
+        rt = self.runtimes[app]
+        return rt.persist(incremental=incremental).to_dict()
+
+    def snapshot_info(self, app: str) -> dict:
+        """GET /siddhi/artifact/snapshot: the durability/recovery state
+        of a deployed app — last revision descriptor (this process OR
+        the store's newest), WAL gauges, and the last recovery report."""
+        rt = self.runtimes[app]
+        desc = rt.last_revision_descriptor
+        store = rt.manager.persistence_store if rt.manager else None
+        out = {
+            "app": app,
+            "durability": rt.durability,
+            "last_revision": desc.to_dict() if desc is not None else None,
+            "store_revision": (store.last_revision(app)
+                               if store is not None else None),
+        }
+        if rt.wal is not None:
+            out["wal"] = rt.wal.metrics()
+        if getattr(rt, "_wal_recovery", None) is not None:
+            out["recovery"] = rt._wal_recovery
+        return out
 
     def tuning(self, app: Optional[str] = None) -> dict:
         """The persisted execution-geometry tuning cache (autotune.py):

@@ -116,6 +116,13 @@ FIXTURES = {
         @info(name='q') from every e1=S[v > 1] -> e2=S[v > e1.v]
         within 1 sec select e1.v as a, e2.v as b insert into Out;
     """,
+    "SA13": """
+        @app:durability('fsync')
+        @source(type='tcp', port='0')
+        define stream S (v double);
+        define stream Out (v double);
+        @info(name='q') from S[v > 0] select v insert into Out;
+    """,
 }
 
 CLEAN = [

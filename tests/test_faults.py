@@ -707,3 +707,114 @@ def test_persist_save_injection_point(tmp_path):
         rt.persist()
     rt.persist()                    # burst exhausted: succeeds
     mgr.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# durability (core/wal.py) injection points: wal.append / wal.fsync /
+# wal.truncate — the three chaos boundaries the kill-9 bench rides
+# ---------------------------------------------------------------------------
+
+DUR_APP = """
+@app:name('W')
+@app:durability('batch', dir='%s')
+define stream S (x int);
+define table T (x int);
+from S select x insert into T;
+"""
+
+
+def _dur_rt(mgr, tmp_path, policy="batch"):
+    app = DUR_APP % str(tmp_path / "wal")
+    if policy != "batch":
+        app = app.replace("'batch'", f"'{policy}'")
+    rt = mgr.create_app_runtime(app)
+    rt.start()
+    return rt
+
+
+def test_wal_append_fault_self_heals_and_rolls_back(mgr, tmp_path):
+    """A fault raised mid-append must leave NO scar: the partial record
+    is truncated away, the failed frame is not claimed durable, and the
+    next append (and a full replay) is clean."""
+    rt = _dur_rt(mgr, tmp_path)
+    rt.fault_injector = FaultInjector(seed=1, counts={"wal.append": 1})
+    with pytest.raises(InjectedFault):
+        rt.send("S", (1,))
+        rt.flush()
+    assert rt.wal.metrics()["appended_frames"] == 0
+    rt.fault_injector = None
+    rt.send("S", (2,))
+    rt.flush()
+    assert rt.wal.metrics()["appended_frames"] == 1
+    got = list(rt.wal.replay())
+    assert len(got) == 1 and got[0][1] == 1      # seq 1, no gap, no scar
+    assert rt.wal.corrupt_skipped == 0
+
+
+def test_wal_append_fault_on_net_feed_captures_whole_frame(mgr, tmp_path):
+    """Over the serving plane the zero-loss invariant must hold through
+    a WAL append fault: the admitted frame lands WHOLE in the
+    ErrorStore (point net.feed), replayable once the log recovers."""
+    import numpy as np
+    from siddhi_tpu.net import TcpFrameClient
+    app = ("@source(type='tcp', port='0')\n" + DUR_APP % str(tmp_path / "w2"))
+    rt = mgr.create_app_runtime(app)
+    rt.start()
+    rt.fault_injector = FaultInjector(seed=1, counts={"wal.append": 1})
+    cli = TcpFrameClient("127.0.0.1", rt.sources[0].port, "S",
+                         TcpFrameClient.cols_of_schema(rt.schemas["S"]))
+    for k in range(3):
+        cli.send_batch({"x": np.array([k], dtype=np.int32)},
+                       np.array([k], dtype=np.int64))
+    cli.barrier(timeout=30)
+    cli.close()
+    stored = rt.error_store.entries("S")
+    # exactly ONE capture: the WAL append path stores the frame and
+    # marks the exception, so the net.feed guard must not double it
+    # (a second entry would double-ingest on replay)
+    assert len(stored) == 1 and stored[0].point == "wal.append"
+    assert rt.wal.metrics()["appended_frames"] == 2     # the other two
+    rt.fault_injector = None
+    rep = rt.error_store.replay(rt)
+    assert rep["remaining"] == 0
+    # replayed frame re-entered ingest -> appended to the WAL after all
+    assert rt.wal.metrics()["appended_frames"] == 3
+    assert sorted(x[0] for x in rt.tables["T"].all_rows()) == [0, 1, 2]
+
+
+def test_wal_fsync_fault_rolls_back_record(mgr, tmp_path):
+    rt = _dur_rt(mgr, tmp_path, policy="fsync")
+    rt.fault_injector = FaultInjector(seed=1, counts={"wal.fsync": 1})
+    with pytest.raises(InjectedFault):
+        rt.send("S", (1,))
+        rt.flush()
+    assert rt.fault_injector.stats()["fired"]["wal.fsync"] == 1
+    rt.fault_injector = None
+    rt.send("S", (2,))
+    rt.flush()
+    m = rt.wal.metrics()
+    assert m["appended_frames"] == 1 and m["fsyncs"] >= 1
+
+
+def test_wal_truncate_fault_keeps_segments_and_snapshot(mgr, tmp_path):
+    """An injected truncation fault must NOT fail the (successful)
+    persist — kept segments are redundant, the next barrier retries."""
+    from siddhi_tpu.core.persistence import FileSystemPersistenceStore
+    mgr.set_persistence_store(
+        FileSystemPersistenceStore(str(tmp_path / "snap")))
+    rt = _dur_rt(mgr, tmp_path)
+    for i in range(3):
+        rt.send("S", (i,))
+        rt.flush()
+    rt.wal.rotate()
+    rt.fault_injector = FaultInjector(seed=1, counts={"wal.truncate": 1})
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        rev = rt.persist()              # snapshot OK, truncation faulted
+    assert rev.watermark == {"S": 3}
+    assert any("barrier incomplete" in str(x.message) for x in w)
+    assert rt.fault_injector.stats()["fired"]["wal.truncate"] == 1
+    assert rt.wal.truncated_segments == 0
+    rt.fault_injector = None
+    rt.persist()                        # retry: segments go this time
+    assert rt.wal.truncated_segments >= 1

@@ -1,0 +1,776 @@
+"""Durability subsystem (core/wal.py + runtime recovery wiring):
+admitted-frame WAL, snapshot-coordinated exactly-once crash recovery,
+the torn-write/corrupt-segment matrix (mirroring the test_persistence
+corruption philosophy), segment truncation behind snapshot barriers,
+durable-ACK over the frame plane, and the structured revision
+descriptor + service snapshot endpoint."""
+import glob
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.persistence import (FileSystemPersistenceStore,
+                                         Revision)
+from siddhi_tpu.core.wal import WriteAheadLog
+
+APP = """
+@app:name('Dur')
+@app:durability('batch')
+define stream S (sym string, p double);
+define table T (sym string, p double);
+@info(name='ins') from S select sym, p insert into T;
+"""
+
+PATTERN = """
+@app:name('DurPat')
+@app:durability('batch')
+define stream S (sym string, p double);
+define table M (s1 string, p2 double);
+@info(name='q') from every e1=S[p > 100] -> e2=S[p > e1.p] within 1 sec
+select e1.sym as s1, e2.p as p2 insert into M;
+"""
+
+
+def frames(n_frames=6, batch=32, seed=3):
+    rng = np.random.default_rng(seed)
+    ts0 = 1_700_000_000_000
+    return [({"sym": np.array([f"K{i}" for i in
+                               rng.integers(0, 4, batch)]),
+              "p": np.round(rng.uniform(90, 130, batch), 2)},
+             ts0 + np.arange(k * batch, (k + 1) * batch,
+                             dtype=np.int64))
+            for k in range(n_frames)]
+
+
+def feed(rt, frs, stream="S"):
+    h = rt.input_handler(stream)
+    for cols, ts in frs:
+        h.send_batch(cols, ts)
+    rt.flush()
+
+
+def table_rows(rt, name):
+    return sorted(map(tuple, rt.tables[name].all_rows()))
+
+
+def crash(mgr, rt):
+    """Simulate SIGKILL: release the log file without the graceful
+    shutdown barrier/close path (no flush-of-builders, no final sync
+    beyond what the policy already did)."""
+    if rt.wal is not None:
+        rt.wal.close()
+    mgr._runtimes.clear()
+
+
+def fresh(tmp_path, app=APP):
+    mgr = SiddhiManager()
+    mgr.set_persistence_store(FileSystemPersistenceStore(str(tmp_path)))
+    rt = mgr.create_app_runtime(app)
+    return mgr, rt
+
+
+# ---------------------------------------------------------------------------
+# exactly-once recovery roundtrips
+# ---------------------------------------------------------------------------
+
+def test_recover_without_snapshot_replays_everything(tmp_path):
+    frs = frames()
+    mgr, rt = fresh(tmp_path)
+    rt.start()
+    feed(rt, frs)
+    want = table_rows(rt, "T")
+    crash(mgr, rt)
+
+    m2, rt2 = fresh(tmp_path)
+    rep = rt2.recover()
+    assert rep["restored_revision"] is None
+    assert rep["replayed_frames"] == len(frs)
+    assert table_rows(rt2, "T") == want
+    m2.shutdown()
+
+
+def test_recover_skips_at_or_below_watermark(tmp_path):
+    """Snapshot mid-stream: recovery must restore + replay ONLY the
+    suffix — zero duplicate rows, zero lost rows."""
+    frs = frames(8)
+    mgr, rt = fresh(tmp_path)
+    rt.start()
+    feed(rt, frs[:5])
+    rev = rt.persist()
+    assert rev.watermark == {"S": 5}
+    feed(rt, frs[5:])
+    want = table_rows(rt, "T")
+    crash(mgr, rt)
+
+    m2, rt2 = fresh(tmp_path)
+    rep = rt2.recover()
+    assert rep["restored_revision"] == str(rev)
+    assert rep["watermark"] == {"S": 5}
+    # the synchronous persist truncated the pre-watermark segments, so
+    # nothing even needed skipping; everything replayed is the suffix
+    assert rep["replayed_frames"] == 3
+    assert table_rows(rt2, "T") == want
+    m2.shutdown()
+
+
+def test_recover_stateful_pattern_exactly_once(tmp_path):
+    """Pattern state (pending instances) rides the snapshot; the WAL
+    suffix re-arms and completes them — matches byte-identical to an
+    uninterrupted run."""
+    frs = frames(8, seed=11)
+    mgr, rt = fresh(tmp_path, PATTERN)
+    rt.start()
+    feed(rt, frs)
+    want = table_rows(rt, "M")
+    assert want                          # the tape produces matches
+    crash(mgr, rt)
+
+    m2, rt2 = fresh(tmp_path, PATTERN)
+    rt2.recover()
+    assert table_rows(rt2, "M") == want
+    m2.shutdown()
+
+    # and with a mid-stream snapshot barrier
+    m3, rt3 = fresh(tmp_path / "b", PATTERN)
+    rt3.start()
+    feed(rt3, frs[:4])
+    rt3.persist()
+    feed(rt3, frs[4:])
+    assert table_rows(rt3, "M") == want
+    crash(m3, rt3)
+    m4, rt4 = fresh(tmp_path / "b", PATTERN)
+    rep = rt4.recover()
+    assert rep["replayed_frames"] == 4 and rep["watermark"] == {"S": 4}
+    assert table_rows(rt4, "M") == want
+    m4.shutdown()
+
+
+def test_double_recovery_is_idempotent(tmp_path):
+    """Recover, crash again WITHOUT new ingest, recover again: the
+    second recovery must not double-apply (fresh snapshotless runs
+    replay the same prefix into fresh state — same rows, not more)."""
+    frs = frames(4)
+    mgr, rt = fresh(tmp_path)
+    rt.start()
+    feed(rt, frs)
+    want = table_rows(rt, "T")
+    crash(mgr, rt)
+    m2, rt2 = fresh(tmp_path)
+    rt2.recover()
+    assert table_rows(rt2, "T") == want
+    crash(m2, rt2)
+    m3, rt3 = fresh(tmp_path)
+    rt3.recover()
+    assert table_rows(rt3, "T") == want
+    m3.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# corruption matrix (mirrors test_persistence's corrupt-skip philosophy)
+# ---------------------------------------------------------------------------
+
+def _wal_dir(tmp_path, app="Dur"):
+    return os.path.join(str(tmp_path), app, "wal")
+
+
+def _segs(tmp_path, app="Dur"):
+    return sorted(glob.glob(os.path.join(_wal_dir(tmp_path, app),
+                                         "wal-*.seg")))
+
+
+def test_torn_tail_truncate_mid_record(tmp_path):
+    """Truncate the newest segment mid-record (a crash mid-append):
+    recovery applies the longest valid prefix and heals the file."""
+    frs = frames(5)
+    mgr, rt = fresh(tmp_path)
+    rt.start()
+    feed(rt, frs)
+    crash(mgr, rt)
+    seg = _segs(tmp_path)[-1]
+    os.truncate(seg, os.path.getsize(seg) - 9)
+
+    m2, rt2 = fresh(tmp_path)
+    rep = rt2.recover()
+    assert rep["replayed_frames"] == 4
+    assert rep["corrupt_skipped"] >= 1
+    assert rt2.wal.metrics()["corrupt_skipped"] >= 1
+    assert rt2.statistics()["durability"]["corrupt_skipped"] >= 1
+    # post-heal ingest + a THIRD recovery sees old prefix + new frames
+    feed(rt2, frames(1, seed=99))
+    crash(m2, rt2)
+    m3, rt3 = fresh(tmp_path)
+    rep3 = rt3.recover()
+    assert rep3["replayed_frames"] == 5 and rep3["corrupt_skipped"] == 0
+    m3.shutdown()
+
+
+def test_bitflip_in_sealed_segment_stops_at_scar(tmp_path):
+    """Flip bytes inside a SEALED (older) segment: replay must stop at
+    the last valid record BEFORE the flip — frames after it (whose
+    pre-state is now unprovable) are dropped and counted, never
+    half-applied."""
+    frs = frames(6)
+    mgr, rt = fresh(tmp_path)
+    rt.start()
+    feed(rt, frs[:4])
+    rt.wal.rotate()                     # seal segment 1 (frames 1-4)
+    feed(rt, frs[4:])                   # segment 2 (frames 5-6)
+    crash(mgr, rt)
+    sealed = _segs(tmp_path)[0]
+    blob = bytearray(open(sealed, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF        # scar mid-segment
+    open(sealed, "wb").write(bytes(blob))
+
+    m2, rt2 = fresh(tmp_path)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        rep = rt2.recover()
+    assert 0 < rep["replayed_frames"] < 4
+    assert rep["corrupt_skipped"] >= 1
+    # the unreachable newer segment was quarantined, not deleted
+    q = glob.glob(os.path.join(_wal_dir(tmp_path), "*.quarantined"))
+    assert q
+    m2.shutdown()
+
+
+def test_deleted_newest_segment_recovers_prefix(tmp_path):
+    frs = frames(6)
+    mgr, rt = fresh(tmp_path)
+    rt.start()
+    feed(rt, frs[:3])
+    rt.wal.rotate()
+    feed(rt, frs[3:])
+    crash(mgr, rt)
+    os.remove(_segs(tmp_path)[-1])
+
+    m2, rt2 = fresh(tmp_path)
+    rep = rt2.recover()
+    assert rep["replayed_frames"] == 3
+    # seqs resume past the lost frames' watermark: new ingest appends
+    # at seq 4, and the next recovery replays prefix + new frame
+    feed(rt2, frames(1, seed=5))
+    assert rt2.wal.seqs["S"] == 4
+    crash(m2, rt2)
+    m3, rt3 = fresh(tmp_path)
+    assert rt3.recover()["replayed_frames"] == 4
+    m3.shutdown()
+
+
+def test_missing_middle_segment_stops_before_gap(tmp_path):
+    frs = frames(9)
+    mgr, rt = fresh(tmp_path)
+    rt.start()
+    for i in range(3):
+        feed(rt, frs[i * 3:(i + 1) * 3])
+        if i < 2:
+            rt.wal.rotate()
+    crash(mgr, rt)
+    segs = _segs(tmp_path)
+    assert len(segs) == 3
+    os.remove(segs[1])                  # the gap
+
+    m2, rt2 = fresh(tmp_path)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        rep = rt2.recover()
+    assert rep["replayed_frames"] == 3  # segment 1 only
+    assert rep["corrupt_skipped"] >= 1
+    m2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# segments, truncation, barriers
+# ---------------------------------------------------------------------------
+
+def test_segment_rotation_and_snapshot_truncation(tmp_path):
+    app = APP.replace("@app:durability('batch')",
+                      "@app:durability('batch', segment.bytes='256')")
+    mgr, rt = fresh(tmp_path, app)
+    rt.start()
+    feed(rt, frames(6))
+    assert len(_segs(tmp_path)) > 1     # tiny segments rotated
+    n_before = len(_segs(tmp_path))
+    rev = rt.persist()                  # barrier: rotate + truncate
+    assert rt.wal.truncated_segments >= n_before - 1
+    # every surviving frame is covered by the snapshot watermark
+    left = _segs(tmp_path)
+    assert len(left) <= 2               # the fresh open segment (+seal)
+    # post-snapshot ingest lands in the new segment and replays alone
+    feed(rt, frames(2, seed=42))
+    want = table_rows(rt, "T")
+    crash(mgr, rt)
+    m2, rt2 = fresh(tmp_path, app)
+    rep = rt2.recover()
+    assert rep["watermark"] == dict(rev.watermark)
+    assert rep["replayed_frames"] == 2
+    assert table_rows(rt2, "T") == want
+    m2.shutdown()
+
+
+def test_async_persist_does_not_truncate(tmp_path):
+    mgr, rt = fresh(tmp_path)
+    rt.start()
+    feed(rt, frames(3))
+    rt.wal.rotate()
+    rev = rt.persist(asynchronous=True)
+    rt.persistor().wait()
+    assert rt.persistor().errors == []
+    assert rt.wal.truncated_segments == 0   # async: suffix must survive
+    assert rev.watermark == {"S": 3}
+    mgr.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# sync policies + durable ACK over the frame plane
+# ---------------------------------------------------------------------------
+
+def test_fsync_policy_syncs_per_append(tmp_path):
+    app = APP.replace("'batch'", "'fsync'")
+    mgr, rt = fresh(tmp_path, app)
+    rt.start()
+    feed(rt, frames(3))
+    m = rt.wal.metrics()
+    assert m["policy"] == "fsync" and m["fsyncs"] >= 3
+    assert m["fsync"]["batches"] == m["fsyncs"]
+    mgr.shutdown()
+
+
+def test_tcp_ack_means_durable(tmp_path):
+    """Frames ACK'd over the wire (client barrier) must be in the log,
+    fsynced, BEFORE the ACK — a crash right after the barrier loses
+    nothing the producer was told is safe."""
+    from siddhi_tpu.net import TcpFrameClient
+    app = ("@source(type='tcp', port='0')\n"
+           + APP.replace("@app:name('Dur')", "@app:name('DurNet')"))
+    mgr = SiddhiManager()
+    mgr.set_persistence_store(FileSystemPersistenceStore(str(tmp_path)))
+    rt = mgr.create_app_runtime(app)
+    rt.start()
+    cli = TcpFrameClient("127.0.0.1", rt.sources[0].port, "S",
+                         TcpFrameClient.cols_of_schema(rt.schemas["S"]))
+    frs = frames(4)
+    for cols, ts in frs:
+        cli.send_batch(cols, ts)
+    cli.barrier(timeout=30)             # PING/ACK: the durability barrier
+    want = table_rows(rt, "T")
+    m = rt.wal.metrics()
+    assert m["appended_frames"] == 4
+    assert m["fsyncs"] >= 1             # the ACK barrier synced 'batch'
+    cli.close()
+    crash(mgr, rt)
+
+    m2 = SiddhiManager()
+    m2.set_persistence_store(FileSystemPersistenceStore(str(tmp_path)))
+    rt2 = m2.create_app_runtime(app)
+    rep = rt2.recover()
+    assert rep["replayed_frames"] == 4
+    assert table_rows(rt2, "T") == want
+    m2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# descriptors, endpoints, disabled-loudly
+# ---------------------------------------------------------------------------
+
+def test_revision_descriptor_is_str_compatible(tmp_path):
+    mgr, rt = fresh(tmp_path)
+    rt.start()
+    feed(rt, frames(2))
+    rev = rt.persist()
+    assert isinstance(rev, Revision) and isinstance(rev, str)
+    store = mgr.persistence_store
+    assert store.last_revision(rt.app.name) == rev      # str compare
+    d = rev.to_dict()
+    assert d["revision"] == str(rev)
+    assert d["watermark"] == {"S": 2}
+    assert d["durability"] == "batch" and d["incremental"] is False
+    assert rt.last_revision_descriptor is rev
+    # durability off -> watermark None, still a Revision
+    m2 = SiddhiManager()
+    m2.set_persistence_store(FileSystemPersistenceStore(str(tmp_path)))
+    rt2 = m2.create_app_runtime(APP.replace("@app:durability('batch')\n",
+                                            ""))
+    rev2 = rt2.persist()
+    assert isinstance(rev2, Revision) and rev2.watermark is None
+    mgr.shutdown()
+    m2.shutdown()
+
+
+def test_service_snapshot_endpoint(tmp_path):
+    import json
+    import urllib.request
+    from siddhi_tpu.service import SiddhiService
+    mgr = SiddhiManager()
+    mgr.set_persistence_store(FileSystemPersistenceStore(str(tmp_path)))
+    svc = SiddhiService(port=0, manager=mgr, net=False).start()
+    base = f"http://127.0.0.1:{svc.port}"
+    try:
+        req = urllib.request.Request(f"{base}/siddhi/artifact/deploy",
+                                     data=APP.encode(), method="POST")
+        urllib.request.urlopen(req).read()
+        svc.send_events({"app": "Dur", "stream": "S",
+                         "data": ["A", 1.0]})
+        req = urllib.request.Request(
+            f"{base}/siddhi/artifact/snapshot",
+            data=json.dumps({"app": "Dur"}).encode(), method="POST")
+        out = json.loads(urllib.request.urlopen(req).read())
+        assert out["watermark"] == {"S": 1}
+        assert out["durability"] == "batch" and out["revision"]
+        info = json.loads(urllib.request.urlopen(
+            f"{base}/siddhi/artifact/snapshot?siddhiApp=Dur").read())
+        assert info["last_revision"]["revision"] == out["revision"]
+        assert info["wal"]["appended_frames"] == 1
+        assert info["store_revision"] == out["revision"]
+    finally:
+        svc.stop()
+
+
+def test_service_redeploy_recovers(tmp_path):
+    """Same-name redeploy on a durable app resumes from the log instead
+    of parking-only: match counts identical to the uninterrupted run."""
+    from siddhi_tpu.service import SiddhiService
+    frs = frames(6, seed=11)
+    # uninterrupted reference
+    mgr, rt = fresh(tmp_path / "ref", PATTERN)
+    rt.start()
+    feed(rt, frs)
+    want = table_rows(rt, "M")
+    mgr.shutdown()
+
+    m2 = SiddhiManager()
+    m2.set_persistence_store(
+        FileSystemPersistenceStore(str(tmp_path / "svc")))
+    svc = SiddhiService(port=0, manager=m2, net=False).start()
+    try:
+        svc.deploy(PATTERN)
+        feed(svc.runtimes["DurPat"], frs[:4])
+        svc.runtimes["DurPat"].persist()
+        feed(svc.runtimes["DurPat"], frs[4:])
+        svc.deploy(PATTERN)             # redeploy: recover, not park
+        rt2 = svc.runtimes["DurPat"]
+        assert rt2._wal_recovery["replayed_frames"] == 2
+        assert table_rows(rt2, "M") == want
+    finally:
+        svc.stop()
+
+
+def test_durability_without_store_disables_loudly():
+    mgr = SiddhiManager()               # no persistence store, no dir
+    env = os.environ.pop("SIDDHI_WAL_DIR", None)
+    try:
+        rt = mgr.create_app_runtime(APP)
+        with pytest.warns(RuntimeWarning, match="DISABLED"):
+            rt.start()
+        assert rt.wal is None
+        d = rt.statistics()["durability"]
+        assert d["policy"] == "batch" and d["enabled"] is False
+        assert "reason" in d
+        ex = rt.explain()["durability"]
+        assert ex["enabled"] is False and "reason" in ex
+    finally:
+        if env is not None:
+            os.environ["SIDDHI_WAL_DIR"] = env
+        mgr.shutdown()
+
+
+def test_replay_feed_failure_captures_to_error_store(tmp_path):
+    """Schema drift across a redeploy: a durable frame that cannot feed
+    the new schema must land whole in the ErrorStore, never vanish."""
+    mgr, rt = fresh(tmp_path)
+    rt.start()
+    feed(rt, frames(2))
+    crash(mgr, rt)
+    # the new schema ADDS a column the logged frames cannot provide
+    APP2 = APP.replace("define stream S (sym string, p double);",
+                       "define stream S (sym string, p double, v int);") \
+              .replace("select sym, p insert into T;",
+                       "select sym, p, v insert into T;") \
+              .replace("define table T (sym string, p double);",
+                       "define table T (sym string, p double, v int);")
+    m2, rt2 = fresh(tmp_path, APP2)
+    rep = rt2.recover()
+    assert rep["failed_frames"] == 2 and rep["replayed_frames"] == 0
+    ents = rt2.error_store.entries("S")
+    assert len(ents) == 2 and ents[0].point == "wal.replay"
+    m2.shutdown()
+
+
+def test_wal_direct_api_roundtrip(tmp_path):
+    """The WAL class on its own: append -> replay identity, watermark
+    filter, metrics shape."""
+    from siddhi_tpu.core.schema import StreamSchema, StringTable
+    from siddhi_tpu.query.ast import Attribute, AttrType
+    schema = StreamSchema("S", (Attribute("sym", AttrType.STRING),
+                                Attribute("p", AttrType.DOUBLE)))
+    strings = StringTable()
+    wal = WriteAheadLog(str(tmp_path / "w"), policy="batch")
+    for i in range(3):
+        cols = {"sym": strings.encode_many(np.array([f"K{i}", "K0"])),
+                "p": np.array([float(i), 0.5])}
+        seq = wal.append("S", np.array([i, i], dtype=np.int64), cols,
+                         strings, schema=schema)
+        assert seq == i + 1
+    wal.barrier()
+    got = list(wal.replay())
+    assert [g[1] for g in got] == [1, 2, 3]
+    stream, seq, ts, cols = got[2]
+    assert stream == "S" and cols["sym"].tolist() == ["K2", "K0"]
+    assert cols["p"].tolist() == [2.0, 0.5]
+    assert wal.watermark() == {"S": 3}
+    wal.close()
+
+
+# ---------------------------------------------------------------------------
+# review-round regressions
+# ---------------------------------------------------------------------------
+
+def test_segments_stay_contiguous_after_scar_heal(tmp_path):
+    """Healing past a mid-log scar must open the fresh segment
+    CONTIGUOUSLY after the kept prefix — a numbering gap would read as
+    corruption on the next open and quarantine (lose) everything
+    appended after the heal."""
+    frs = frames(6)
+    mgr, rt = fresh(tmp_path)
+    rt.start()
+    feed(rt, frs[:3])
+    rt.wal.rotate()                     # seal segment 1
+    feed(rt, frs[3:])                   # segment 2
+    crash(mgr, rt)
+    sealed = _segs(tmp_path)[0]
+    blob = bytearray(open(sealed, "rb").read())
+    blob[10] ^= 0xFF                    # scar the FIRST segment
+    open(sealed, "wb").write(bytes(blob))
+
+    m2, rt2 = fresh(tmp_path)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        rep2 = rt2.recover()            # seg 2 quarantined, prefix empty-ish
+    feed(rt2, frames(2, seed=21))       # post-heal durable ingest
+    post = table_rows(rt2, "T")
+    crash(m2, rt2)
+    # segment numbering on disk must be gap-free
+    nums = [int(os.path.basename(s)[4:-4]) for s in _segs(tmp_path)]
+    assert nums == list(range(nums[0], nums[0] + len(nums))), nums
+    m3, rt3 = fresh(tmp_path)
+    rep3 = rt3.recover()                # post-heal frames MUST survive
+    assert rep3["corrupt_skipped"] == 0
+    assert rep3["replayed_frames"] == rep2["replayed_frames"] + 2
+    assert table_rows(rt3, "T") == post
+    m3.shutdown()
+
+
+def test_start_without_recover_replays_instead_of_truncating(tmp_path):
+    """start() on a durable app with a pre-existing log runs the
+    recovery manager itself: opening without replaying would let the
+    next snapshot's watermark claim unapplied frames and truncate
+    them — silent loss."""
+    frs = frames(4)
+    mgr, rt = fresh(tmp_path)
+    rt.start()
+    feed(rt, frs)
+    want = table_rows(rt, "T")
+    crash(mgr, rt)
+
+    m2, rt2 = fresh(tmp_path)
+    rt2.start()                         # no explicit recover()
+    assert table_rows(rt2, "T") == want
+    rev = rt2.persist()                 # truncation barrier is now safe
+    crash(m2, rt2)
+    m3, rt3 = fresh(tmp_path)
+    rep = rt3.recover()
+    assert rep["watermark"] == dict(rev.watermark)
+    assert table_rows(rt3, "T") == want
+    m3.shutdown()
+
+
+def test_recover_is_idempotent_within_one_runtime(tmp_path):
+    frs = frames(3)
+    mgr, rt = fresh(tmp_path)
+    rt.start()
+    feed(rt, frs)
+    crash(mgr, rt)
+    m2, rt2 = fresh(tmp_path)
+    rep1 = rt2.recover()
+    rep2 = rt2.recover()                # second call: no double replay
+    rt2.start()                         # and start() must not replay
+    assert rep1["replayed_frames"] == 3
+    assert rep2 == rep1
+    assert table_rows(rt2, "T") == table_rows(rt2, "T")
+    assert len(rt2.tables["T"].all_rows()) == 3 * 32
+    m2.shutdown()
+
+
+def test_recover_honors_manual_restore(tmp_path):
+    """A caller that restored a PAST revision explicitly gets the WAL
+    suffix past THAT watermark — recover() must not override their
+    choice with the newest revision."""
+    frs = frames(6)
+    mgr, rt = fresh(tmp_path)
+    rt.start()
+    feed(rt, frs[:2])
+    rev1 = rt.persist(asynchronous=True)    # async: no truncation
+    rt.persistor().wait()
+    feed(rt, frs[2:4])
+    rt.persist(asynchronous=True)
+    rt.persistor().wait()
+    feed(rt, frs[4:])
+    want = table_rows(rt, "T")
+    crash(mgr, rt)
+
+    m2, rt2 = fresh(tmp_path)
+    rt2.restore_revision(str(rev1))         # the OLDER revision
+    rep = rt2.recover()
+    assert rep["restored_revision"] == str(rev1)
+    assert rep["watermark"] == {"S": 2}
+    assert rep["replayed_frames"] == 4      # suffix past revision 1
+    assert table_rows(rt2, "T") == want
+    m2.shutdown()
+
+
+def test_replay_unknown_stream_captures_not_drops(tmp_path):
+    """Durable frames of a stream the redeployed app no longer defines
+    must land in the ErrorStore, not silently count as 'skipped'."""
+    frs = frames(2)
+    mgr, rt = fresh(tmp_path)
+    rt.start()
+    feed(rt, frs)
+    crash(mgr, rt)
+    APP2 = APP.replace("define stream S (sym string, p double);",
+                       "define stream S2 (sym string, p double);") \
+              .replace("from S select", "from S2 select")
+    m2, rt2 = fresh(tmp_path, APP2)
+    rep = rt2.recover()
+    assert rep["failed_frames"] == 2 and rep["skipped_frames"] == 0
+    ents = rt2.error_store.entries("S")
+    assert len(ents) == 2 and ents[0].point == "wal.replay"
+    assert ents[0].events                    # rows preserved whole
+    m2.shutdown()
+
+
+def test_direct_send_append_failure_captures_batch(tmp_path):
+    """Row-path sends buffered before a failing freeze-time append must
+    land in the ErrorStore (the builder was already cleared) — and only
+    ONCE."""
+    from siddhi_tpu.core.faults import FaultInjector, InjectedFault
+    mgr, rt = fresh(tmp_path)
+    rt.start()
+    h = rt.input_handler("S")
+    h.send(("A", 1.0))
+    h.send(("B", 2.0))                  # buffered rows ride the freeze
+    rt.fault_injector = FaultInjector(seed=1, counts={"wal.append": 1})
+    with pytest.raises(InjectedFault):
+        rt.flush()
+    ents = rt.error_store.entries("S")
+    assert len(ents) == 1 and ents[0].point == "wal.append"
+    assert [tuple(r) for _t, r in ents[0].events] == [("A", 1.0),
+                                                      ("B", 2.0)]
+    rt.fault_injector = None
+    rep = rt.error_store.replay(rt)     # replayable: nothing stranded
+    assert rep["remaining"] == 0
+    assert table_rows(rt, "T") == [("A", 1.0), ("B", 2.0)]
+    mgr.shutdown()
+
+
+def test_seq_floor_after_truncation_and_restart(tmp_path):
+    """Snapshot-barrier truncation can empty the log; after a restart
+    the seq counters must resume PAST the restored watermark, or the
+    next recovery's skip would swallow brand-new durable frames."""
+    mgr, rt = fresh(tmp_path)
+    rt.start()
+    feed(rt, frames(3))
+    rev = rt.persist()                  # truncates everything <= {S: 3}
+    crash(mgr, rt)
+
+    m2, rt2 = fresh(tmp_path)
+    rep = rt2.recover()                 # empty log, watermark {S: 3}
+    assert rep["watermark"] == {"S": 3} and rep["replayed_frames"] == 0
+    feed(rt2, frames(2, seed=77))       # new frames must number 4, 5
+    assert rt2.wal.seqs == {"S": 5}
+    want = table_rows(rt2, "T")
+    crash(m2, rt2)
+
+    m3, rt3 = fresh(tmp_path)
+    rep3 = rt3.recover()
+    assert rep3["replayed_frames"] == 2 and rep3["skipped_frames"] == 0
+    assert table_rows(rt3, "T") == want
+    m3.shutdown()
+
+
+def test_shutdown_start_cycle_keeps_logging(tmp_path):
+    """shutdown()+start() in one process must REOPEN the log (state is
+    live, nothing replays) with seq continuity — and the enabled gauge
+    must read 0 only while actually down."""
+    mgr, rt = fresh(tmp_path)
+    rt.start()
+    feed(rt, frames(2))
+    rt.shutdown()
+    assert rt.statistics()["durability"]["enabled"] is False
+    rt.start()                          # reopen, no replay into live state
+    assert rt.wal is not None
+    assert rt.statistics()["durability"]["enabled"] is True
+    assert table_rows(rt, "T") == table_rows(rt, "T")
+    feed(rt, frames(1, seed=31))
+    assert rt.wal.seqs == {"S": 3}      # continuity past generation 1
+    want = table_rows(rt, "T")
+    crash(mgr, rt)
+    m2, rt2 = fresh(tmp_path)
+    rep = rt2.recover()
+    assert rep["replayed_frames"] == 3
+    assert table_rows(rt2, "T") == want
+    m2.shutdown()
+
+
+def test_durable_ack_waits_for_oldest_park(tmp_path):
+    """Under shed.policy='oldest' the ACK must not cover memory-parked
+    frames: the barrier drains the park (token refills) first, so by
+    ACK time every frame is in the log."""
+    from siddhi_tpu.net import TcpFrameClient
+    app = ("@source(type='tcp', port='0', rate.limit='512', "
+           "shed.policy='oldest', max.pending='8 MB')\n"
+           + APP.replace("@app:name('Dur')", "@app:name('DurOld')"))
+    mgr = SiddhiManager()
+    mgr.set_persistence_store(FileSystemPersistenceStore(str(tmp_path)))
+    rt = mgr.create_app_runtime(app)
+    rt.start()
+    cli = TcpFrameClient("127.0.0.1", rt.sources[0].port, "S",
+                         TcpFrameClient.cols_of_schema(rt.schemas["S"]))
+    frs = frames(4, batch=256)          # 1024 events vs a 512 burst:
+    for cols, ts in frs:                # the tail parks
+        cli.send_batch(cols, ts)
+    cli.barrier(timeout=60)             # must wait out the park
+    assert rt.wal.metrics()["appended_frames"] == 4
+    assert rt.admission["S"].pending_count() == 0
+    cli.close()
+    mgr.shutdown()
+
+
+def test_no_truncation_behind_inmemory_store(tmp_path):
+    """A synchronous persist to an IN-MEMORY store must NOT truncate
+    the on-disk log: the revision dies with the process, so the
+    segments it would supersede are the only durable copy."""
+    from siddhi_tpu.core.runtime import InMemoryPersistenceStore
+    mgr = SiddhiManager()
+    mgr.set_persistence_store(InMemoryPersistenceStore())
+    app = APP.replace("@app:durability('batch')",
+                      f"@app:durability('batch', dir='{tmp_path}/w')")
+    rt = mgr.create_app_runtime(app)
+    rt.start()
+    feed(rt, frames(3))
+    rt.wal.rotate()
+    rev = rt.persist()                  # snapshot lives only in memory
+    assert rev.watermark == {"S": 3}
+    assert rt.wal.truncated_segments == 0
+    want = table_rows(rt, "T")
+    crash(mgr, rt)                      # process gone -> snapshot gone
+
+    m2 = SiddhiManager()
+    m2.set_persistence_store(InMemoryPersistenceStore())
+    rt2 = m2.create_app_runtime(app)
+    rep = rt2.recover()                 # full-log replay, nothing lost
+    assert rep["restored_revision"] is None
+    assert rep["replayed_frames"] == 3
+    assert table_rows(rt2, "T") == want
+    m2.shutdown()
