@@ -26,6 +26,16 @@ echo "== static analysis: self-lint =="
 # review rounds keep finding
 python -m siddhi_tpu.analysis --self
 
+echo "== static analysis: concurrency (--threads) =="
+# the concurrency self-analysis gate (docs/ANALYSIS.md "Concurrency
+# self-analysis"): SL03 lockset / inconsistent guard, SL04 lock-order
+# inversion, SL05 blocking-call-under-lock, SL06 thread lifecycle over
+# the engine's own source.  The baseline pins the justified-suppression
+# inventory — a new `# lint: allow (...)` anywhere fails CI until the
+# baseline is regenerated in the same commit (--write-baseline)
+python -m siddhi_tpu.analysis --threads \
+    --baseline scripts/threads_baseline.json
+
 echo "== static analysis: samples corpus =="
 # the analyzer over every samples/*.py app string: expected findings are
 # PINNED (all info-severity conveniences in the samples); any new rule
@@ -38,6 +48,22 @@ python -m siddhi_tpu.analysis --expect SA07,SA07,SA07,SA07,SA12,SA13,SA13 \
 echo "== tier-1 tests =="
 python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider
+
+echo "== lock-witness vs static graph =="
+# run a fast serving-plane tier-1 subset with every engine lock
+# witness-instrumented (utils/locks.py, SIDDHI_LOCK_CHECK=1): the
+# ACTUAL acquisition orders the tests exhibit are recorded, then
+# cross-checked against the static lock graph.  Any witnessed order
+# the model contradicts or does not know fails CI — the SL04 deadlock
+# verdicts are only as good as this agreement.  (A dynamic inversion
+# additionally raises LockOrderError inside the test run itself.)
+WITNESS_OUT="$(mktemp -u /tmp/siddhi_lock_witness.XXXXXX.json)"
+SIDDHI_LOCK_CHECK=1 SIDDHI_LOCK_WITNESS_OUT="$WITNESS_OUT" \
+    python -m pytest tests/test_net_admission.py tests/test_net_server.py \
+    tests/test_wal.py tests/test_service.py -q -m 'not slow' \
+    -p no:cacheprovider
+python -m siddhi_tpu.analysis --threads --witness "$WITNESS_OUT"
+rm -f "$WITNESS_OUT"
 
 echo "== service /metrics smoke =="
 python - <<'EOF'

@@ -11,10 +11,17 @@ Three cooperating parts:
     ``rt.explain()`` / ``GET /siddhi/artifact/explain`` / the CLI;
   * ``selflint`` — an AST checker over siddhi_tpu's OWN source (SL01
     silent-demotion swallows, SL02 unguarded shared counters), the
-    ``--self`` CI gate in scripts/smoke.sh.
+    ``--self`` CI gate in scripts/smoke.sh;
+  * ``concurrency`` — whole-package concurrency self-analysis (SL03
+    lockset, SL04 lock-order inversion, SL05 blocking-under-lock, SL06
+    thread lifecycle), the ``--threads`` CI gate, validated against the
+    runtime lock-witness (``utils/locks.py``, ``SIDDHI_LOCK_CHECK=1``).
 """
 from __future__ import annotations
 
+from .concurrency import (analyze_package as analyze_threads,  # noqa: F401
+                          check_witness, lint_threads_source,
+                          static_lock_graph)
 from .rules import RULES, SEVERITIES, Finding, analyze_app  # noqa: F401
 from .selflint import lint_package, lint_source             # noqa: F401
 

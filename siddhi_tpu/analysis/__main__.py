@@ -2,6 +2,7 @@
 
     python -m siddhi_tpu.analysis [options] <file> [<file> ...]
     python -m siddhi_tpu.analysis --self
+    python -m siddhi_tpu.analysis --threads [options] [<file.py> ...]
 
 Inputs: a SiddhiQL app file (.siddhi or any text file), ``-`` for
 stdin, or a .py file — every module-level string constant containing
@@ -20,6 +21,21 @@ Options:
   --self          lint siddhi_tpu's own source instead (SL01 silent
                   demotions, SL02 unguarded shared counters); any
                   finding exits non-zero — this is the CI gate
+  --threads       concurrency self-analysis (SL03 lockset, SL04
+                  lock-order inversion, SL05 blocking-under-lock, SL06
+                  thread lifecycle — docs/ANALYSIS.md): over the
+                  siddhi_tpu package with no files, or over the given
+                  .py files (the seeded-corpus mode; --expect works).
+                  Sub-options, package mode only:
+                    --witness PATH         cross-check a runtime
+                                           lock-witness dump (see
+                                           utils/locks.py) against the
+                                           static lock graph
+                    --baseline PATH        pin the justified-suppression
+                                           inventory; any drift fails
+                    --write-baseline PATH  regenerate the baseline pin
+                                           (use in the same commit that
+                                           adds a justified suppression)
 
 Exit status: 0 clean (or --expect matched), 1 findings at error
 severity (warn too under --strict), 2 usage/input errors.
@@ -102,24 +118,125 @@ def _render_text(entry: dict) -> str:
     return "\n".join(lines)
 
 
+def _opt_value(argv: list, flag: str):
+    """Extract `--flag VALUE` from argv; returns VALUE or None, or
+    raises SystemExit-ish usage (handled by caller as 2)."""
+    if flag not in argv:
+        return None
+    i = argv.index(flag)
+    try:
+        value = argv[i + 1]
+    except IndexError:
+        raise ValueError(f"{flag} needs a value")
+    del argv[i:i + 2]
+    return value
+
+
+def _threads_main(argv: list, as_json: bool, expect,
+                  witness_path, baseline_path, write_baseline) -> int:
+    """The --threads mode (docs/ANALYSIS.md "Concurrency
+    self-analysis").  Package mode with no files; seeded-corpus mode
+    over explicit .py files."""
+    from .concurrency import (analyze_package, analyze_sources,
+                              check_baseline, check_witness,
+                              suppression_inventory)
+    if write_baseline is not None:
+        inv = suppression_inventory()
+        with open(write_baseline, "w", encoding="utf-8") as f:
+            json.dump(inv, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"baseline: {sum(inv.values())} suppression(s) over "
+              f"{len(inv)} file(s) -> {write_baseline}")
+        return 0
+    if argv:
+        sources = []
+        for path in argv:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    sources.append((path, f.read()))
+            except OSError as e:
+                print(f"cannot read {path}: {e}", file=sys.stderr)
+                return 2
+        result = analyze_sources(sources)
+    else:
+        result = analyze_package()
+    findings = list(result["findings"])
+    if witness_path is not None:
+        try:
+            with open(witness_path, encoding="utf-8") as f:
+                witness = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"cannot read witness {witness_path}: {e}",
+                  file=sys.stderr)
+            return 2
+        findings += check_witness(witness, result["graph"])
+    if baseline_path is not None:
+        try:
+            findings += check_baseline(baseline_path)
+        except (OSError, ValueError) as e:
+            print(f"cannot read baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+    g = result["graph"]
+    if as_json:
+        print(json.dumps({
+            "threads": [f.to_dict() for f in findings],
+            "findings": len(findings),
+            "suppressions": [list(s) for s in result["suppressions"]],
+            "graph": {"nodes": sorted(g["nodes"]),
+                      "edges": sorted(
+                          [a, b, f"{s[0]}:{s[1]}"]
+                          for (a, b), s in g["edges"].items())}},
+            indent=1))
+    else:
+        for f in findings:
+            print(f)
+        print(f"threads: {len(findings)} finding(s), "
+              f"{len(result['suppressions'])} suppressed site(s), "
+              f"{len(g['nodes'])} lock(s), {len(g['edges'])} order "
+              f"edge(s)")
+    if expect is not None:
+        got = sorted(f.rule_id for f in findings)
+        if got != expect:
+            print(f"--expect mismatch: wanted {expect}, got {got}",
+                  file=sys.stderr)
+            return 1
+        return 0
+    return 1 if findings else 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     as_json = "--json" in argv
     explain = "--explain" in argv
     strict = "--strict" in argv
     self_lint = "--self" in argv
+    threads = "--threads" in argv
     expect = None
-    for flag in ("--json", "--explain", "--strict", "--self"):
+    for flag in ("--json", "--explain", "--strict", "--self", "--threads"):
         while flag in argv:
             argv.remove(flag)
-    if "--expect" in argv:
-        i = argv.index("--expect")
-        try:
-            expect = sorted(x for x in argv[i + 1].split(",") if x)
-        except IndexError:
-            print("--expect needs a rule-id list", file=sys.stderr)
-            return 2
-        del argv[i:i + 2]
+    try:
+        witness_path = _opt_value(argv, "--witness")
+        baseline_path = _opt_value(argv, "--baseline")
+        write_baseline = _opt_value(argv, "--write-baseline")
+        expect_raw = _opt_value(argv, "--expect")
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if expect_raw is not None:
+        expect = sorted(x for x in expect_raw.split(",") if x)
+
+    if not threads and (witness_path or baseline_path or write_baseline):
+        # silently ignoring a gate flag would leave CI weaker than the
+        # author believes — misuse is a usage error, never a pass
+        print("--witness/--baseline/--write-baseline require --threads",
+              file=sys.stderr)
+        return 2
+
+    if threads:
+        return _threads_main(argv, as_json, expect, witness_path,
+                             baseline_path, write_baseline)
 
     if self_lint:
         findings = lint_package()

@@ -1,0 +1,1119 @@
+"""Whole-package concurrency self-analysis (SL03–SL06).
+
+`python -m siddhi_tpu.analysis --threads` runs four rule groups over
+the engine's own source — the serving plane is a deeply threaded
+system, and every review round before this analyzer existed found
+lock-discipline bugs by hand:
+
+  SL03  lockset / inconsistent guard — per-class inventory of lock
+        attributes, then Eraser-style dominant-lock inference for every
+        shared mutable attribute (reads, plain/aug assignment,
+        container mutation — generalizing SL02 beyond ``+=``): an
+        attribute guarded by a lock at most sites but accessed outside
+        it at others is a data race until someone writes down why not.
+  SL04  lock-order inversion — a lock-acquisition graph extracted from
+        nested ``with <lock>:`` scopes and composed through per-method
+        call summaries; cycles are potential deadlocks.
+  SL05  blocking call under a lock — socket send/recv/accept/connect,
+        ``os.fsync``, ``time.sleep``, thread/queue joins and waits,
+        subprocess, and HTTP calls reachable (directly or through the
+        call summary) while a named lock is held.
+  SL06  thread lifecycle — spawned threads that are neither daemonized
+        nor join-tracked, threads without a ``siddhi-<role>`` name, and
+        ``Condition.wait`` outside a predicate loop.
+  SL07  a ``lint: allow`` annotation with no justification — the
+        why is mandatory; a bare pragma suppresses nothing.
+
+Every rule honors ``# lint: allow (<why>)`` on the flagged line (or
+the line above); SL03 additionally honors the legacy
+``# lint: unlocked-ok (<why>)`` so a site never needs two pragmas.
+
+The analysis is deliberately heuristic and lexical — it resolves
+receivers by constructor-assignment attribute typing and
+unique-method-name fallback, not real type inference — which is why it
+is paired with the runtime *lock-witness* (`siddhi_tpu/utils/locks.py`):
+under ``SIDDHI_LOCK_CHECK=1`` every engine lock records the actual
+acquisition orders, and ``--threads --witness <dump.json>`` fails if
+reality exhibits an order the static graph contradicts or simply does
+not know.  The model is validated against the engine, not trusted.
+
+See docs/ANALYSIS.md "Concurrency self-analysis" for the rule catalog,
+annotation grammar, and triage runbook.
+"""
+from __future__ import annotations
+
+import ast as pyast
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .rules import Finding
+from .walker import (MUTATING_METHODS, call_name, class_lock_attrs,
+                     comment_map, iter_package, justified_pragma,
+                     lock_call_kind, pragma_re, self_attr)
+
+ALLOW = "lint: allow"
+ALLOW_LEGACY = "lint: unlocked-ok"      # SL02's pragma; SL03 honors it
+ALLOW_SWALLOW = "lint: allow-swallow"   # SL01's pragma (inventory only)
+
+# SL03 dominant-lock inference: the candidate lock must guard at least
+# MIN_GUARDED accesses and at least DOMINANCE of the eligible ones
+MIN_GUARDED = 2
+DOMINANCE = 0.6
+
+_SOCKET_METHODS = {"sendall", "send", "recv", "recvfrom", "recv_into",
+                   "accept", "connect", "sendto"}
+_SOCKETISH = re.compile(r"sock|conn$|_ws$", re.I)
+_THREADISH = re.compile(r"thread|worker|proc|child|ring|persistor", re.I)
+_QUEUEISH = re.compile(r"(^|_)q(ueue)?\d*$", re.I)
+
+
+# ---------------------------------------------------------------------------
+# model
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Access:
+    attr: str
+    lineno: int
+    kind: str                   # "read" | "write"
+    held: frozenset             # lock node names held at the access
+    method: str
+    suppressed: bool = False
+
+
+@dataclass
+class CallSite:
+    name: str                   # method/function name
+    recv: Optional[str]         # "self" | resolved class name | None
+    lineno: int
+    held: tuple                 # lock node names held, outermost first
+    suppressed: bool = False
+
+
+@dataclass
+class MethodInfo:
+    cls: Optional[str]          # class NAME (for messages)
+    name: str                   # qualified within the class (a.b for nested)
+    cls_id: Optional[str] = None    # "relpath::Class" (for resolution)
+    relpath: str = ""
+    acquires: dict = field(default_factory=dict)    # node -> first lineno
+    edges: list = field(default_factory=list)       # (outer, inner, lineno)
+    calls: list = field(default_factory=list)       # [CallSite]
+    blocking: list = field(default_factory=list)    # [(line, what, supp, held)]
+    accesses: list = field(default_factory=list)    # [Access]
+    returns_lock: Optional[str] = None
+    exempt: bool = False        # __init__ / *_locked naming convention
+    thread_join: bool = False   # joins a thread somewhere (SL06)
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    relpath: str
+    locks: dict = field(default_factory=dict)       # attr -> (kind, node)
+    methods: dict = field(default_factory=dict)     # qualname -> MethodInfo
+    has_join: bool = False      # joins threads somewhere (SL06)
+
+
+class PackageModel:
+    def __init__(self):
+        # classes are keyed by "relpath::name" — two modules may define
+        # same-named classes (the engine already has two `Query`s), and
+        # merging them would attribute accesses to the wrong file and
+        # dilute/invent SL03 dominance.  Name-based resolution goes
+        # through by_name and stays conservative on ambiguity.
+        self.classes: dict = {}         # "relpath::Class" -> ClassInfo
+        self.by_name: dict = {}         # class name -> [class ids]
+        self.attr_lock_nodes: dict = {} # lock attr name -> set(node names)
+        self.attr_types: dict = {}      # attr name -> set(class ids)
+        self.method_owner: dict = {}    # method name -> set(class ids)
+        self.module_locks: dict = {}    # module-level const name -> node
+        self.modfuncs: dict = {}        # "mod:fn" -> MethodInfo
+        self.thread_spawns: list = []   # (relpath, lineno, info dict)
+        self.cond_waits: list = []      # (relpath, lineno, in_while, supp)
+
+    def add_class(self, relpath: str, ci: "ClassInfo") -> str:
+        cid = f"{relpath}::{ci.name}"
+        self.classes[cid] = ci
+        self.by_name.setdefault(ci.name, []).append(cid)
+        return cid
+
+    def class_id_for_name(self, name: str) -> Optional[str]:
+        ids = self.by_name.get(name)
+        return ids[0] if ids and len(ids) == 1 else None
+
+    def lock_node_for_attr(self, attr: str) -> Optional[str]:
+        nodes = self.attr_lock_nodes.get(attr)
+        if nodes and len(nodes) == 1:
+            return next(iter(nodes))
+        return None
+
+    def all_methods(self):
+        for ci in self.classes.values():
+            yield from ci.methods.values()
+        yield from self.modfuncs.values()
+
+
+def _err(rule: str, message: str, subject: str) -> Finding:
+    return Finding(rule, "error", message, subject)
+
+
+# ---------------------------------------------------------------------------
+# pass A: inventory
+# ---------------------------------------------------------------------------
+
+def _mod_base(relpath: str) -> str:
+    return relpath.rsplit("/", 1)[-1][:-3]
+
+
+def _inventory(files: list, model: PackageModel) -> list:
+    """files: [(relpath, tree, comments)].  Fills classes/locks/types/
+    owners; returns the same list."""
+    for relpath, tree, _comments in files:
+        base = _mod_base(relpath)
+        for node in tree.body:
+            if isinstance(node, pyast.Assign) and \
+                    (got := lock_call_kind(node.value)) is not None:
+                for tgt in node.targets:
+                    if isinstance(tgt, pyast.Name):
+                        model.module_locks[tgt.id] = \
+                            got[1] or f"{base}.{tgt.id}"
+        for cls in [n for n in pyast.walk(tree)
+                    if isinstance(n, pyast.ClassDef)]:
+            ci = ClassInfo(cls.name, relpath)
+            for attr, (kind, explicit) in class_lock_attrs(cls).items():
+                node_name = explicit or f"{cls.name}.{attr}"
+                ci.locks[attr] = (kind, node_name)
+                model.attr_lock_nodes.setdefault(attr, set()).add(node_name)
+            cid = model.add_class(relpath, ci)
+            for stmt in cls.body:
+                if isinstance(stmt, (pyast.FunctionDef,
+                                     pyast.AsyncFunctionDef)):
+                    model.method_owner.setdefault(stmt.name,
+                                                  set()).add(cid)
+        # non-self lock-attr assignments (rt._net_gate = new_rlock(...))
+        for n in pyast.walk(tree):
+            if not isinstance(n, pyast.Assign):
+                continue
+            got = lock_call_kind(n.value)
+            if got is None:
+                continue
+            for tgt in n.targets:
+                if isinstance(tgt, pyast.Attribute) and \
+                        self_attr(tgt) is None:
+                    model.attr_lock_nodes.setdefault(
+                        tgt.attr, set()).add(got[1] or tgt.attr)
+    # attribute typing: self.X = ClassName(...) (two passes so an
+    # attr-to-attr alias like `rt._store = rt.error_store` resolves)
+    for _ in range(2):
+        for relpath, tree, _comments in files:
+            for n in pyast.walk(tree):
+                if not isinstance(n, pyast.Assign):
+                    continue
+                t = _expr_type(n.value, model)
+                if t is None:
+                    continue
+                for tgt in n.targets:
+                    if isinstance(tgt, pyast.Attribute):
+                        model.attr_types.setdefault(tgt.attr, set()).add(t)
+    return files
+
+
+def _expr_type(value, model: PackageModel) -> Optional[str]:
+    """Best-effort class ID for an assigned expression (None when the
+    constructor name is ambiguous across modules)."""
+    if isinstance(value, pyast.Call):
+        name = call_name(value)
+        if name is not None:
+            return model.class_id_for_name(name)
+    if isinstance(value, pyast.Attribute):
+        types = model.attr_types.get(value.attr)
+        if types and len(types) == 1:
+            return next(iter(types))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# pass B: per-function walk
+# ---------------------------------------------------------------------------
+
+# names too generic for the unique-method-name call-resolution fallback
+_GENERIC = {
+    "append", "add", "get", "pop", "update", "clear", "remove", "extend",
+    "insert", "sort", "write", "read", "close", "flush", "send", "recv",
+    "join", "wait", "put", "keys", "items", "values", "count", "index",
+    "copy", "setdefault", "discard", "open", "next", "encode", "decode",
+    "name", "release", "acquire", "dump", "dumps", "load", "loads",
+}
+
+
+class _FnWalker:
+    """One function/method body: tracks the held-lock stack, local lock
+    bindings, attribute accesses, calls, and blocking primitives."""
+
+    def __init__(self, model: PackageModel, cls: Optional[ClassInfo],
+                 info: MethodInfo, comments: dict,
+                 bindings: Optional[dict] = None):
+        self.model = model
+        self.cls = cls
+        self.info = info
+        self.comments = comments        # lineno -> comment token text
+        self.held: list = []            # lock node names, outer first
+        self.bindings = dict(bindings or {})
+        self.while_depth = 0
+
+    # -- resolution ---------------------------------------------------------
+
+    def _suppressed(self, lineno: int, legacy: bool = False) -> bool:
+        if justified_pragma(self.comments, lineno, ALLOW):
+            return True
+        return legacy and justified_pragma(self.comments, lineno,
+                                           ALLOW_LEGACY)
+
+    def resolve_lock(self, e) -> Optional[str]:
+        """Lock node name for an expression, or None."""
+        if isinstance(e, pyast.Name):
+            return self.bindings.get(e.id) or \
+                self.model.module_locks.get(e.id)
+        attr = self_attr(e)
+        if attr is not None and self.cls is not None and \
+                attr in self.cls.locks:
+            return self.cls.locks[attr][1]
+        if isinstance(e, pyast.Attribute):
+            return self.model.lock_node_for_attr(e.attr)
+        if isinstance(e, pyast.Call):
+            name = call_name(e)
+            if name == "getattr" and len(e.args) >= 2 and \
+                    isinstance(e.args[1], pyast.Constant):
+                return self.model.lock_node_for_attr(str(e.args[1].value))
+            got = lock_call_kind(e)
+            if got is not None and got[1]:
+                return got[1]
+            # own-method call with a known returns-lock summary
+            if self_attr(e.func) is not None and self.cls is not None:
+                m = self.cls.methods.get(e.func.attr)
+                if m is not None:
+                    return m.returns_lock
+        return None
+
+    def resolve_recv(self, func) -> Optional[str]:
+        """Receiver class ID for a method call, or "self", or None."""
+        if not isinstance(func, pyast.Attribute):
+            return None
+        v = func.value
+        if isinstance(v, pyast.Name):
+            if v.id == "self":
+                return "self"
+            t = self.bindings.get("type:" + v.id)
+            if t:
+                return t
+        if isinstance(v, pyast.Attribute):
+            types = self.model.attr_types.get(v.attr)
+            if types and len(types) == 1:
+                return next(iter(types))
+        # unique-method-name fallback (non-generic names only)
+        name = func.attr
+        if name not in _GENERIC:
+            owners = self.model.method_owner.get(name)
+            if owners and len(owners) == 1:
+                return next(iter(owners))
+        return None
+
+    # -- blocking classification --------------------------------------------
+
+    def blocking_what(self, call: pyast.Call) -> Optional[str]:
+        f = call.func
+        if not isinstance(f, pyast.Attribute):
+            if isinstance(f, pyast.Name) and f.id == "urlopen":
+                return "urllib urlopen"
+            return None
+        recv = f.value
+        recv_txt = recv.attr if isinstance(recv, pyast.Attribute) else \
+            recv.id if isinstance(recv, pyast.Name) else ""
+        m = f.attr
+        if m in _SOCKET_METHODS and _SOCKETISH.search(recv_txt):
+            return f"socket .{m}()"
+        if m == "create_connection" and recv_txt == "socket":
+            return "socket connect"
+        if m == "sleep" and recv_txt == "time":
+            return "time.sleep"
+        if m == "fsync" and recv_txt == "os":
+            return "os.fsync"
+        if m == "wait":
+            return f"{recv_txt or '<obj>'}.wait()"
+        if m == "join" and (
+                any(k.arg == "timeout" for k in call.keywords)
+                or _THREADISH.search(recv_txt)):
+            return f"{recv_txt or '<obj>'}.join()"
+        if m in ("get", "put") and _QUEUEISH.search(recv_txt):
+            return f"queue .{m}()"
+        if recv_txt == "subprocess" or (
+                m in ("communicate", "check_output", "check_call")):
+            return f"subprocess {m}"
+        if m == "urlopen":
+            return "urllib urlopen"
+        return None
+
+    # -- the walk -----------------------------------------------------------
+
+    def walk(self, stmts) -> None:
+        for s in stmts:
+            self.stmt(s)
+
+    def stmt(self, node) -> None:
+        if isinstance(node, pyast.With):
+            self.handle_with(node)
+            return
+        if isinstance(node, (pyast.FunctionDef, pyast.AsyncFunctionDef)):
+            # a nested function runs LATER, not under the locks held at
+            # its definition: fresh held stack, inherited bindings
+            self.handle_nested(node)
+            return
+        if isinstance(node, pyast.ClassDef):
+            return                      # nested classes: out of scope
+        if isinstance(node, pyast.Assign):
+            self.handle_assign(node)
+            return
+        if isinstance(node, pyast.AugAssign):
+            tgt = self_attr(node.target)
+            if tgt is not None:
+                self.record_access(tgt, node.lineno, "write")
+            self.expr(node.value)
+            return
+        if isinstance(node, pyast.Return):
+            if node.value is not None:
+                lk = self.resolve_lock(node.value)
+                if lk is not None and self.info.returns_lock is None:
+                    self.info.returns_lock = lk
+                self.expr(node.value)
+            return
+        if isinstance(node, pyast.While):
+            self.expr(node.test)
+            self.while_depth += 1
+            self.walk(node.body)
+            self.walk(node.orelse)
+            self.while_depth -= 1
+            return
+        if isinstance(node, pyast.Delete):
+            for t in node.targets:
+                base = t.value if isinstance(t, pyast.Subscript) else t
+                attr = self_attr(base)
+                if attr is not None:
+                    self.record_access(attr, node.lineno, "write")
+            return
+        # generic: visit expressions, recurse into bodies
+        for fname, value in pyast.iter_fields(node):
+            if isinstance(value, pyast.expr):
+                self.expr(value)
+            elif isinstance(value, list):
+                if value and isinstance(value[0], pyast.stmt):
+                    self.walk(value)
+                elif value and isinstance(value[0], pyast.expr):
+                    for v in value:
+                        self.expr(v)
+                elif value and isinstance(value[0], pyast.excepthandler):
+                    for h in value:
+                        self.walk(h.body)
+
+    def handle_with(self, node: pyast.With) -> None:
+        acquired = []
+        for item in node.items:
+            self.expr(item.context_expr, as_with=True)
+            lk = self.resolve_lock(item.context_expr)
+            if lk is not None:
+                # edges are FACTS: a suppression only silences the SL04
+                # finding, never the graph (the runtime lock-witness is
+                # checked against the full graph)
+                supp = self._suppressed(node.lineno)
+                for outer in self.held:
+                    if outer != lk:
+                        self.info.edges.append((outer, lk, node.lineno,
+                                                supp))
+                self.info.acquires.setdefault(lk, node.lineno)
+                self.held.append(lk)
+                acquired.append(lk)
+            if item.optional_vars is not None and lk is not None and \
+                    isinstance(item.optional_vars, pyast.Name):
+                self.bindings[item.optional_vars.id] = lk
+        self.walk(node.body)
+        for _ in acquired:
+            self.held.pop()
+
+    def handle_nested(self, node) -> None:
+        qual = f"{self.info.name}.{node.name}"
+        sub = MethodInfo(self.info.cls, qual, cls_id=self.info.cls_id,
+                         relpath=self.info.relpath, exempt=self.info.exempt)
+        w = _FnWalker(self.model, self.cls, sub, self.comments,
+                      self.bindings)
+        w.walk(node.body)
+        if self.cls is not None:
+            self.cls.methods[qual] = sub
+        else:
+            self.model.modfuncs[f"{self.info.relpath}:{qual}"] = sub
+
+    def handle_assign(self, node: pyast.Assign) -> None:
+        lk = self.resolve_lock(node.value)
+        t = _expr_type(node.value, self.model)
+        for tgt in node.targets:
+            if isinstance(tgt, pyast.Name):
+                if lk is not None:
+                    self.bindings[tgt.id] = lk
+                if t is not None:
+                    self.bindings["type:" + tgt.id] = t
+            attr = self_attr(tgt)
+            if attr is not None:
+                self.record_access(attr, node.lineno, "write")
+            elif isinstance(tgt, pyast.Subscript):
+                battr = self_attr(tgt.value)
+                if battr is not None:
+                    self.record_access(battr, node.lineno, "write")
+                else:
+                    self.expr(tgt.value)
+        self.expr(node.value)
+
+    def record_access(self, attr: str, lineno: int, kind: str) -> None:
+        if self.cls is None or attr in self.cls.locks:
+            return
+        self.info.accesses.append(Access(
+            attr, lineno, kind,
+            frozenset(self.held), self.info.name,
+            suppressed=self._suppressed(lineno, legacy=True)))
+
+    def expr(self, node, as_with: bool = False) -> None:
+        if node is None:
+            return
+        if isinstance(node, pyast.Call):
+            self.handle_call(node)
+            return
+        if isinstance(node, pyast.Lambda):
+            sub = MethodInfo(self.info.cls,
+                             f"{self.info.name}.<lambda>",
+                             cls_id=self.info.cls_id,
+                             relpath=self.info.relpath,
+                             exempt=self.info.exempt)
+            w = _FnWalker(self.model, self.cls, sub, self.comments,
+                          self.bindings)
+            w.expr(node.body)
+            if self.cls is not None:
+                self.cls.methods.setdefault(sub.name, sub)
+            return
+        attr = self_attr(node)
+        if attr is not None and isinstance(node.ctx, pyast.Load) \
+                and not as_with:
+            self.record_access(attr, node.lineno, "read")
+            return
+        for child in pyast.iter_child_nodes(node):
+            if isinstance(child, pyast.expr):
+                self.expr(child)
+            elif isinstance(child, pyast.comprehension):
+                self.expr(child.iter)
+                for c in child.ifs:
+                    self.expr(c)
+
+    def handle_call(self, call: pyast.Call) -> None:
+        f = call.func
+        name = call_name(call)
+        supp = self._suppressed(call.lineno)
+        # thread spawn (SL06)
+        if name == "Thread":
+            self.model.thread_spawns.append(
+                (self.info.relpath, call.lineno, self._thread_info(call),
+                 self.info.cls_id, supp))
+        # Condition.wait predicate-loop check (SL06).  A wait on an
+        # owned Condition RELEASES that lock while parked — the correct
+        # idiom, not an SL05 blocking-under-lock
+        is_cond_wait = False
+        if isinstance(f, pyast.Attribute) and f.attr == "wait":
+            cattr = self_attr(f.value)
+            if cattr is not None and self.cls is not None and \
+                    self.cls.locks.get(cattr, ("", ""))[0] == "condition":
+                is_cond_wait = True
+                self.model.cond_waits.append(
+                    (self.info.relpath, call.lineno,
+                     self.while_depth > 0, supp))
+        # blocking primitive (SL05, direct)
+        what = None if is_cond_wait else self.blocking_what(call)
+        if what is not None:
+            self.info.blocking.append((call.lineno, what, supp,
+                                       tuple(self.held)))
+            if what.endswith(".join()"):
+                self.info.thread_join = True
+        # container mutation through a method (SL03 write); the
+        # receiver of a NON-mutating method call is still a read
+        if isinstance(f, pyast.Attribute):
+            battr = self_attr(f.value)
+            if battr is not None:
+                self.record_access(
+                    battr, call.lineno,
+                    "write" if f.attr in MUTATING_METHODS else "read")
+        # call-site summary (SL04/SL05 composition)
+        if isinstance(f, pyast.Attribute):
+            self.info.calls.append(CallSite(
+                f.attr, self.resolve_recv(f), call.lineno,
+                tuple(self.held), supp))
+            if self_attr(f.value) is None and \
+                    not (isinstance(f.value, pyast.Name)
+                         and f.value.id == "self"):
+                self.expr(f.value)
+        elif isinstance(f, pyast.Name):
+            self.info.calls.append(CallSite(
+                f.id, None, call.lineno, tuple(self.held), supp))
+        else:
+            self.expr(f)
+        for a in call.args:
+            self.expr(a)
+        for k in call.keywords:
+            self.expr(k.value)
+
+    @staticmethod
+    def _thread_info(call: pyast.Call) -> dict:
+        kw = {k.arg: k.value for k in call.keywords}
+        daemon = isinstance(kw.get("daemon"), pyast.Constant) and \
+            kw["daemon"].value is True
+        name_kw = kw.get("name")
+        if name_kw is None:
+            tname = None
+        elif isinstance(name_kw, pyast.Constant):
+            tname = str(name_kw.value)
+        else:
+            tname = "<dynamic>"
+        return {"daemon": daemon, "name": tname}
+
+
+def _walk_files(files: list, model: PackageModel) -> None:
+    """Pass B: walk every method twice — the first round computes
+    returns-lock summaries, the second resolves bindings made through
+    them (e.g. ``gate = self._gate_of(rt)``)."""
+    for _round in (1, 2):
+        model.thread_spawns.clear()
+        model.cond_waits.clear()
+        for relpath, tree, comments in files:
+            for cls_node in [n for n in pyast.walk(tree)
+                             if isinstance(n, pyast.ClassDef)]:
+                cid = f"{relpath}::{cls_node.name}"
+                ci = model.classes[cid]
+                for stmt in cls_node.body:
+                    if not isinstance(stmt, (pyast.FunctionDef,
+                                             pyast.AsyncFunctionDef)):
+                        continue
+                    prev = ci.methods.get(stmt.name)
+                    info = MethodInfo(
+                        ci.name, stmt.name, cls_id=cid, relpath=relpath,
+                        # the *_locked SUFFIX is the caller-holds-lock
+                        # convention; a substring match would also
+                        # exempt e.g. `on_blocked` — the opposite of
+                        # the intent in block-policy-heavy code
+                        exempt=(stmt.name == "__init__"
+                                or stmt.name.endswith("_locked")))
+                    if prev is not None:
+                        info.returns_lock = prev.returns_lock
+                    ci.methods[stmt.name] = info
+                    _FnWalker(model, ci, info, comments).walk(stmt.body)
+                ci.has_join = ci.has_join or any(
+                    m.thread_join for m in ci.methods.values())
+            for stmt in tree.body:
+                if isinstance(stmt, (pyast.FunctionDef,
+                                     pyast.AsyncFunctionDef)):
+                    info = MethodInfo(None, stmt.name, relpath=relpath)
+                    model.modfuncs[f"{relpath}:{stmt.name}"] = info
+                    _FnWalker(model, None, info, comments).walk(stmt.body)
+
+
+# ---------------------------------------------------------------------------
+# the lock graph (SL04) + blocking closure (SL05)
+# ---------------------------------------------------------------------------
+
+def _resolve_callees(model: PackageModel, site: CallSite,
+                     cls: Optional[str]) -> list:
+    """Candidate MethodInfos for a call site.  An unresolved receiver
+    with a non-generic method name owned by a FEW classes resolves to
+    ALL of them — over-approximation keeps the static graph a superset
+    of what the runtime lock-witness can observe."""
+    owner = cls if site.recv == "self" else site.recv
+    if owner is not None:
+        ci = model.classes.get(owner)
+        m = ci.methods.get(site.name) if ci is not None else None
+        if m is not None:
+            return [m]
+        # fall through: `self.inject(...)` may be a callable ATTRIBUTE
+        # (a bound method handed in at construction), not an own method
+    if site.name in _GENERIC:
+        return []
+    owners = model.method_owner.get(site.name) or ()
+    if len(owners) > 8:
+        return []
+    return [m for o in sorted(owners)
+            for m in [model.classes[o].methods.get(site.name)]
+            if m is not None]
+
+
+def _closure(model: PackageModel, seed_fn) -> dict:
+    """Generic transitive closure over the call graph.  `seed_fn(m)`
+    -> set of facts directly true in method m; returns {id(m): facts}
+    where facts propagate from callees to callers."""
+    facts = {id(m): set(seed_fn(m)) for m in model.all_methods()}
+    methods = list(model.all_methods())
+    changed = True
+    while changed:
+        changed = False
+        for m in methods:
+            mine = facts[id(m)]
+            before = len(mine)
+            for c in m.calls:
+                for callee in _resolve_callees(model, c, m.cls_id):
+                    mine |= facts[id(callee)]
+            if len(mine) != before:
+                changed = True
+    return facts
+
+
+def build_lock_graph(model: PackageModel) -> dict:
+    """{"nodes": set, "edges": {(a, b): (relpath, lineno, suppressed)}}
+    — direct nesting edges plus call-composed ones (holding A, call a
+    method that eventually acquires B => A -> B).  Suppressed edges
+    stay IN the graph (they are facts the lock-witness will observe);
+    the flag only exempts them from SL04 cycle findings."""
+    nodes: set = set(model.module_locks.values())
+    for ci in model.classes.values():
+        for _a, (_k, node) in ci.locks.items():
+            nodes.add(node)
+    edges: dict = {}
+    acq = _closure(model, lambda m: set(m.acquires))
+    for m in model.all_methods():
+        for a, b, lineno, supp in m.edges:
+            _add_edge(edges, a, b, (m.relpath, lineno, supp))
+            nodes.update((a, b))
+        for c in m.calls:
+            if not c.held:
+                continue
+            for callee in _resolve_callees(model, c, m.cls_id):
+                for inner in acq[id(callee)]:
+                    for outer in c.held:
+                        if outer != inner:
+                            _add_edge(edges, outer, inner,
+                                      (m.relpath, c.lineno, c.suppressed))
+                            nodes.update((outer, inner))
+    return {"nodes": nodes, "edges": edges}
+
+
+def _add_edge(edges: dict, a: str, b: str, site: tuple) -> None:
+    """Keep the first site, but an UNSUPPRESSED sighting always wins
+    over a suppressed one (a pragma on one site must not blanket-allow
+    the same order somewhere else)."""
+    prev = edges.get((a, b))
+    if prev is None or (prev[2] and not site[2]):
+        edges[(a, b)] = site
+
+
+def _reaches(edges: dict, src: str, dst: str) -> bool:
+    succ: dict = {}
+    for (a, b) in edges:
+        succ.setdefault(a, set()).add(b)
+    seen, todo = set(), [src]
+    while todo:
+        n = todo.pop()
+        if n == dst:
+            return True
+        if n in seen:
+            continue
+        seen.add(n)
+        todo.extend(succ.get(n, ()))
+    return False
+
+
+def _cycles(graph: dict) -> list:
+    """Strongly connected components with >= 2 nodes, as sorted node
+    tuples (Tarjan, iterative)."""
+    succ: dict = {}
+    for (a, b) in graph["edges"]:
+        succ.setdefault(a, set()).add(b)
+    index: dict = {}
+    low: dict = {}
+    on: set = set()
+    stack: list = []
+    out: list = []
+    counter = [0]
+
+    def strongconnect(root):
+        work = [(root, iter(succ.get(root, ())))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(succ.get(w, ()))))
+                    advanced = True
+                    break
+                if w in on:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) >= 2:
+                    out.append(tuple(sorted(comp)))
+
+    for n in sorted(graph["nodes"]):
+        if n not in index:
+            strongconnect(n)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+def _sl03(model: PackageModel) -> tuple:
+    findings, suppressions = [], []
+    for ci in model.classes.values():
+        if not ci.locks:
+            continue
+        own_nodes = {node for _k, node in ci.locks.values()}
+        per_attr: dict = {}
+        for m in ci.methods.values():
+            if m.exempt:
+                continue
+            for a in m.accesses:
+                per_attr.setdefault(a.attr, []).append(a)
+        for attr, accs in sorted(per_attr.items()):
+            if not any(a.kind == "write" for a in accs):
+                continue            # init-only / read-only: not shared-mutable
+            eligible = [a for a in accs
+                        if (a.held & own_nodes) or not a.suppressed]
+            if not eligible:
+                continue
+            counts: dict = {}
+            for a in eligible:
+                for lk in (a.held & own_nodes):
+                    counts[lk] = counts.get(lk, 0) + 1
+            if not counts:
+                continue
+            dominant, guarded = max(counts.items(), key=lambda kv: kv[1])
+            if guarded < MIN_GUARDED or guarded / len(eligible) < DOMINANCE:
+                continue
+            bad = [a for a in accs
+                   if dominant not in a.held and not a.suppressed]
+            for a in accs:
+                if dominant not in a.held and a.suppressed:
+                    suppressions.append(("SL03", ci.relpath, a.lineno))
+            if not bad:
+                continue
+            sites = ", ".join(f"{a.method}:{a.lineno} ({a.kind})"
+                              for a in bad[:4])
+            more = f" (+{len(bad) - 4} more)" if len(bad) > 4 else ""
+            findings.append(_err(
+                "SL03",
+                f"`self.{attr}` in {ci.name!r} is guarded by "
+                f"{dominant!r} at {guarded}/{len(eligible)} accesses but "
+                f"accessed without it at {sites}{more} — inconsistent "
+                f"guard is a data race; lock it, rename the method "
+                f"`*_locked`, or annotate `# {ALLOW} (<why>)`",
+                f"{ci.relpath}:{bad[0].lineno}"))
+    return findings, suppressions
+
+
+def _sl04(model: PackageModel, graph: dict) -> list:
+    findings = []
+    live = {"nodes": graph["nodes"],
+            "edges": {k: v for k, v in graph["edges"].items()
+                      if not v[2]}}
+    for comp in _cycles(live):
+        inside = [((a, b), site) for (a, b), site in live["edges"].items()
+                  if a in comp and b in comp]
+        chain = "; ".join(f"{a} -> {b} at {site[0]}:{site[1]}"
+                          for (a, b), site in sorted(inside)[:6])
+        findings.append(_err(
+            "SL04",
+            f"lock-order inversion between {{{', '.join(comp)}}} — "
+            f"two threads taking these in opposite orders deadlock; "
+            f"break one edge or annotate its `with`/call line "
+            f"`# {ALLOW} (<why>)`.  Edges: {chain}",
+            f"{sorted(inside)[0][1][0]}:{sorted(inside)[0][1][1]}"))
+    return findings
+
+
+def _sl05(model: PackageModel) -> tuple:
+    findings, suppressions = [], []
+    blocking = _closure(
+        model, lambda m: {(w, f"{m.cls or ''}.{m.name}".lstrip("."))
+                          for (_ln, w, supp, _held) in m.blocking
+                          if not supp})
+    for m in model.all_methods():
+        # direct blocking calls inside a with-lock scope
+        for lineno, what, supp, held in m.blocking:
+            if not held:
+                continue
+            if supp:
+                suppressions.append(("SL05", m.relpath, lineno))
+                continue
+            findings.append(_err(
+                "SL05",
+                f"{what} while holding {held[-1]!r} "
+                f"(in {m.cls or m.relpath}.{m.name}) — a blocking call "
+                f"under a lock stalls every other thread that needs it; "
+                f"move it outside the guard or annotate "
+                f"`# {ALLOW} (<why>)`",
+                f"{m.relpath}:{lineno}"))
+        # blocking reached through a callee while a lock is held
+        for c in m.calls:
+            if not c.held:
+                continue
+            facts = set()
+            for callee in _resolve_callees(model, c, m.cls_id):
+                facts |= blocking[id(callee)]
+            if not facts:
+                continue
+            if c.suppressed:
+                suppressions.append(("SL05", m.relpath, c.lineno))
+                continue
+            what, via = sorted(facts)[0]
+            findings.append(_err(
+                "SL05",
+                f"call to {c.name}() while holding {c.held[-1]!r} "
+                f"(in {m.cls or m.relpath}.{m.name}) reaches {what} "
+                f"via {via} — blocking under a lock; restructure or "
+                f"annotate the call line `# {ALLOW} (<why>)`",
+                f"{m.relpath}:{c.lineno}"))
+    return findings, suppressions
+
+
+def _sl06(model: PackageModel) -> tuple:
+    findings, suppressions = [], []
+    for relpath, lineno, info, cls, supp in model.thread_spawns:
+        probs = []
+        if not info["daemon"] and not (
+                cls and model.classes[cls].has_join):
+            probs.append("neither daemon=True nor join-tracked by its "
+                         "owner (leaks at shutdown)")
+        if info["name"] is None:
+            probs.append("unnamed — every engine thread must carry "
+                         "name='siddhi-<role>' so leak checks and ops "
+                         "tooling can attribute it")
+        elif info["name"] != "<dynamic>" and \
+                not info["name"].startswith("siddhi-"):
+            probs.append(f"named {info['name']!r}, not 'siddhi-<role>'")
+        if not probs:
+            continue
+        if supp:
+            suppressions.append(("SL06", relpath, lineno))
+            continue
+        findings.append(_err(
+            "SL06",
+            "thread spawn is " + " and ".join(probs)
+            + f"; fix it or annotate `# {ALLOW} (<why>)`",
+            f"{relpath}:{lineno}"))
+    for relpath, lineno, in_while, supp in model.cond_waits:
+        if in_while:
+            continue
+        if supp:
+            suppressions.append(("SL06", relpath, lineno))
+            continue
+        findings.append(_err(
+            "SL06",
+            "Condition.wait outside a predicate loop — spurious wakeups "
+            "and missed notifies are real; wrap it in "
+            "`while not <predicate>: cond.wait()` or annotate "
+            f"`# {ALLOW} (<why>)`",
+            f"{relpath}:{lineno}"))
+    return findings, suppressions
+
+
+def _sl07(files: list) -> list:
+    """Every `# lint: ...` pragma must carry a (why) — same grammar
+    (walker.pragma_re) the suppression check and baseline inventory
+    apply, so nothing can suppress without being counted.  Only real
+    COMMENT tokens are considered: docstring/string mentions of the
+    grammar are prose, not pragmas."""
+    out = []
+    # longest tag first: "lint: allow" is a prefix of "lint: allow-swallow"
+    tags = sorted((ALLOW_SWALLOW, ALLOW_LEGACY, ALLOW), key=len,
+                  reverse=True)
+    bare = {t: re.compile(r"#\s*" + re.escape(t)) for t in tags}
+    just = {t: pragma_re(t) for t in tags}
+    for relpath, _tree, comments in files:
+        for lineno in sorted(comments):
+            text = comments[lineno]
+            tag = next((t for t in tags if bare[t].search(text)), None)
+            if tag is None or just[tag].search(text):
+                continue
+            out.append(_err(
+                "SL07",
+                f"suppression `# {tag}` without a justification — "
+                f"the why is mandatory: `# {tag} (<why>)`",
+                f"{relpath}:{lineno}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def _parse_files(sources: list) -> tuple:
+    """[(relpath, text)] -> ([(relpath, tree, comments)],
+    parse_findings) — `comments` maps lineno to real comment tokens
+    (walker.comment_map), the only place pragmas are honored."""
+    files, findings = [], []
+    for relpath, text in sources:
+        try:
+            tree = pyast.parse(text)
+        except SyntaxError as e:
+            findings.append(_err("SL00", f"does not parse: {e}", relpath))
+            continue
+        files.append((relpath, tree, comment_map(text)))
+    return files, findings
+
+
+def build_model(sources: list) -> tuple:
+    """[(relpath, text)] -> (PackageModel, parse_findings)."""
+    files, findings = _parse_files(sources)
+    model = PackageModel()
+    _inventory(files, model)
+    _walk_files(files, model)
+    return model, findings, files
+
+
+def analyze_sources(sources: list) -> dict:
+    """The full SL03–SL07 pass over [(relpath, text)].  Returns
+    {"findings": [Finding], "graph": ..., "suppressions": [...]}."""
+    model, findings, files = build_model(sources)
+    graph = build_lock_graph(model)
+    suppressions: list = []
+    f3, s3 = _sl03(model)
+    f5, s5 = _sl05(model)
+    f6, s6 = _sl06(model)
+    findings += f3 + _sl04(model, graph) + f5 + f6 + _sl07(files)
+    suppressions += s3 + s5 + s6
+    findings.sort(key=lambda f: (f.subject or "", f.rule_id))
+    return {"findings": findings, "graph": graph, "model": model,
+            "suppressions": sorted(suppressions)}
+
+
+def analyze_package(root: Optional[str] = None) -> dict:
+    return analyze_sources(list(iter_package(root)))
+
+
+def lint_threads_source(text: str, relpath: str = "<snippet>.py") -> list:
+    """SL03–SL07 over ONE module in isolation (the seeded-corpus entry
+    point)."""
+    return analyze_sources([(relpath, text)])["findings"]
+
+
+def static_lock_graph(root: Optional[str] = None) -> dict:
+    """{"nodes": sorted list, "edges": [[a, b, "file:line"], ...]} —
+    the static model the runtime lock-witness is checked against."""
+    g = analyze_package(root)["graph"]
+    return {"nodes": sorted(g["nodes"]),
+            "edges": sorted([a, b, f"{site[0]}:{site[1]}"]
+                            for (a, b), site in g["edges"].items()),
+            "suppressed_edges": sorted(
+                f"{a} -> {b}" for (a, b), site in g["edges"].items()
+                if site[2])}
+
+
+def check_witness(witness: dict, graph: dict) -> list:
+    """Compare a runtime lock-witness dump ({"locks": [...], "edges":
+    [[outer, inner], ...]}) against the static graph.  A witnessed
+    order the static model contradicts (knows only the REVERSE of) or
+    does not know at all is a finding — the model must over-approximate
+    reality or its SL04 verdicts are worthless."""
+    findings = []
+    nodes = set(graph["nodes"])
+    edges = {(a, b) for (a, b) in graph["edges"]}
+    for pair in witness.get("edges", ()):
+        a, b = pair[0], pair[1]
+        if a not in nodes or b not in nodes:
+            missing = a if a not in nodes else b
+            findings.append(_err(
+                "SL04",
+                f"runtime witnessed lock {missing!r} that the static "
+                f"model never inventoried — a construction site the "
+                f"analyzer cannot see (name it via utils.locks "
+                f"factories)",
+                f"witness:{a}->{b}"))
+            continue
+        if _reaches(edges, a, b):
+            continue
+        if _reaches(edges, b, a):
+            findings.append(_err(
+                "SL04",
+                f"runtime acquisition order {a!r} -> {b!r} CONTRADICTS "
+                f"the static graph (which only knows {b!r} -> {a!r}) — "
+                f"either a real inversion or a model bug; both block",
+                f"witness:{a}->{b}"))
+        else:
+            findings.append(_err(
+                "SL04",
+                f"runtime acquisition order {a!r} -> {b!r} is unknown "
+                f"to the static graph — the model missed a nesting or "
+                f"call edge and its cycle verdicts cannot be trusted",
+                f"witness:{a}->{b}"))
+    return findings
+
+
+def check_witness_file(path: str, root: Optional[str] = None) -> list:
+    with open(path, encoding="utf-8") as f:
+        witness = json.load(f)
+    g = analyze_package(root)["graph"]
+    return check_witness(witness,
+                         {"nodes": g["nodes"], "edges": g["edges"]})
+
+
+def suppression_inventory(root: Optional[str] = None) -> dict:
+    """{relpath: pragma count} over the package — the pinned-baseline
+    unit: a NEW suppression anywhere fails CI until the baseline is
+    deliberately regenerated (--baseline, scripts/threads_baseline.json).
+    Counts REAL comment tokens with walker.pragma_re — the SAME grammar
+    that makes a pragma suppress — so no spelling can take effect
+    uncounted, and a docstring that merely quotes the grammar is not
+    pinned as a suppression."""
+    tags = sorted((ALLOW_SWALLOW, ALLOW_LEGACY, ALLOW), key=len,
+                  reverse=True)
+    rxs = [pragma_re(t) for t in tags]
+    out: dict = {}
+    for relpath, text in iter_package(root):
+        n = sum(next((1 for rx in rxs if rx.search(c)), 0)
+                for c in comment_map(text).values())
+        if n:
+            out[relpath] = n
+    return out
+
+
+def check_baseline(path: str, root: Optional[str] = None) -> list:
+    """Compare the live suppression inventory to the pinned baseline;
+    every drift (new, removed, or recounted) is a finding."""
+    with open(path, encoding="utf-8") as f:
+        pinned = json.load(f)
+    live = suppression_inventory(root)
+    findings = []
+    for rel in sorted(set(pinned) | set(live)):
+        want, got = pinned.get(rel, 0), live.get(rel, 0)
+        if want != got:
+            findings.append(_err(
+                "SL-BASELINE",
+                f"suppression count drifted: {rel} has {got} justified "
+                f"pragma(s), baseline pins {want} — if the new "
+                f"suppression is legitimate, regenerate the baseline "
+                f"(python -m siddhi_tpu.analysis --threads "
+                f"--write-baseline <path>) in the same commit",
+                rel))
+    return findings

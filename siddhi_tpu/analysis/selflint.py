@@ -32,6 +32,8 @@ import re
 from typing import Optional
 
 from .rules import Finding
+from .walker import (class_lock_attrs, has_pragma,  # noqa: F401 (re-export)
+                     iter_package, package_root)
 
 # files whose except-handlers are on a plan-lowering path (SL01 scope)
 LOWERING_FILES = (
@@ -70,12 +72,8 @@ def _sl(rule_id: str, message: str, subject: str) -> Finding:
     return Finding(rule_id, "error", message, subject)
 
 
-def _has_pragma(lines: list, lineno: int, tag: str) -> bool:
-    """`tag` on the node's line or the line directly above it."""
-    for ln in (lineno - 1, lineno - 2):
-        if 0 <= ln < len(lines) and tag in lines[ln]:
-            return True
-    return False
+# shared pragma helper (analysis/walker.py)
+_has_pragma = has_pragma
 
 
 def _etype_names(node) -> set:
@@ -135,24 +133,11 @@ def lint_sl01(tree, lines: list, relpath: str) -> list:
 # ---------------------------------------------------------------------------
 
 def _lock_attrs(cls: pyast.ClassDef) -> set:
-    """self attributes assigned a threading.Lock()/RLock() anywhere in
-    the class body."""
-    locks: set = set()
-    for n in pyast.walk(cls):
-        if not isinstance(n, pyast.Assign) or not isinstance(n.value,
-                                                             pyast.Call):
-            continue
-        f = n.value.func
-        fname = f.attr if isinstance(f, pyast.Attribute) else \
-            f.id if isinstance(f, pyast.Name) else None
-        if fname not in ("Lock", "RLock"):
-            continue
-        for tgt in n.targets:
-            if isinstance(tgt, pyast.Attribute) and \
-                    isinstance(tgt.value, pyast.Name) and \
-                    tgt.value.id == "self":
-                locks.add(tgt.attr)
-    return locks
+    """self attributes assigned a lock anywhere in the class body —
+    raw threading.Lock()/RLock() AND the engine's named factories
+    (utils.locks new_lock/new_rlock), via the shared walker."""
+    return {attr for attr, (kind, _node) in class_lock_attrs(cls).items()
+            if kind in ("lock", "rlock")}
 
 
 def _with_guards(stack: list, locks: set) -> bool:
@@ -225,21 +210,9 @@ def lint_source(text: str, relpath: str) -> list:
     return out
 
 
-def package_root() -> str:
-    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
 def lint_package(root: Optional[str] = None) -> list:
     """Lint every .py under the siddhi_tpu package (the CI gate)."""
-    root = root or package_root()
     out: list = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, root).replace(os.sep, "/")
-            with open(path, encoding="utf-8") as f:
-                out += lint_source(f.read(), rel)
+    for rel, text in iter_package(root):
+        out += lint_source(text, rel)
     return out

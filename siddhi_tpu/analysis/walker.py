@@ -1,0 +1,154 @@
+"""Shared AST machinery for the self-lint passes.
+
+`selflint.py` (SL01/SL02) and `concurrency.py` (SL03–SL06) walk the
+same package with the same primitives: lock-attribute inventory,
+`# lint:` pragma handling, `with self.<lock>:` guard resolution, and
+package iteration.  This module holds the one implementation.
+
+Lock construction is recognized in two shapes:
+
+    self._lock = threading.Lock() / threading.RLock() /
+                 threading.Condition()
+    self._lock = new_lock("Class._lock") / new_rlock("Class._lock")
+
+The second is the engine's own convention (`siddhi_tpu/utils/locks.py`
+named factories): the string argument IS the canonical node name the
+static lock graph and the runtime lock-witness share.
+"""
+from __future__ import annotations
+
+import ast as pyast
+import os
+import re
+from typing import Optional
+
+# factory call names that create a lock-like object
+LOCK_FACTORIES = {"Lock": "lock", "RLock": "rlock",
+                  "new_lock": "lock", "new_rlock": "rlock",
+                  "Condition": "condition", "new_condition": "condition",
+                  "Semaphore": "lock", "BoundedSemaphore": "lock"}
+
+# methods whose call on an attribute MUTATES the underlying container
+MUTATING_METHODS = {
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "popitem", "remove", "clear", "add", "discard", "update",
+    "setdefault", "sort", "reverse", "__setitem__",
+}
+
+
+def call_name(node: pyast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, pyast.Attribute):
+        return f.attr
+    if isinstance(f, pyast.Name):
+        return f.id
+    return None
+
+
+def self_attr(node) -> Optional[str]:
+    """`self.X` -> "X", else None."""
+    if isinstance(node, pyast.Attribute) and \
+            isinstance(node.value, pyast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def has_pragma(lines: list, lineno: int, tag: str) -> bool:
+    """`tag` on the node's line or the line directly above it."""
+    for ln in (lineno - 1, lineno - 2):
+        if 0 <= ln < len(lines) and tag in lines[ln]:
+            return True
+    return False
+
+
+def pragma_re(tag: str) -> "re.Pattern":
+    """ONE grammar for a justified suppression, shared by the
+    suppression check (justified_pragma), the bare-pragma rule (SL07),
+    and the baseline inventory (suppression_inventory) — the three MUST
+    agree or a suppression could take effect without being counted:
+    a `#` comment marker, the tag, then `(<non-empty why>`."""
+    return re.compile(r"#\s*" + re.escape(tag) + r"\s*\(\s*\S")
+
+
+def comment_map(text: str) -> dict:
+    """{1-based lineno: comment text} for REAL comment tokens only —
+    a docstring or string literal that merely mentions the pragma
+    grammar must neither suppress findings nor count in the pinned
+    baseline."""
+    import io
+    import tokenize
+    out: dict = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # unparsable tail: fall back to a lexical scan so a pragma
+        # never silently stops applying mid-file
+        for i, line in enumerate(text.splitlines(), 1):
+            if "#" in line:
+                out[i] = line[line.index("#"):]
+    return out
+
+
+def justified_pragma(comments: dict, lineno: int, tag: str) -> bool:
+    """True when a REAL comment on the node's line (or the line
+    directly above) carries the tag with a non-empty justification:
+    `# lint: allow (<why>)`.  A bare tag does NOT suppress — the why
+    is mandatory."""
+    rx = pragma_re(tag)
+    return any(rx.search(comments.get(ln, ""))
+               for ln in (lineno, lineno - 1))
+
+
+def lock_call_kind(node) -> Optional[tuple]:
+    """If `node` is a lock-factory Call: (kind, explicit_name_or_None).
+    The explicit name is the string literal handed to new_lock/new_rlock
+    — the canonical graph-node name."""
+    if not isinstance(node, pyast.Call):
+        return None
+    name = call_name(node)
+    kind = LOCK_FACTORIES.get(name or "")
+    if kind is None:
+        return None
+    explicit = None
+    if name in ("new_lock", "new_rlock", "new_condition") and node.args \
+            and isinstance(node.args[0], pyast.Constant) \
+            and isinstance(node.args[0].value, str):
+        explicit = node.args[0].value
+    return kind, explicit
+
+
+def class_lock_attrs(cls: pyast.ClassDef) -> dict:
+    """{attr: (kind, explicit_name)} for every `self.X = <lock>()`
+    anywhere in the class body (nested functions included)."""
+    locks: dict = {}
+    for n in pyast.walk(cls):
+        if not isinstance(n, pyast.Assign):
+            continue
+        got = lock_call_kind(n.value)
+        if got is None:
+            continue
+        for tgt in n.targets:
+            attr = self_attr(tgt)
+            if attr is not None:
+                locks[attr] = got
+    return locks
+
+
+def iter_package(root: Optional[str] = None):
+    """Yield (relpath, source_text) for every .py under the package."""
+    root = root or package_root()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            with open(path, encoding="utf-8") as f:
+                yield rel, f.read()
+
+
+def package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -42,7 +42,6 @@ import json
 import math
 import os
 import time
-import threading
 import warnings
 from collections import deque
 from dataclasses import dataclass
@@ -51,6 +50,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..query import ast
+from ..utils.locks import new_lock
 
 CACHE_VERSION = 1
 GEOMETRY_KEYS = ("batch", "pipeline_depth", "chunk_lanes", "lane_pack",
@@ -240,7 +240,7 @@ class TuningCache:
         self.hits = 0
         self.misses = 0
         self.corrupt = False
-        self._lock = threading.Lock()
+        self._lock = new_lock("TuningCache._lock")
         self._data: Optional[dict] = None
 
     # -- persistence -----------------------------------------------------
@@ -336,7 +336,7 @@ class TuningCache:
 
 
 _SHARED: dict = {}
-_SHARED_LOCK = threading.Lock()
+_SHARED_LOCK = new_lock("autotune._SHARED_LOCK")
 
 
 def shared_cache(path: Optional[str] = None) -> TuningCache:

@@ -26,6 +26,8 @@ from collections import defaultdict
 from dataclasses import dataclass
 from typing import Callable, Optional
 
+from ..utils.locks import new_lock
+
 
 # ---------------------------------------------------------------------------
 # fault classification
@@ -235,9 +237,10 @@ class ErrorStore:
         self.evicted = 0
         self._entries: list = []
         self._next_id = 1
-        self._lock = threading.Lock()
+        self._lock = new_lock("ErrorStore._lock")
 
     def __len__(self) -> int:
+        # lint: allow (len() of a list is one atomic read; scrape-only)
         return len(self._entries)
 
     def add(self, stream_id: str, point: str, error, timestamp_ms: int,
@@ -432,7 +435,7 @@ class FaultInjector:
         self.fired: dict = defaultdict(int)
         self.checked: dict = defaultdict(int)
         self._rngs: dict = {}
-        self._lock = threading.Lock()
+        self._lock = new_lock("FaultInjector._lock")
 
     @classmethod
     def parse(cls, spec: str, seed: int = 0) -> "FaultInjector":

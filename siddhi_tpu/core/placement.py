@@ -39,9 +39,10 @@ Demotion rule ids (docs/ANALYSIS.md "Demotion records"):
 """
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass
 from typing import Optional
+
+from ..utils.locks import new_lock
 
 DEMOTION_RULES = {
     "D-FILTER": "device filter/projection lowering failed",
@@ -90,7 +91,7 @@ class PlacementLog:
     dispatch thread — appends are lock-guarded, reads snapshot."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = new_lock("PlacementLog._lock")
         self._demotions: list = []
 
     def demote(self, query: str, rule_id: str, reason: str,

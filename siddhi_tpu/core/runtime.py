@@ -22,6 +22,7 @@ from typing import Any, Callable, Optional, Sequence, Union
 
 from ..query import ast as qast
 from ..query.parser import parse
+from ..utils.locks import new_lock, new_rlock
 from .batch import BatchBuilder, EventBatch
 from .planner import OutputBatch, PlanError, QueryPlan
 from .schema import StreamSchema, StringTable
@@ -288,7 +289,7 @@ class SiddhiAppRuntime:
         # serializes net feeds against retire() across EVERY server
         # feeding this runtime (net/server.py _gate_of)
         self.admission: dict = {}
-        self._net_gate = threading.RLock()
+        self._net_gate = new_rlock("SiddhiAppRuntime._net_gate")
         self._ladders: dict = {}        # plan name -> FaultLadder
         self._degraded: list = []       # quarantined-plan records
         # placement accounting (core/placement.py): every interpreter
@@ -322,7 +323,7 @@ class SiddhiAppRuntime:
         # ingest/timer mutual exclusion (the reference's ThreadBarrier +
         # per-query locks collapse to one runtime lock: state is columnar
         # and single-writer by design)
-        self._lock = threading.RLock()
+        self._lock = new_rlock("SiddhiAppRuntime._lock")
         # sink deliveries staged inside _drain (under the lock) and flushed
         # after release: a sink publishing into another runtime's source
         # (which takes THAT runtime's lock) could otherwise ABBA-deadlock
@@ -334,7 +335,12 @@ class SiddhiAppRuntime:
         self._ingest_thread = None
         self._ingest_err = None
         self._async_outbox: list = []   # full builders staged under the lock
-        self._outbox_mutex = threading.Lock()   # orders producer enqueues
+        self._outbox_mutex = new_lock(
+            "SiddhiAppRuntime._outbox_mutex")    # orders producer enqueues
+        # shutdown() is reachable concurrently (service.stop() racing an
+        # undeploy of the same snapshot, user teardown racing atexit):
+        # the teardown sequence must run once, not interleave
+        self._shutdown_mutex = new_lock("SiddhiAppRuntime._shutdown_mutex")
 
         from .telemetry import StatisticsManager
         self.stats = StatisticsManager(self)
@@ -558,7 +564,7 @@ class SiddhiAppRuntime:
                            for w in [p.next_wakeup()] if w is not None]
                     now = int(time.time() * 1000)
                     if due and min(due) <= now:
-                        self._fire_timers(now)
+                        self._fire_timers_locked(now)
                         self._clock_ms = None    # stay in wall-clock mode
                 self._drain_async_outbox()      # outside the lock
                 self._flush_sink_outbox()
@@ -639,6 +645,20 @@ class SiddhiAppRuntime:
         return self._debugger
 
     def shutdown(self) -> None:
+        # serialized: two concurrent shutdowns (service.stop() racing an
+        # undeploy that snapshotted the same runtime, user teardown
+        # racing atexit) used to race the `self._sched_thread = None`
+        # hand-off below — the loser crashed joining a None thread.
+        # The mutex makes the second call a clean no-op pass-through.
+        with self._shutdown_mutex:
+            # joining the worker/scheduler threads under the mutex is the
+            # point: the second caller must not proceed until teardown —
+            # joins included — finished.  The joined threads never take
+            # this mutex, so the joins always complete.
+            # lint: allow (join-under-mutex is the once-only teardown barrier)
+            self._shutdown_serialized()
+
+    def _shutdown_serialized(self) -> None:
         for s in (*self.sources, *self.sinks):
             if s.connected:
                 s.disconnect()
@@ -674,7 +694,9 @@ class SiddhiAppRuntime:
     # -- time ----------------------------------------------------------------
 
     def now_ms(self) -> int:
-        if self._clock_ms is not None:
+        # unguarded virtual-clock read: an int read is atomic under the
+        # GIL and telemetry/scrape callers tolerate one tick of staleness
+        if self._clock_ms is not None:  # lint: allow (atomic int read)
             return self._clock_ms
         return int(time.time() * 1000)
 
@@ -700,12 +722,12 @@ class SiddhiAppRuntime:
             # `not X for T` deadline ~50 years out on the event timeline
             if self._clock_ms is None:
                 self._clock_ms = ms
-            self._fire_timers(ms)
+            self._fire_timers_locked(ms)
             self._clock_ms = ms
             self._drain()
         self._flush_sink_outbox()
 
-    def _fire_timers(self, upto_ms: int) -> None:
+    def _fire_timers_locked(self, upto_ms: int) -> None:
         guard = 0
         while True:
             guard += 1
@@ -833,6 +855,10 @@ class SiddhiAppRuntime:
                     item = self._async_outbox.pop(0)
                 except IndexError:
                     return
+                # bounded-queue backpressure is deliberate: a full queue
+                # stalls producers, never the worker (which drains it
+                # without ever taking this mutex — no deadlock)
+                # lint: allow (backpressure by design; worker never locks this)
                 self._ingest_q.put(item)
 
     def _send_locked(self, stream_id: str, data, timestamp: Optional[int]) -> None:
@@ -930,6 +956,7 @@ class SiddhiAppRuntime:
         is output-invariant (faults.split_batch parity, PR 4)."""
         n = max(1, int(n))
         self.batch_capacity = n
+        # lint: allow (called from _drain at a flush boundary: lock held)
         for b in self._builders.values():
             b.capacity = n
         for p in self._plans:
@@ -1009,6 +1036,7 @@ class SiddhiAppRuntime:
                         self._drain()
                 finally:
                     self._ingest_q.task_done()
+            # lint: allow (owned branch: _is_owned() proved we hold the lock)
             for sid, b in self._builders.items():
                 if len(b):
                     self._pending.append((sid, self._freeze(sid, b)))
@@ -1390,6 +1418,7 @@ class SiddhiAppRuntime:
         for delay in policy.delays():
             if time.monotonic() + delay > deadline:
                 break
+            # lint: allow (@OnError(action='wait') blocks ingest by contract)
             time.sleep(delay)
             try:
                 return plan.process(sid, batch)
@@ -1601,6 +1630,14 @@ class SiddhiAppRuntime:
         }
 
     def restore(self, snap: dict) -> None:
+        # under the runtime lock: a restore on a STARTED runtime races
+        # the scheduler pump's timer fires and any concurrent ingest —
+        # plan state must never be half-swapped under a live _drain
+        # (surfaced by the SL03 lockset self-analysis, docs/ANALYSIS.md)
+        with self._lock:
+            self._restore_locked(snap)
+
+    def _restore_locked(self, snap: dict) -> None:
         self.strings.restore(snap["strings"])
         # a snapshot taken AFTER a quarantine carries that plan's state in
         # the interpreter twin's format: swap the live device plan for a

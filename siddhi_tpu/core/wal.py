@@ -74,6 +74,7 @@ from typing import Callable, Iterator, Optional
 
 import numpy as np
 
+from ..utils.locks import new_rlock
 from .telemetry import Histogram
 
 MAGIC = 0x4C57
@@ -132,7 +133,7 @@ class WriteAheadLog:
         # unarmed fast path is ONE buffered write — the per-frame cost
         # the <=15% 'batch' overhead budget is built on.
         self.armed = armed or (lambda: False)
-        self._lock = threading.RLock()
+        self._lock = new_rlock("WriteAheadLog._lock")
         self._f = None                  # open segment file object
         self._seg_no = 0
         self._seg_len = 0
@@ -304,7 +305,7 @@ class WriteAheadLog:
                     self._f.write(blob)
                 self._f.flush()
                 if self.policy == "fsync":
-                    self._fsync()
+                    self._fsync_locked()
                 else:
                     self._unsynced = True
             except BaseException:
@@ -325,9 +326,13 @@ class WriteAheadLog:
                 self._rotate_locked()
             return seq
 
-    def _fsync(self) -> None:
+    def _fsync_locked(self) -> None:
         self.inject("wal.fsync", "")
         t0 = time.perf_counter()
+        # blocking appenders until the disk confirms is the sync
+        # policy's whole point (docs/RELIABILITY.md): appends must not
+        # interleave with the barrier, so the fsync sits under the lock
+        # lint: allow (fsync under the WAL lock IS the durability contract)
         os.fsync(self._f.fileno())
         self.fsync_hist.record(time.perf_counter() - t0)
         self.fsyncs += 1
@@ -340,13 +345,13 @@ class WriteAheadLog:
             if self._f is None or not self._unsynced:
                 return
             self._f.flush()
-            self._fsync()
+            self._fsync_locked()
 
     # -- rotation / truncation -----------------------------------------------
 
     def _rotate_locked(self) -> None:
         self._f.flush()
-        self._fsync()
+        self._fsync_locked()
         self._f.close()
         self._sealed.append((self._seg_no, self._seg_max))
         self._seg_no += 1
@@ -458,7 +463,7 @@ class WriteAheadLog:
             if self._f is not None:
                 self._f.flush()
                 if self._unsynced:
-                    self._fsync()
+                    self._fsync_locked()
                 self._f.close()
                 self._f = None
 

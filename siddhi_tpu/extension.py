@@ -16,8 +16,9 @@ test suite asserting this table covers the parser's built-in surface.
 """
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass, field
+
+from .utils.locks import new_rlock
 
 
 class ExtensionError(Exception):
@@ -101,7 +102,7 @@ _REGISTRY: dict = {}
 # race the check-then-insert (the RLock lets the discovery thread's own
 # nested register_* calls through)
 _strict_collisions = False
-_REGISTRY_LOCK = threading.RLock()
+_REGISTRY_LOCK = new_rlock("extension._REGISTRY_LOCK")
 
 
 def register_meta(kind: str, meta, strict: bool = None) -> None:

@@ -29,11 +29,12 @@ to 1.0.
 """
 from __future__ import annotations
 
-import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
+
+from ..utils.locks import new_lock
 
 ADMIT = "admit"
 SHED = "shed"
@@ -147,7 +148,7 @@ class AdmissionController:
         self.now_ms = now_ms or (lambda: int(time.time() * 1000))
         self._pending: deque = deque()  # Work, oldest first
         self._inflight = 0              # drained-but-not-yet-fed frames
-        self._lock = threading.Lock()
+        self._lock = new_lock("AdmissionController._lock")
         # gauges/counters (statistics()["net"] + Prometheus)
         self.frames_in = 0
         self.events_in = 0

@@ -25,6 +25,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..utils.locks import new_lock
 from . import frame as fp
 from .ring import ShmRing
 
@@ -463,9 +464,10 @@ class FrameReceiver:
         self._fail_first = fail_first   # refuse N connections (tests)
         self._stop = threading.Event()
         self._threads: list = []
-        self._lock = threading.Lock()
+        self._lock = new_lock("FrameReceiver._lock")
         self._accept = threading.Thread(target=self._accept_loop,
-                                        name="frame-receiver", daemon=True)
+                                        name="siddhi-frame-receiver",
+                                        daemon=True)
         self._accept.start()
 
     def _accept_loop(self) -> None:
@@ -480,8 +482,10 @@ class FrameReceiver:
                 sock.close()
                 continue
             t = threading.Thread(target=self._serve, args=(sock,),
+                                 name="siddhi-frame-receiver-conn",
                                  daemon=True)
-            self._threads.append(t)
+            with self._lock:    # stop() snapshots the join list
+                self._threads.append(t)
             t.start()
 
     def _serve(self, sock: socket.socket) -> None:
@@ -542,5 +546,7 @@ class FrameReceiver:
             self._sock.close()
         except OSError:
             pass
-        for t in self._threads:
+        with self._lock:
+            threads = list(self._threads)
+        for t in threads:
             t.join(timeout=2)

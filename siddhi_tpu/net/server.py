@@ -36,6 +36,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..utils.locks import new_lock, new_rlock
 from . import frame as fp
 from .admission import ADMIT, AdmissionController, Work
 from .ring import ShmRing
@@ -396,7 +397,7 @@ class NetServer:
         self._threads: list = []
         self._conn_socks: list = []
         self._rings: list = []          # (ring, thread)
-        self._lock = threading.Lock()
+        self._lock = new_lock("NetServer._lock")
         # counters (server-level; per-stream counters live on the
         # AdmissionControllers)
         self.connections = 0
@@ -428,7 +429,8 @@ class NetServer:
             with self._lock:
                 gate = getattr(rt, "_net_gate", None)
                 if gate is None:
-                    gate = rt._net_gate = threading.RLock()
+                    gate = rt._net_gate = new_rlock(
+                        "SiddhiAppRuntime._net_gate")
         return gate
 
     def retire(self, rt) -> None:
@@ -496,7 +498,12 @@ class NetServer:
             except OSError:
                 pass
         with self._lock:
+            # snapshot sockets AND threads under the lock: the accept
+            # loop rebuilds self._threads concurrently, and a join list
+            # read outside the lock could miss the newest connection
+            # thread (surfaced by the SL03 lockset self-analysis)
             socks = list(self._conn_socks)
+            conn_threads = list(self._threads)
         for s in socks:
             try:
                 s.shutdown(socket.SHUT_RDWR)
@@ -508,7 +515,7 @@ class NetServer:
                 pass
         deadline = time.monotonic() + timeout
         threads = ([self._accept_thread] if self._accept_thread else []) \
-            + [t for _, t in self._rings] + self._threads
+            + [t for _, t in self._rings] + conn_threads
         for t in threads:
             t.join(timeout=max(0.0, deadline - time.monotonic()))
         for ring, _ in self._rings:

@@ -20,12 +20,12 @@ re-publishes the exact wire bytes.
 from __future__ import annotations
 
 import socket
-import threading
 from typing import Optional
 
 import numpy as np
 
 from ..core.io import Sink, register_sink_type
+from ..utils.locks import new_lock
 from ..core.planner import PlanError
 from . import frame as fp
 from .client import NetClientError, WsFrameClient, _FrameEncoder
@@ -80,13 +80,19 @@ class TcpSink(Sink):
         # — so queued/ErrorStore payloads always decode
         self.enc = _FrameEncoder(stream_id, self._cols, str_cols)
         self._peer_codes = 1            # peer has mapped codes < this
-        self._io_lock = threading.Lock()
+        self._io_lock = new_lock("TcpSink._io_lock")
 
     # -- connection management ---------------------------------------------
 
     def connect(self) -> None:
+        # under _io_lock: connect() can race a publish — a replay of
+        # stored payloads, or the scheduler flushing the sink outbox,
+        # may already be reconnecting on another thread, and _open's
+        # negotiation plus the _peer_codes bookkeeping must not
+        # interleave (surfaced by the SL03 lockset self-analysis)
         try:
-            self._open()
+            with self._io_lock:
+                self._open_locked()
         except _CONN_ERRORS as e:
             if self.on_error is None:
                 raise               # fail-fast sinks surface at start()
@@ -97,19 +103,24 @@ class TcpSink(Sink):
                 f"sink on {self.stream_id!r}: peer "
                 f"{self.host}:{self.tcp_port} unavailable at start ({e}); "
                 f"deferring to per-publish retry", RuntimeWarning)
-            try:
-                if self.sock is not None:
-                    self.sock.close()
-            except OSError:
-                pass
-            self.sock = None
+            with self._io_lock:
+                try:
+                    if self.sock is not None:
+                        self.sock.close()
+                except OSError:
+                    pass
+                self.sock = None
 
-    def _open(self) -> None:
+    def _open_locked(self) -> None:
+        # blocking connect/negotiate under _io_lock is the sink's design:
+        # the lock serializes ALL wire traffic, and a publisher blocked
+        # behind a reconnect is exactly the retry/breaker back-off path
+        # lint: allow (reconnect-under-io-lock serializes the wire by design)
         self.sock = socket.create_connection((self.host, self.tcp_port),
                                              timeout=5.0)
         try:
             self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._wire_send(fp.encode_hello(self.rt.app.name,
+            self._wire_send_locked(fp.encode_hello(self.rt.app.name,
                                             self.stream_id,
                                             self._cols, credit=False))
             ftype, payload = fp.read_frame(fp.reader_for(self.sock))
@@ -121,7 +132,7 @@ class TcpSink(Sink):
                     f"expected HELLO_OK, got {fp.type_name(ftype)}")
             table = self.enc.strings.all_strings()
             if table:                   # dictionary replay (reconnect)
-                self._wire_send(fp.encode_strings(table, start_code=1))
+                self._wire_send_locked(fp.encode_strings(table, start_code=1))
         except BaseException:
             # a half-negotiated socket must not survive: publish() only
             # reconnects when self.sock is None, so leaving it set would
@@ -135,20 +146,28 @@ class TcpSink(Sink):
         self._peer_codes = len(self.enc.strings)
         self.reconnects += 1
 
-    def _wire_send(self, data: bytes) -> None:
+    def _wire_send_locked(self, data: bytes) -> None:
+        # the socket IS the resource _io_lock serializes: frames must
+        # not interleave, and a slow peer backpressures this sink's
+        # publisher only (the retry machinery owns longer stalls)
+        # lint: allow (wire writes must serialize under _io_lock by design)
         self.sock.sendall(data)
 
     def disconnect(self) -> None:
-        if self.sock is not None:
-            try:
-                self._wire_send(fp.encode_frame(fp.BYE))
-            except OSError:
-                pass
-            try:
-                self.sock.close()
-            except OSError:
-                pass
-            self.sock = None
+        # under _io_lock: a teardown racing an in-flight publish used to
+        # interleave the BYE with a half-written DATA frame and null the
+        # socket under the publisher's feet
+        with self._io_lock:
+            if self.sock is not None:
+                try:
+                    self._wire_send_locked(fp.encode_frame(fp.BYE))
+                except OSError:
+                    pass
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+                self.sock = None
 
     # -- egress -------------------------------------------------------------
 
@@ -196,7 +215,7 @@ class TcpSink(Sink):
     def publish(self, payload) -> None:
         with self._io_lock:
             if self.sock is None:       # reconnect + full dictionary replay
-                self._open()
+                self._open_locked()
             try:
                 start = getattr(payload, "start_code", None)
                 behind = len(self.enc.strings) - self._peer_codes
@@ -207,11 +226,11 @@ class TcpSink(Sink):
                     # Skipped when THIS payload's embedded delta already
                     # starts at (or before) the peer's mark — otherwise
                     # every dictionary delta would ship twice
-                    self._wire_send(fp.encode_strings(
+                    self._wire_send_locked(fp.encode_strings(
                         self.enc.strings.strings_from(self._peer_codes),
                         start_code=self._peer_codes))
                     self._peer_codes = len(self.enc.strings)
-                self._wire_send(payload)
+                self._wire_send_locked(payload)
                 end = getattr(payload, "end_code", None)
                 if end is not None and end > self._peer_codes:
                     # the embedded delta advanced the peer too
@@ -241,7 +260,7 @@ class WsSink(TcpSink):
 
     transport = "ws"
 
-    def _open(self) -> None:
+    def _open_locked(self) -> None:
         self._ws = WsFrameClient(self.host, self.tcp_port, self.stream_id,
                                  self._cols, app=self.rt.app.name,
                                  credit=False)
@@ -249,7 +268,7 @@ class WsSink(TcpSink):
         try:
             table = self.enc.strings.all_strings()
             if table:
-                self._wire_send(fp.encode_strings(table, start_code=1))
+                self._wire_send_locked(fp.encode_strings(table, start_code=1))
         except BaseException:
             try:
                 self.sock.close()
@@ -260,7 +279,7 @@ class WsSink(TcpSink):
         self._peer_codes = len(self.enc.strings)
         self.reconnects += 1
 
-    def _wire_send(self, data: bytes) -> None:
+    def _wire_send_locked(self, data: bytes) -> None:
         # each protocol frame rides its own ws message; a blob may hold
         # STRINGS + DATA — split on frame boundaries
         frames, rest = fp.parse_buffer(data)

@@ -85,6 +85,7 @@ from urllib.parse import parse_qs, urlparse
 from . import SiddhiManager
 from .core.telemetry import render_prometheus
 from .query import ast as qast
+from .utils.locks import new_lock
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -100,11 +101,12 @@ class _ControlServer(ThreadingHTTPServer):
     def __init__(self, *a, **k):
         super().__init__(*a, **k)
         self._handler_threads: list = []
-        self._threads_lock = threading.Lock()
+        self._threads_lock = new_lock("_ControlServer._threads_lock")
 
     def process_request(self, request, client_address):
         t = threading.Thread(target=self.process_request_thread,
-                             args=(request, client_address), daemon=True)
+                             args=(request, client_address),
+                             name="siddhi-http", daemon=True)
         with self._threads_lock:
             self._handler_threads = [th for th in self._handler_threads
                                      if th.is_alive()] + [t]
@@ -125,6 +127,12 @@ class SiddhiService:
         self.manager = manager or SiddhiManager()
         self.runtimes: dict = {}
         self._stopping = False          # unblocks 'block'-policy REST waits
+        # serializes deploy/undeploy/stop: the control server handles
+        # requests on concurrent threads, and two same-name deploys
+        # racing each other used to BOTH start a runtime — the loser
+        # leaked alive (scheduler thread and all), never retired, never
+        # shut down.  Ops are rare; correctness beats parallel deploys.
+        self._ops_lock = new_lock("SiddhiService._ops_lock")
         # ErrorStores of undeployed apps: frames admitted by the data
         # plane before an undeploy land here (never dropped), and stay
         # inspectable until the name is redeployed
@@ -334,7 +342,18 @@ class SiddhiService:
     # -- operations -------------------------------------------------------
 
     def deploy(self, app_text: str) -> str:
+        # the build runs OUTSIDE the ops lock (slow: device lowering);
+        # the swap of the live runtime under the name is what must not
+        # interleave with another deploy/undeploy of the same name
         rt = self.manager.create_app_runtime(app_text)
+        with self._ops_lock:
+            # a same-name redeploy shuts the old runtime down (bounded
+            # joins) while holding the ops lock: that wait IS the
+            # serialization — no other deploy may see the half-swapped name
+            # lint: allow (bounded teardown join under the ops lock by design)
+            return self._install(rt)
+
+    def _install(self, rt) -> str:
         name = rt.app.name
         # deploy-time lint (docs/ANALYSIS.md): the findings ride the
         # deploy response; @app:strictAnalysis apps never reach here
@@ -372,15 +391,18 @@ class SiddhiService:
         return name
 
     def undeploy(self, name: str) -> None:
-        rt = self.runtimes.pop(name)
-        self.diagnostics.pop(name, None)
-        # retire FIRST: the data plane serializes this against in-flight
-        # feeds, so every admitted frame either reached the live runtime
-        # or lands whole in the (parked) ErrorStore — never dropped
-        if self.net is not None:
-            self.net.retire(rt)
-        self._park_errors(name, rt.error_store)
-        rt.shutdown()
+        with self._ops_lock:
+            rt = self.runtimes.pop(name)
+            self.diagnostics.pop(name, None)
+            # retire FIRST: the data plane serializes this against
+            # in-flight feeds, so every admitted frame either reached the
+            # live runtime or lands whole in the (parked) ErrorStore —
+            # never dropped
+            if self.net is not None:
+                self.net.retire(rt)
+            self._park_errors(name, rt.error_store)
+            # lint: allow (bounded teardown join under the ops lock by design)
+            rt.shutdown()
 
     def _park_errors(self, name: str, store) -> None:
         """Park a retiring runtime's ErrorStore under its app name.  A
@@ -666,9 +688,11 @@ class SiddhiService:
         # outstanding handler threads: bounded join, so teardown never
         # wedges a test run behind a stuck keep-alive
         self.httpd.join_handlers(timeout=5.0)
-        for rt in list(self.runtimes.values()):
-            rt.shutdown()
-        self.runtimes.clear()
+        with self._ops_lock:    # a straggler undeploy must not interleave
+            for rt in list(self.runtimes.values()):
+                # lint: allow (bounded teardown join under the ops lock)
+                rt.shutdown()
+            self.runtimes.clear()
 
 
 if __name__ == "__main__":

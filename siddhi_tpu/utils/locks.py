@@ -1,0 +1,266 @@
+"""Named lock factories + the runtime lock-witness.
+
+Every lock the engine creates goes through `new_lock(name)` /
+`new_rlock(name)` with a canonical name (`"ClassName._attr"` for
+instance locks, `"module.CONST"` for module-level ones) matching the
+node names the static concurrency analyzer
+(`siddhi_tpu/analysis/concurrency.py`) derives from the source.  In
+normal operation the factories return plain `threading.Lock`/`RLock`
+objects — zero overhead, zero behavior change.
+
+With `SIDDHI_LOCK_CHECK=1` in the environment they return *witness*
+wrappers instead: every acquisition records, per thread, which other
+named locks were already held, building the ACTUAL acquisition-order
+graph the process exhibits.  That graph is the ground truth the static
+analyzer's model (`--threads` SL04 lock-order pass) is validated
+against — the analyzer is trusted only as far as the witness agrees
+with it:
+
+    SIDDHI_LOCK_CHECK=1 SIDDHI_LOCK_WITNESS_OUT=/tmp/w.json \
+        python -m pytest tests/test_net_admission.py -q
+    python -m siddhi_tpu.analysis --threads --witness /tmp/w.json
+
+The second command exits non-zero if any witnessed acquisition order
+contradicts the static graph (reversed edge, or an edge between two
+statically-known locks the model missed) — see docs/ANALYSIS.md
+"Concurrency self-analysis".
+
+The witness also trips a HARD failure on a dynamically observed
+cycle: if thread A acquires X→Y while the recorded graph already holds
+Y→…→X, the acquire raises `LockOrderError` immediately (under the
+check flag only) — a deadlock that would otherwise need two unlucky
+threads to manifest becomes a deterministic test failure.
+
+This module must stay dependency-free (threading/os/json only): it is
+imported by every core/net module at startup.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional
+
+ENV_FLAG = "SIDDHI_LOCK_CHECK"
+ENV_OUT = "SIDDHI_LOCK_WITNESS_OUT"
+
+
+def check_enabled() -> bool:
+    v = os.environ.get(ENV_FLAG, "")
+    return v not in ("", "0", "false", "off")
+
+
+class LockOrderError(RuntimeError):
+    """The witness observed an acquisition order that completes a cycle
+    with previously observed orders — a potential deadlock."""
+
+
+class LockWitness:
+    """Process-wide recorder of (outer, inner) lock acquisition pairs.
+
+    Thread-safe; the held-stack is thread-local.  `edges()` is the
+    observed order relation; `locks()` every named lock that was
+    acquired at least once."""
+
+    def __init__(self):
+        self._mutex = threading.Lock()          # guards the graphs only
+        self._edges: set = set()                # (outer, inner) names
+        self._locks: set = set()
+        self._succ: dict = {}                   # outer -> set(inner)
+        self._tls = threading.local()
+
+    # -- recording ----------------------------------------------------------
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _reaches_locked(self, src: str, dst: str) -> bool:
+        """Is there a recorded path src -> ... -> dst?  (Caller holds
+        self._mutex; the graphs are small — dozens of nodes.)"""
+        seen, todo = set(), [src]
+        while todo:
+            n = todo.pop()
+            if n == dst:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            todo.extend(self._succ.get(n, ()))
+        return False
+
+    def on_acquired(self, name: str) -> None:
+        stack = self._stack()
+        outer = stack[-1] if stack else None
+        with self._mutex:
+            self._locks.add(name)
+            if outer is not None and outer != name:
+                if (outer, name) not in self._edges:
+                    if self._reaches_locked(name, outer):
+                        # completing a cycle: this order, combined with
+                        # an order some other code path already
+                        # exhibited, can deadlock.  Raised BEFORE the
+                        # name goes on the held stack, so the wrapper's
+                        # cleanup leaves the witness state consistent
+                        raise LockOrderError(
+                            f"lock-order inversion: acquiring {name!r} "
+                            f"while holding {outer!r}, but the reverse "
+                            f"order {name!r} -> ... -> {outer!r} was "
+                            f"already witnessed")
+                    self._edges.add((outer, name))
+                    self._succ.setdefault(outer, set()).add(name)
+        stack.append(name)
+
+    def on_released(self, name: str) -> None:
+        stack = self._stack()
+        # release order need not be LIFO (rare but legal): drop the
+        # most recent matching entry
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == name:
+                del stack[i]
+                return
+
+    # -- reporting ----------------------------------------------------------
+
+    def edges(self) -> set:
+        with self._mutex:
+            return set(self._edges)
+
+    def locks(self) -> set:
+        with self._mutex:
+            return set(self._locks)
+
+    def reset(self) -> None:
+        with self._mutex:
+            self._edges.clear()
+            self._locks.clear()
+            self._succ.clear()
+
+    def to_dict(self) -> dict:
+        with self._mutex:
+            return {"locks": sorted(self._locks),
+                    "edges": sorted(list(e) for e in self._edges)}
+
+    def dump(self, path: str) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self.to_dict(), f, indent=1)
+        os.replace(tmp, path)
+
+    def merge_dump(self, path: str) -> None:
+        """Dump, merging with whatever a previous process already wrote
+        there — several test processes can share one witness file.  The
+        read-merge-write runs under an flock'd sidecar so two processes
+        exiting together cannot clobber each other's edges (a lost edge
+        cannot fail the --witness gate, so the loss would be invisible)."""
+        lock_path = path + ".lock"
+        lock_f = open(lock_path, "a+")
+        try:
+            try:
+                import fcntl
+                fcntl.flock(lock_f.fileno(), fcntl.LOCK_EX)
+            except ImportError:         # non-POSIX: best-effort
+                pass
+            data = self.to_dict()
+            try:
+                with open(path, encoding="utf-8") as f:
+                    prev = json.load(f)
+                data["locks"] = sorted(set(data["locks"])
+                                       | set(prev["locks"]))
+                data["edges"] = sorted({tuple(e) for e in data["edges"]}
+                                       | {tuple(e) for e in prev["edges"]})
+                data["edges"] = [list(e) for e in data["edges"]]
+            except (OSError, ValueError, KeyError):
+                pass
+            tmp = path + f".tmp{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(data, f, indent=1)
+            os.replace(tmp, path)
+        finally:
+            lock_f.close()              # releases the flock
+
+
+_WITNESS = LockWitness()
+_ATEXIT_ARMED = False
+
+
+def witness() -> LockWitness:
+    return _WITNESS
+
+
+def _arm_atexit() -> None:
+    global _ATEXIT_ARMED
+    if _ATEXIT_ARMED:
+        return
+    out = os.environ.get(ENV_OUT)
+    if not out:
+        return
+    import atexit
+    atexit.register(lambda: _WITNESS.merge_dump(out))
+    _ATEXIT_ARMED = True
+
+
+class _WitnessLockBase:
+    """Context-manager wrapper over a real lock, reporting to the
+    witness.  Mirrors the small Lock surface the engine uses
+    (acquire/release/with; RLock adds reentrancy via the inner lock)."""
+
+    def __init__(self, name: str, inner):
+        self.name = name
+        self._inner = inner
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            try:
+                _WITNESS.on_acquired(self.name)
+            except BaseException:
+                # a LockOrderError must not leave the real lock held —
+                # the test that provoked it should fail, not wedge
+                self._inner.release()
+                raise
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        _WITNESS.on_released(self.name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<witness {type(self._inner).__name__} {self.name!r}>"
+
+
+class _WitnessLock(_WitnessLockBase):
+    def locked(self) -> bool:           # plain Lock surface only —
+        return self._inner.locked()     # RLock has no locked() here
+
+
+class _WitnessRLock(_WitnessLockBase):
+    def _is_owned(self) -> bool:        # runtime.flush() introspects this
+        return self._inner._is_owned()
+
+
+def new_lock(name: str):
+    """A `threading.Lock`, witness-wrapped under SIDDHI_LOCK_CHECK=1.
+    `name` must match the static analyzer's node name for the
+    construction site: `"ClassName._attr"` / `"module.CONST"`."""
+    if not check_enabled():
+        return threading.Lock()
+    _arm_atexit()
+    return _WitnessLock(name, threading.Lock())
+
+
+def new_rlock(name: str):
+    """A `threading.RLock`, witness-wrapped under SIDDHI_LOCK_CHECK=1."""
+    if not check_enabled():
+        return threading.RLock()
+    _arm_atexit()
+    return _WitnessRLock(name, threading.RLock())

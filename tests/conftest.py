@@ -42,6 +42,33 @@ def pytest_configure(config):
         "excluded from tier-1 via -m 'not slow'")
 
 
+import pytest
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _siddhi_thread_leak_gate():
+    """Thread-leak gate (docs/ANALYSIS.md "Concurrency self-analysis"):
+    every engine thread is named `siddhi-<role>` (the SL06 lint holds
+    that), so a NON-daemon siddhi-* thread still alive after the whole
+    session tore its runtimes/services down is a leak — some shutdown
+    path stopped joining it.  Daemon threads are exempt (process exit
+    reaps them by design).  A failure here fails tier-1."""
+    yield
+    import threading
+    import time
+    deadline = time.time() + 2.0        # teardown joins may still settle
+    while True:
+        leaked = [t for t in threading.enumerate()
+                  if t.name.startswith("siddhi-") and not t.daemon
+                  and t.is_alive()]
+        if not leaked or time.time() >= deadline:
+            break
+        time.sleep(0.1)
+    assert not leaked, (
+        "non-daemon siddhi-* threads outlived the session (a shutdown "
+        f"path stopped joining them): {sorted(t.name for t in leaked)}")
+
+
 # isolate the execution-geometry tuning cache (core/autotune.py): the
 # suite must neither trust nor pollute a developer's persisted winners
 if "SIDDHI_TUNE_CACHE" not in os.environ:
