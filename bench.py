@@ -170,6 +170,13 @@ def p99_latency(app, stream, tape, keys, out_stream="Out", warm=10):
             lat.clear()
         t_start[0] = time.perf_counter()
         h.send_batch(cols, ts)
+        if i >= warm:
+            # unconditional per-batch flush inside the timed window:
+            # every batch's deliveries land while ITS t_start is live,
+            # so the histogram can neither attribute a batch's latency
+            # to the next batch's clock nor end up empty (the frontier
+            # "p99_ms": null failure shape, BENCH_r05)
+            rt.flush()
     rt.flush()                  # deliver anything still in flight
     mgr.shutdown()
     return round(float(np.percentile(lat, 99)), 1) if lat else None
@@ -609,7 +616,8 @@ def kernel_eps(app, family, batch, keys=8, dt_ms=1, reps=6, info=None):
         def count(args):
             ev = args[1]
             if "__nev__" in ev:
-                return int(ev["__nev__"])
+                # lane-vmapped blocks carry per-lane counts (L,)
+                return int(np.asarray(ev["__nev__"]).sum())
             return int(np.asarray(ev["__valid__"]).sum())
     else:
         raise ValueError(family)
@@ -821,7 +829,16 @@ def autotune_bench(smoke=False):
                 "hand": Geometry(batch=1 << 18, pipeline_depth=0),
                 "grid": [Geometry(batch=1 << 16, pipeline_depth=0),
                          Geometry(batch=1 << 17, pipeline_depth=0),
-                         Geometry(batch=1 << 18, pipeline_depth=0)]},
+                         Geometry(batch=1 << 18, pipeline_depth=0),
+                         # plan-family axis over the PARTITIONED lanes
+                         # (ISSUE 13): the lane-vmapped scan family vs
+                         # the per-key sequential state kernel — the
+                         # sweep's output-invariance check doubles as
+                         # the partitioned cross-family differential
+                         Geometry(batch=1 << 18, pipeline_depth=0,
+                                  plan_family="scan"),
+                         Geometry(batch=1 << 18, pipeline_depth=0,
+                                  plan_family="seq")]},
             "6_join": {
                 "app": JOIN_APP, "keys": 1000,
                 "hand": Geometry(batch=2048, pipeline_depth=3),
@@ -1973,15 +1990,29 @@ def _print_summary(summary: dict, cap: int = 2048) -> None:
     bounded to `cap` bytes: drivers keep only a stdout tail and parse
     its last line, so an oversized line truncates into garbage (the
     BENCH "parsed": null failure shape).  Oversize degrades by dropping
-    detail keys — never by emitting an unparseable line."""
+    detail keys — never by emitting an unparseable line.  The bound is
+    HARD: if dropping detail keys still leaves the line over cap (or a
+    value fails to serialize), a minimal headline line prints instead,
+    so the last stdout line ALWAYS round-trips through json.loads
+    (pinned by scripts/smoke.sh and tests/test_bench_summary.py)."""
     drop_order = ("stage_shares_config3", "configs", "roofline",
-                  "transport", "trace_coverage_config3")
-    line = json.dumps(summary)
-    for key in drop_order:
-        if len(line) <= cap:
-            break
-        summary.pop(key, None)
+                  "transport", "trace_coverage_config3", "durability",
+                  "placement")
+    try:
         line = json.dumps(summary)
+        for key in drop_order:
+            if len(line) <= cap:
+                break
+            summary.pop(key, None)
+            line = json.dumps(summary)
+    except (TypeError, ValueError):        # non-serializable value crept in
+        line = None
+    if line is None or len(line) > cap:
+        line = json.dumps({k: summary.get(k) for k in
+                           ("metric", "value", "unit", "vs_baseline",
+                            "detail")
+                           if isinstance(summary.get(k),
+                                         (str, int, float, type(None)))})
     sys.stderr.flush()
     print(line, flush=True)
 
@@ -1989,8 +2020,17 @@ def _print_summary(summary: dict, cap: int = 2048) -> None:
 def pattern_families_smoke() -> dict:
     """`bench.py --family-smoke` (scripts/smoke.sh): one eligible pattern
     per plan family, run differentially against the host interpreter —
-    a lowering regression in any family fails fast, in CI time budget."""
+    a lowering regression in any family fails fast, in CI time budget.
+    Includes the ISSUE-13 lowerings: a count-quantifier cell (rank/
+    select chase) and a partitioned-lanes parity cell (the lane-vmapped
+    flat block vs per-key host clones)."""
     from siddhi_tpu import SiddhiManager
+
+    C_COUNT = STOCK + (
+        "@info(name='q') from every e1=StockStream[price > 110]<1:3> -> "
+        "e2=StockStream[price < 95] within 1 sec "
+        "select e1[0].price as a, e1[last].price as b, e2.price as c "
+        "insert into Out;\n")
 
     CASES = {
         # family -> (annotation head, query): each query is eligible for
@@ -1999,9 +2039,11 @@ def pattern_families_smoke() -> dict:
         "chunk": ("@app:patternFamily('chunk')\n", C3),
         "scan": ("@app:patternFamily('scan')\n", C3),
         "dfa": ("@app:patternFamily('dfa')\n", C3S),
+        "scan_count": ("@app:patternFamily('scan')\n", C_COUNT),
+        "dfa_count": ("@app:patternFamily('dfa')\n", C_COUNT),
     }
 
-    def run(app, n=1024, batch=256):
+    def run(app, n=1024, batch=256, keys=8, sort=False):
         mgr = SiddhiManager()
         rt = mgr.create_app_runtime(app)
         rows = []
@@ -2012,22 +2054,35 @@ def pattern_families_smoke() -> dict:
         from siddhi_tpu.core.pattern_plan import DevicePatternPlan
         fam = next((p.family for p in rt._plans
                     if isinstance(p, DevicePatternPlan)), None)
-        tape = make_tape(n, batch)
-        for cols, ts in _columnar(rt, STREAM, tape, 8):
+        tape = make_tape(n, batch, keys=keys)
+        for cols, ts in _columnar(rt, STREAM, tape, keys):
             h.send_batch(cols, ts)
         rt.flush()
         mgr.shutdown()
-        return fam, rows
+        return fam, sorted(rows) if sort else rows
 
     out = {"families": {}, "pass": True}
-    for fam, (ann, q) in CASES.items():
+    for cell, (ann, q) in CASES.items():
+        fam = cell.split("_")[0]
         used, dev = run(ann + DEV["patterns"] + q)
         _u, host = run(HOST["patterns"] + q)
         ok = used == fam and dev == host and len(dev) > 0
-        out["families"][fam] = {"engaged": used, "matches": len(dev),
-                                "host_matches": len(host),
-                                "identical": dev == host, "pass": ok}
+        out["families"][cell] = {"engaged": used, "matches": len(dev),
+                                 "host_matches": len(host),
+                                 "identical": dev == host, "pass": ok}
         out["pass"] = out["pass"] and ok
+
+    # partitioned-lanes parity: config 4's shape at smoke scale, default
+    # family selection (must be a parallel one), per-key host clones as
+    # the oracle; cross-key delivery order is not defined -> sorted
+    used, dev = run("@app:partitionCapacity(64)\n" + C4,
+                    keys=48, sort=True)
+    _u, host = run(HOST["patterns"] + C4, keys=48, sort=True)
+    ok = used in ("scan", "dfa") and dev == host and len(dev) > 0
+    out["families"]["partitioned_lanes"] = {
+        "engaged": used, "matches": len(dev), "host_matches": len(host),
+        "identical": dev == host, "pass": ok}
+    out["pass"] = out["pass"] and ok
     return out
 
 
@@ -2401,8 +2456,9 @@ def main(argv=None):
                            if breakdown.get(k, {}).get("bound") else {})}
                     for k, v in configs.items()},
         # durability column (sync policy + measured overhead vs 'off'):
-        # kept OUT of the oversize drop_order, like placement, so the
-        # exactly-once serving trade always survives into the final line
+        # LAST in the oversize drop_order, like placement, so the
+        # exactly-once serving trade survives into the final line unless
+        # nothing else is left to drop (a parseable line always wins)
         "durability": ({"policy": dur_res.get("policy"),
                         "overhead_pct": dur_res.get("overhead_pct"),
                         "tcp_eps": (dur_res.get("tcp_eps") or {}).get(
@@ -2410,8 +2466,8 @@ def main(argv=None):
                        if dur_res else None),
         # device/interpreter query counts per config (placement plane,
         # docs/ANALYSIS.md): a future silent demotion shifts these
-        # numbers in the bench trajectory — kept OUT of the oversize
-        # drop_order so the column always survives into the final line
+        # numbers in the bench trajectory — dropped only as the final
+        # resort before the minimal-headline fallback
         "placement": {k: "{}d/{}i/{}dem".format(
                           v["placement"].get("device", 0),
                           v["placement"].get("interpreter", 0),

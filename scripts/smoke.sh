@@ -308,7 +308,8 @@ SIDDHI_TUNE_CACHE="$(mktemp -u /tmp/siddhi_tune_smoke.XXXXXX.json)" \
 
 echo "== plan-family parity smoke =="
 # bench.py --family-smoke: one eligible pattern per NFA plan family
-# (seq / chunk / scan / dfa), each run differentially against the host
+# (seq / chunk / scan / dfa), plus the ISSUE-13 count-quantifier and
+# partitioned-lanes cells, each run differentially against the host
 # interpreter — a lowering regression in any family fails fast here
 # instead of surfacing as wrong matches in production
 python bench.py --family-smoke
@@ -316,8 +317,16 @@ python bench.py --family-smoke
 echo "== pipelined-vs-unpipelined bench smoke =="
 # bench.py --smoke: short pipelined-vs-unpipelined run over the
 # multi-plan overlap config; asserts identical match counts and prints
-# the eps delta + overlap_ratio, so dispatch-pipeline regressions
-# surface in tier-1 time budget
-python bench.py --smoke
+# the eps delta + overlap_ratio.  The LAST stdout line must round-trip
+# through json.loads — the bench driver parses exactly that line, and
+# an unparseable tail is the BENCH "parsed": null failure shape
+python bench.py --smoke | tee /tmp/_bench_smoke.out
+python - <<'EOF'
+import json
+line = open("/tmp/_bench_smoke.out").read().strip().splitlines()[-1]
+parsed = json.loads(line)          # raises -> smoke fails
+assert isinstance(parsed, dict) and "metric" in parsed, parsed
+print("OK: bench --smoke last line parses:", parsed["metric"])
+EOF
 
 echo "smoke: PASS"

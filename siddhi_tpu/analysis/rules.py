@@ -353,14 +353,6 @@ def _rule_sa08_ineligible_family(ctx, out):
     for name, q, part in ctx.queries:
         if not isinstance(q.input, ast.StateInputStream):
             continue
-        if part is not None:
-            out.append(_finding(
-                "SA08",
-                f"@app:patternFamily({fam!r}) on a partitioned pattern: "
-                f"partitioned lanes hold persistent per-key state — "
-                f"only the sequential kernel applies; the build falls "
-                f"back", name))
-            continue
         schemas = {}
         missing = False
         for sid in input_stream_ids(q):
@@ -371,7 +363,11 @@ def _rule_sa08_ineligible_family(ctx, out):
             schemas[sid] = s
         if missing:
             continue
-        verdict = classify_shape(q.input, schemas, StringTable()).get(fam)
+        # partitioned patterns apply the lane-vmap gates (chunk's lane
+        # axis is spent on partition keys; non-`every` arms need per-key
+        # state) — classify_shape mirrors pattern_plan's build gates
+        verdict = classify_shape(q.input, schemas, StringTable(),
+                                 partitioned=part is not None).get(fam)
         if verdict is not True and fam in ("chunk", "scan", "dfa"):
             out.append(_finding(
                 "SA08",

@@ -6,52 +6,75 @@ chain, not by math (BENCH_r05: ~0.01-0.02x the single-thread C++ roofline
 on the P=1 pattern configs).  *Simultaneous Finite Automata* (arXiv
 1405.0562) breaks that chain: simulate the automaton from EVERY state,
 compose per-event transition functions associatively, and the whole
-block collapses to log-depth scans.  For the linear chains this module
-accepts, the composed transition function factorizes — "the earliest
-completion reachable from state k at time t" is fully determined by
-per-position *next-match pointers*, so the SFA composition lowers to:
+block collapses to log-depth scans.  First-match semantics make the
+composed transition function DETERMINISTIC given a head event, so the
+SFA composition factorizes into per-state primitives answered in
+O(log T) each:
 
-  * a reverse `jax.lax.associative_scan` (min semiring) per position for
-    statically-maskable transitions (the per-event predicate matrix is
-    precomputed outside the scan, exactly like the sequential kernel's
-    pre-masks), and
-  * a vectorized segment-tree descent for *threshold* transitions —
-    capture-dependent filters of the monotone comparison form
-    `attr > f(earlier captures)` (the BENCH config-3/4 shape
+  * next-match pointers for statically-maskable transitions — a reverse
+    `jax.lax.associative_scan` (min semiring) per chase node;
+  * a vectorized perfect-segment-tree descent for *threshold*
+    transitions — capture-dependent filters of the monotone comparison
+    form `attr > f(earlier captures)` (the BENCH config-3/4 shape
     `e2.price > e1.price`), answered as "first index >= s whose masked
     value beats v" in O(log T) gathers per hop, batched over every
-    pending instance at once.
+    pending instance at once;
+  * rank/select over occurrence-count prefix sums for `<m:n>` count
+    quantifiers — "the min-th occurrence after entry" is one segment
+    tree query on the monotone cumulative-count array (the bit-packed
+    state-SET lowering of arXiv 2210.10077 collapsed onto the counter
+    lattice: the u32 frontier word's reachable set is an interval, so
+    its boundary IS the rank);
+  * forward prev-match scans (max semiring) for logical AND/OR partner
+    pairs — "done" is the min (or) / max (and) of the two sides' first
+    matches, captures re-resolve to the LAST side match at or before
+    the done event, exactly like the sequential kernel's re-capturing
+    station.
 
 Two plan families are built on these primitives:
 
   * family "scan" — the SFA lowering above, O(S log T) depth.
   * family "dfa"  — NFA->DFA/hybrid lowering (arXiv 2210.10077) with
     state-set compaction and bit-packed transitions: the per-event
-    position masks pack into one u32 *symbol word* (bit k = event
-    matches position k), blocks of STRIDE=4 events precompose into
+    chase-node masks pack into one u32 *symbol word* (bit k = event
+    matches chase node k), blocks of STRIDE=4 events precompose into
     dense per-block transition tables (first-hit offsets for all
-    positions bit-packed into one u32 per block), and the block-level
+    chase nodes bit-packed into one u32 per block), and the block-level
     next pointers ride ONE associative scan over T/4 elements — a
     multi-stride dense table walk instead of per-event stepping
-    (cf. 2209.05686, CAMA 2112.00267).  Threshold hops share the
-    segment-tree machinery (the "hybrid" part).
+    (cf. 2209.05686, CAMA 2112.00267).  Threshold and count hops share
+    the segment-tree machinery (the "hybrid" part).
 
 Eligibility (classify_parallel) is strict and *sound*: anything outside
 the supported algebra reports a reason string and the planner keeps the
 sequential kernel (or the chunked-halo mode) — the families never guess.
-Match semantics of the eligible class (every-head linear chains of
-(1,1) stream positions, within-bounded): each head-matching event arms
-one instance; an instance at position k advances on the FIRST later
-event matching position k (the slot is then consumed), expiring instead
-when that event arrives past the position's `within` horizon.  The
-next-pointer chase reproduces exactly that — one candidate completion
-per head — so outputs are byte-identical to the sequential kernel and
-the host oracle (asserted by tests/test_plan_families.py).
+The accepted algebra (byte-identical to the sequential kernel, asserted
+by tests/test_plan_families.py):
+
+  * linear chains of stream positions, within-bounded, `every` or
+    single-arm (non-`every`) heads;
+  * (1,1) positions with event-only filters plus at most one monotone
+    threshold conjunct below the head;
+  * `<m:n>` count quantifiers (min >= 1; unbounded max allowed except
+    in the final position), event-only filters, incl. count heads and
+    indexed capture reads (e1[0] / e1[last] / e1[last-1]);
+  * logical AND/OR partner pairs of two stream nodes below the head,
+    event-only filters (OR's unmatched side null-reconstructs through
+    the presence rows, like the sequential kernel);
+  * strict sequences (`,` succession): each hop reads the immediately
+    next event, so capture-dependent filters are evaluated directly —
+    arbitrary conjunctions allowed;
+  * fused multi-query lanes (per-lane `__qparam` constants) and
+    partitioned per-key lanes, both via ONE vmap of the flat block
+    over the lane axis (pattern_plan ships (L, F) grids).
 
 Cross-flush continuity reuses the chunked-halo harness in
 pattern_plan.py: blocks are stateless, the last `within` window of
 events replays at the next flush, and completions at or before the
-previous flush's last seq are suppressed on device.
+previous flush's last seq are suppressed on device (per lane, for
+partitioned grids).  Non-`every` chains additionally report a per-lane
+resolution flag in the meta row so the host stops dispatching once the
+single arm has definitively completed or died.
 """
 from __future__ import annotations
 
@@ -65,13 +88,16 @@ from jax import lax
 
 from ..query import ast
 from .expr import (ExprError, compile_expression, compute_dtypes)
-from .nfa_device import (ChainSpec, NFAKernel, _hi32, _lo32, _I32,
-                         pow2_at_least)
+from .nfa_device import (ChainSpec, NFAKernel, _base_ref, _hi32, _lo32,
+                         _I32, pow2_at_least)
 
 STRIDE = 4                # dfa family: events per precomposed transition
 _OFF_BITS = 3             # bits per packed first-hit offset (0..STRIDE)
 NUMERIC = (ast.AttrType.INT, ast.AttrType.LONG,
            ast.AttrType.FLOAT, ast.AttrType.DOUBLE)
+UNBOUNDED = 10 ** 9       # NFACompiler's normalization of <m:> counts
+# single-arm (non-`every`) resolution flag, meta row slot 4
+ARM_NONE, ARM_PENDING, ARM_RESOLVED = 0, 1, 2
 
 
 class ParallelUnsupported(Exception):
@@ -88,29 +114,46 @@ class HopThreshold:
 
 
 @dataclass
-class Hop:
-    """One chain position lowered for the pointer chase."""
+class HopNode:
+    """One lowered stream node inside a chase position."""
     ref: str
     scode: int
-    within_ms: Optional[int]
     pre_conjs: list = field(default_factory=list)   # CompiledExpr, event-only
     threshold: Optional[HopThreshold] = None
+    step_conjs: list = field(default_factory=list)  # sequence-mode direct eval
 
     @property
     def is_static(self) -> bool:
-        return self.threshold is None
+        return self.threshold is None and not self.step_conjs
+
+
+@dataclass
+class PPos:
+    """One chain position lowered for the state chase."""
+    kind: str                     # "single" | "count" | "logical"
+    nodes: list                   # [HopNode]; 2 for logical
+    within_ms: int = 0
+    op: Optional[str] = None      # "and" | "or" (logical)
+    min_count: int = 1
+    max_count: int = 1
 
 
 @dataclass
 class ParallelProgram:
-    hops: list                    # [Hop], index = chain position
+    positions: list               # [PPos], index = chain position
     stream_ids: list
     schemas: dict                 # ref -> StreamSchema
-    ref_pos: dict                 # ref -> position index
+    ref_of: dict                  # ref -> (position index, node index)
+    sequence: bool = False        # strict `,` succession
+    single_arm: bool = False      # non-`every` head (one instance ever)
 
     @property
     def S(self) -> int:
-        return len(self.hops)
+        return len(self.positions)
+
+    @property
+    def count_refs(self) -> set:
+        return {p.nodes[0].ref for p in self.positions if p.kind == "count"}
 
 
 _FLIP = {"gt": "lt", "ge": "le", "lt": "gt", "le": "ge"}
@@ -133,52 +176,121 @@ def _own_var(e, node, schemas) -> Optional[str]:
 
 def lower_parallel(spec: ChainSpec, strings,
                    param_extra: Optional[dict] = None) -> ParallelProgram:
-    """Lower a ChainSpec into a pointer-chase program, or raise
+    """Lower a ChainSpec into a state-chase program, or raise
     ParallelUnsupported with the (human-readable) ineligibility reason.
-    The accepted algebra is the provably-equivalent subset: every-head
-    linear chains of single (1,1) stream positions, within-bounded, with
-    event-only filters plus at most one monotone threshold conjunct per
-    non-head position."""
-    if spec.is_sequence:
-        raise ParallelUnsupported("strict sequence (`,` succession)")
-    if not spec.every_head:
-        raise ParallelUnsupported("non-`every` head (single stateful arm)")
+    See the module docstring for the accepted algebra."""
     if spec.S < 2:
         raise ParallelUnsupported("single-position chain (no scan depth)")
-    hops: list = []
-    ref_pos: dict = {}
+    sequence = bool(spec.is_sequence)
+    single_arm = not spec.every_head
+    positions: list = []
+    ref_of: dict = {}
+    count_refs: set = set()
+    or_refs: set = set()
+    S = spec.S
     for pi, pos in enumerate(spec.positions):
-        if pos.op is not None:
-            raise ParallelUnsupported("logical and/or position")
-        if pos.is_count:
-            raise ParallelUnsupported("count quantifier <m:n>")
-        n = pos.nodes[0]
-        if n.kind != "stream":
-            raise ParallelUnsupported("absent (`not ... for`) position")
+        for n in pos.nodes:
+            if n.kind != "stream":
+                raise ParallelUnsupported("absent (`not ... for`) position")
         if pos.sticky and pi > 0:
             raise ParallelUnsupported("`every` below the head")
         if pos.within_ms is None:
             raise ParallelUnsupported(
                 "position without a `within` bound (stateless tail replay "
                 "needs a finite horizon)")
-        hop = Hop(n.ref, n.scode, pos.within_ms, list(n.pre_conjs))
-        if n.step_conjs:
+        if pos.op is not None:
             if pi == 0:
-                raise ParallelUnsupported("head filter reads captures")
-            if len(n.step_conjs) > 1:
+                raise ParallelUnsupported("logical and/or head")
+            if sequence:
                 raise ParallelUnsupported(
-                    "multiple capture-dependent conjuncts on one position "
-                    "(first-match of a conjunction is not decomposable)")
-            hop.threshold = _lower_threshold(
-                n, n.step_asts[0], spec, strings, param_extra, ref_pos)
-        hops.append(hop)
-        ref_pos[n.ref] = pi
-    return ParallelProgram(hops, list(spec.stream_ids), dict(spec.schemas),
-                           ref_pos)
+                    "logical and/or position in a strict sequence")
+            if pi > 0 and spec.positions[pi - 1].is_count:
+                raise ParallelUnsupported("logical position after a count "
+                                          "(no station to consume the arm)")
+            nodes = []
+            for n in pos.nodes:
+                if n.step_conjs:
+                    raise ParallelUnsupported(
+                        "capture-dependent filter on a logical position")
+                nodes.append(HopNode(n.ref, n.scode, list(n.pre_conjs)))
+            pp = PPos("logical", nodes, pos.within_ms, op=pos.op)
+            if pos.op == "or":
+                or_refs.update(n.ref for n in pos.nodes)
+        elif pos.is_count:
+            if sequence:
+                raise ParallelUnsupported(
+                    "count quantifier in a strict sequence")
+            if pos.min_count < 1:
+                raise ParallelUnsupported(
+                    "optional count quantifier (min 0 arms on entry)")
+            if pi > 0 and spec.positions[pi - 1].is_count:
+                raise ParallelUnsupported("adjacent count positions")
+            if pi == S - 1 and (pos.max_count >= UNBOUNDED
+                                or pos.max_count - pos.min_count + 1 > 8):
+                raise ParallelUnsupported(
+                    "unbounded or wide count in the final position "
+                    "(one emission lane per allowed occurrence)")
+            n = pos.nodes[0]
+            if n.step_conjs:
+                raise ParallelUnsupported(
+                    "capture-dependent filter on a count position")
+            pp = PPos("count", [HopNode(n.ref, n.scode, list(n.pre_conjs))],
+                      pos.within_ms, min_count=pos.min_count,
+                      max_count=pos.max_count)
+            count_refs.add(n.ref)
+        else:
+            n = pos.nodes[0]
+            hop = HopNode(n.ref, n.scode, list(n.pre_conjs))
+            if n.step_conjs:
+                if pi == 0:
+                    raise ParallelUnsupported("head filter reads captures")
+                if sequence:
+                    # the strict next event is KNOWN (j+1): evaluate the
+                    # conjunction directly, no monotonicity needed
+                    hop.step_conjs = list(n.step_conjs)
+                    _check_step_reads(n.step_conjs, n.ref, ref_of,
+                                      count_refs, param_extra)
+                else:
+                    if len(n.step_conjs) > 1:
+                        raise ParallelUnsupported(
+                            "multiple capture-dependent conjuncts on one "
+                            "position (first-match of a conjunction is not "
+                            "decomposable)")
+                    hop.threshold = _lower_threshold(
+                        n, n.step_asts[0], spec, strings, param_extra,
+                        ref_of, count_refs, or_refs)
+            pp = PPos("single", [hop], pos.within_ms)
+        positions.append(pp)
+        for ni, hn in enumerate(pp.nodes):
+            ref_of[hn.ref] = (pi, ni)
+    return ParallelProgram(positions, list(spec.stream_ids),
+                           dict(spec.schemas), ref_of, sequence=sequence,
+                           single_arm=single_arm)
+
+
+def _check_step_reads(step_conjs, own_ref, ref_of, count_refs, param_extra):
+    """Sequence-mode step conjuncts: reads must be the own event's
+    columns, earlier FROZEN captures, params, or __timestamp__."""
+    for ce in step_conjs:
+        for k in ce.reads:
+            if k == "__timestamp__" or (param_extra and k in param_extra):
+                continue
+            if "." not in k:
+                raise ParallelUnsupported(
+                    f"step filter reads non-capture key {k!r}")
+            base = _base_ref(k.split(".", 1)[0])[0]
+            if base == own_ref:
+                continue
+            if base in count_refs:
+                raise ParallelUnsupported(
+                    "step filter reads a still-collecting count capture")
+            if base not in ref_of:
+                raise ParallelUnsupported(
+                    f"step filter reads unresolved key {k!r}")
 
 
 def _lower_threshold(node, cond, spec, strings, param_extra,
-                     ref_pos) -> HopThreshold:
+                     ref_of, count_refs, or_refs=()) -> HopThreshold:
     """`own.attr OP expr(earlier captures)` -> HopThreshold, else raise."""
     from .nfa_device import PatternFilterContext
     if not isinstance(cond, ast.Compare) or cond.op not in _OPN:
@@ -207,14 +319,26 @@ def _lower_threshold(node, cond, spec, strings, param_extra,
     if rhs.type not in NUMERIC:
         raise ParallelUnsupported("threshold rhs is not numeric")
     ok_reads = set()
-    for r, pi in ref_pos.items():
+    for r in ref_of:
         for a in spec.schemas[r].attributes:
             ok_reads.add(f"{r}.{a.name}")
+    if param_extra:
+        ok_reads.update(param_extra)
     bad = set(rhs.reads) - ok_reads
     if bad:
         raise ParallelUnsupported(
             f"threshold rhs reads non-capture keys {sorted(bad)!r} "
             f"(own event / timestamp / later positions)")
+    for k in rhs.reads:
+        if "." not in k:
+            continue
+        base = _base_ref(k.split(".", 1)[0])[0]
+        if base in count_refs:
+            raise ParallelUnsupported(
+                "threshold rhs reads a still-collecting count capture")
+        if base in or_refs:
+            raise ParallelUnsupported(
+                "threshold rhs reads a maybe-absent `or` capture")
     return HopThreshold(f"{node.ref}.{attr}", op, rhs, own_t)
 
 
@@ -226,15 +350,31 @@ def classify_parallel(spec: ChainSpec, kernel: NFAKernel, strings,
     by the forced-fallback tests)."""
     try:
         prog = lower_parallel(spec, strings, param_extra)
-        if kernel.params or kernel.emit_qid:
-            raise ParallelUnsupported("per-lane query parameters "
-                                      "(fused multi-query kernel)")
+        count_refs = prog.count_refs
+        logical_refs = {n.ref for p in prog.positions
+                        if p.kind == "logical" for n in p.nodes}
         for ce in (list(kernel.sel_fns.values())
                    + ([kernel.having] if kernel.having else [])):
+            is_having = kernel.having is not None and ce is kernel.having
             for k in ce.reads:
-                if "." in k and "[" in k.split(".", 1)[0]:
+                if "." not in k or k.startswith("__"):
+                    continue
+                refpart = k.split(".", 1)[0]
+                base, cidx = _base_ref(refpart)
+                if cidx is not None:
+                    if base in count_refs and (
+                            cidx in ("last", "last-1") or cidx.isdigit()):
+                        pass            # rank/select-resolvable
+                    elif cidx == "last" and base in prog.ref_of:
+                        pass            # [last] over a (1,1) ref == plain
+                    else:
+                        raise ParallelUnsupported(
+                            f"indexed capture read {k!r} outside a count "
+                            f"position")
+                if is_having and base in logical_refs:
                     raise ParallelUnsupported(
-                        f"indexed capture read {k!r} in selector/having")
+                        "having reads a capture of a logical (maybe-"
+                        "absent) position")
     except ParallelUnsupported as e:   # lint: allow-swallow (the reason
         # string IS the demotion record — the planner surfaces it via
         # plan.families / rt.explain())
@@ -242,23 +382,44 @@ def classify_parallel(spec: ChainSpec, kernel: NFAKernel, strings,
     return _classify_prog(prog)
 
 
+def _chase_lanes(prog: ParallelProgram) -> list:
+    """Static chase nodes (pi, ni) that resolve via next-match pointers —
+    the dfa family's bit-packable symbol lanes.  Count positions resolve
+    via rank/select and threshold hops via the segment tree; neither
+    consumes a symbol bit."""
+    lanes = []
+    for pi, pos in enumerate(prog.positions):
+        if pi == 0:
+            continue
+        if pos.kind == "single" and pos.nodes[0].is_static:
+            lanes.append((pi, 0))
+        elif pos.kind == "logical":
+            lanes.extend((pi, ni) for ni in range(len(pos.nodes)))
+    return lanes
+
+
 def _classify_prog(prog: ParallelProgram) -> dict:
-    """Family verdicts for a successfully-lowered pointer-chase program
-    (shared between the built-kernel classifier above and the
-    analysis-time classify_shape below)."""
+    """Family verdicts for a successfully-lowered chase program (shared
+    between the built-kernel classifier above and the analysis-time
+    classify_shape below)."""
     out = {"scan": True}
-    if prog.S > 8:
+    lanes = _chase_lanes(prog)
+    if prog.sequence:
+        out["dfa"] = ("strict sequence (consecutive-event steps leave "
+                      "nothing to bit-pack)")
+    elif len(lanes) > 8:
         out["dfa"] = ("more than 8 positions (symbol words bit-pack one "
                       "position per u32 lane bit)")
-    elif not any(h.is_static for h in prog.hops[1:]):
+    elif not lanes:
         out["dfa"] = ("no static transition to bit-pack (every hop is "
-                      "threshold-dependent)")
+                      "threshold- or count-dependent)")
     else:
         out["dfa"] = True
     return out
 
 
-def classify_shape(state_input, schemas, strings) -> dict:
+def classify_shape(state_input, schemas, strings,
+                   partitioned: bool = False) -> dict:
     """Analysis-time family eligibility for a raw AST pattern input:
     {'chunk'|'scan'|'dfa': True | reason} with the SAME reason strings
     classify_parallel reports for a built kernel — computable without
@@ -269,7 +430,9 @@ def classify_shape(state_input, schemas, strings) -> dict:
 
     `schemas` maps stream id -> StreamSchema for every stream the
     pattern consumes; a shape the device chain lowering itself rejects
-    reports that reason for every family."""
+    reports that reason for every family.  `partitioned` applies the
+    per-key lane-vmap gates pattern_plan applies for patterns inside a
+    `partition with (...)` block."""
     from ..interp.engine import _collect_filters
     from .nfa_device import lower_chain
     try:
@@ -281,18 +444,26 @@ def classify_shape(state_input, schemas, strings) -> dict:
     # the stateless-harness gates DevicePatternPlan applies before any
     # family runs (pattern_plan.py "plan-family selection")
     base = True
-    if not spec.every_head:
-        base = "non-`every` head (single stateful arm)"
-    elif any(n.kind != "stream" for n in spec.all_nodes):
+    if any(n.kind != "stream" for n in spec.all_nodes) \
+            or spec.needs_init_slot:
         base = "absent state (timer-driven deadlines need device state)"
     elif not all(p.within_ms is not None for p in spec.positions):
         base = "position without a `within` bound"
     if base is not True:
         return {"chunk": base, "scan": base, "dfa": base}
-    out = {"chunk": True}
+    if partitioned:
+        out = {"chunk": "partitioned (the lane axis holds partition keys)"}
+    elif not spec.every_head:
+        out = {"chunk": "non-`every` head (single stateful arm)"}
+    else:
+        out = {"chunk": True}
     try:
         prog = lower_parallel(spec, strings)
         out.update(_classify_prog(prog))
+        if partitioned and prog.single_arm:
+            r = ("non-`every` head with partitioned lanes (per-key "
+                 "single-arm state)")
+            out.update({"scan": r, "dfa": r})
     except ParallelUnsupported as e:   # lint: allow-swallow (reason IS
         # the analysis-time record)
         out.update({"scan": str(e), "dfa": str(e)})
@@ -415,6 +586,16 @@ def _next_static_scan(mask, L: int):
     return lax.associative_scan(jnp.minimum, idx, reverse=True)
 
 
+def _prev_static_scan(mask):
+    """prev[t] = LAST index <= t with mask set (-1 = none): one forward
+    associative scan in the max semiring — resolves the sequential
+    kernel's re-capturing logical stations (capture = last side match
+    at or before the pair's done event)."""
+    F = mask.shape[0]
+    idx = jnp.where(mask, jnp.arange(F, dtype=_I32), jnp.int32(-1))
+    return lax.associative_scan(jnp.maximum, idx)
+
+
 # ---------------------------------------------------------------------------
 # the block kernel
 # ---------------------------------------------------------------------------
@@ -425,10 +606,14 @@ class ParallelChainKernel:
 
     Mirrors NFAKernel's packed-output contract exactly (meta row, valid
     row under `having`, out_names/out_dtypes from the plan's NFAKernel)
-    so DevicePatternPlan._unpack_block consumes both interchangeably.
+    so DevicePatternPlan's unpack consumes both interchangeably.
     Blocks carry no device state: ev is the chunked-halo flat layout
     (`__flat.*` arrays + `__nev__`/`__prev_seq__`/bases) minus the lane
-    geometry — the whole flush is ONE log-depth program."""
+    geometry — the whole flush is ONE log-depth program.  block_fn
+    accepts T as an int (flat block) or an (L, F) tuple (ONE jax.vmap
+    of the flat block over the lane axis: partitioned per-key grids and
+    fused multi-query lanes — per-lane leaves map on axis 0, shared
+    scalars broadcast)."""
 
     def __init__(self, prog: ParallelProgram, nfak: NFAKernel,
                  family: str = "scan"):
@@ -441,11 +626,15 @@ class ParallelChainKernel:
         self._block_cache: dict = {}
 
     # NFAKernel-compatible surface consumed by _call_block / bench
-    def block_fn(self, F: int, M: int):
-        key = (F, M)
+    def block_fn(self, T, M: int):
+        key = (T, M)
         fn = self._block_cache.get(key)
         if fn is None:
-            fn = self._block_cache[key] = jax.jit(self._make_block(M))
+            if isinstance(T, tuple):
+                fn = jax.jit(self._make_lane_block(M))
+            else:
+                fn = jax.jit(self._make_block(M))
+            self._block_cache[key] = fn
         return fn
 
     def _make_block(self, M: int):
@@ -454,32 +643,63 @@ class ParallelChainKernel:
                 return state, self._block_impl(ev, M)
         return block
 
+    def _make_lane_block(self, M: int):
+        """vmap the flat block over the lane axis: per-lane leaves (lane-
+        major grids, per-lane scalars, params, qids) map on axis 0;
+        shared leaves (bases, broadcast event arrays in fused mode)
+        replicate."""
+        def lane_block(state, ev):
+            shared_nd = {"__base_ts__": 0, "__base_seq__": 0}
+            axes = {}
+            for k, v in ev.items():
+                if k in shared_nd:
+                    axes[k] = None
+                elif k.startswith("__flat."):
+                    axes[k] = 0 if v.ndim == 2 else None
+                else:               # __nev__/__prev_seq__/__param.*/...
+                    axes[k] = 0 if v.ndim >= 1 else None
+
+            def one(e):
+                with compute_dtypes(self._mode):
+                    return self._block_impl(e, M)
+            return state, jax.vmap(one, in_axes=(axes,))(ev)
+        return lane_block
+
     # -- mask/env helpers -----------------------------------------------
 
-    def _flat_env(self, ev, hop: Hop, ts, base_ts) -> dict:
-        env = {}
-        for a in self.prog.schemas[hop.ref].attributes:
-            key = f"__flat.{hop.scode}.{a.name}"
+    def _param_env(self, ev) -> dict:
+        """Per-lane lifted constants (fused multi-query mode): scalars
+        under the lane vmap, named exactly like NFAKernel.params."""
+        return {k[len("__param."):]: v for k, v in ev.items()
+                if k.startswith("__param.")}
+
+    def _flat_env(self, ev, node: HopNode, ts, base_ts) -> dict:
+        env = self._param_env(ev)
+        for a in self.prog.schemas[node.ref].attributes:
+            key = f"__flat.{node.scode}.{a.name}"
             if key in ev:
-                env[f"{hop.ref}.{a.name}"] = ev[key]
+                env[f"{node.ref}.{a.name}"] = ev[key]
         env["__timestamp__"] = base_ts + ts.astype(jnp.int64)
         return env
 
-    def _hop_mask(self, ev, hop: Hop, ts, valid, base_ts):
+    def _node_mask(self, ev, node: HopNode, ts, valid, base_ts):
         m = valid
         if len(self.prog.stream_ids) > 1:
-            m = m & (ev["__flat.__scode__"] == hop.scode)
-        if hop.pre_conjs:
-            env = self._flat_env(ev, hop, ts, base_ts)
-            for ce in hop.pre_conjs:
+            m = m & (ev["__flat.__scode__"] == node.scode)
+        if node.pre_conjs:
+            env = self._flat_env(ev, node, ts, base_ts)
+            for ce in node.pre_conjs:
                 m = m & jnp.broadcast_to(ce.fn(env), m.shape)
         return m
 
-    def _cap_env(self, ev, j_at: dict, keys, F: int, base_ts, comp_j=None):
-        """Capture env gathered at resolved hop indices: key "r.attr" ->
-        flat column at j_at[position(r)] (clipped; callers mask validity
-        downstream).  `keys` bounds the gathers to what's read."""
-        env = {}
+    def _gather_env(self, ev, idx_of: dict, keys, F: int, base_ts,
+                    comp_j=None) -> dict:
+        """Capture env gathered at resolved indices: key "r.attr" (or
+        "r[i].attr") -> flat column at idx_of[refpart] (clipped; callers
+        mask validity downstream).  `keys` bounds the gathers to what's
+        read.  idx_of maps refpart -> index array (per-head or
+        per-match, caller's choice)."""
+        env = self._param_env(ev)
         for k in keys:
             if k == "__timestamp__":
                 if comp_j is not None:
@@ -489,37 +709,40 @@ class ParallelChainKernel:
             if "." not in k or k.startswith("__"):
                 continue
             refpart, attr = k.split(".", 1)
-            base = refpart.split("[", 1)[0]
-            pi = self.prog.ref_pos.get(base)
-            if pi is None:
+            base = _base_ref(refpart)[0]
+            idx = idx_of.get(refpart, idx_of.get(base))
+            if idx is None:
                 continue
-            scode = self.prog.hops[pi].scode
+            pn = self.prog.ref_of.get(base)
+            if pn is None:
+                continue
+            scode = self.prog.positions[pn[0]].nodes[pn[1]].scode
             col = ev.get(f"__flat.{scode}.{attr}")
             if col is None:
                 continue
-            env[k] = col[jnp.clip(j_at[pi], 0, F - 1)]
+            env[k] = col[jnp.clip(idx, 0, F - 1)]
         return env
 
     # -- dfa family: bit-packed multi-stride static tables ----------------
 
-    def _dfa_tables(self, masks, F: int, L: int):
+    def _dfa_tables(self, lane_masks: list, F: int, L: int):
         """Precompose per-event symbol words into stride-4 block tables.
-        Returns (suffix_flat per static hop, packed first-offset words,
-        block-level next pointers per static hop, NB)."""
+        lane_masks: one (F,) mask per chase node (symbol bit).  Returns
+        (suffix_flat per lane, packed first-offset words, block-level
+        next pointers per lane, NB)."""
         B = STRIDE
         NB = -(-F // B)
         Fp = NB * B
-        static = [k for k in range(1, self.prog.S)
-                  if self.prog.hops[k].is_static]
-        # ONE u32 symbol word per event: bit k = matches position k
+        lanes = range(len(lane_masks))
+        # ONE u32 symbol word per event: bit k = matches chase node k
         sym = jnp.zeros((Fp,), jnp.uint32)
-        for k in static:
-            mk = jnp.zeros((Fp,), bool).at[:F].set(masks[k])
+        for k in lanes:
+            mk = jnp.zeros((Fp,), bool).at[:F].set(lane_masks[k])
             sym = sym | (mk.astype(jnp.uint32) << np.uint32(k))
         o = jnp.arange(B, dtype=_I32)[None, :]
         suffix = {}
         first = {}
-        for k in static:
+        for k in lanes:
             bits = ((sym.reshape(NB, B) >> np.uint32(k)) & 1) != 0
             offs = jnp.where(bits, o, jnp.int32(B))
             # in-block suffix-first offsets (stride-4: 3 dense mins)
@@ -531,22 +754,22 @@ class ParallelChainKernel:
             suf = jnp.stack(list(reversed(cols)), axis=1)   # (NB, B)
             suffix[k] = suf.reshape(-1)
             first[k] = suf[:, 0]
-        # per-block transition table: first-hit offsets for ALL static
-        # positions bit-packed into one u32 word per block
+        # per-block transition table: first-hit offsets for ALL chase
+        # nodes bit-packed into one u32 word per block
         packed = jnp.zeros((NB,), jnp.uint32)
-        for k in static:
+        for k in lanes:
             packed = packed | (first[k].astype(jnp.uint32)
                                << np.uint32(_OFF_BITS * k))
         # block-level next pointers: one associative scan over F/4
-        # elements per static position (stacked -> a single scan call)
-        if static:
+        # elements per chase node (stacked -> a single scan call)
+        if lane_masks:
             blk = jnp.stack(
                 [jnp.where(first[k] < B,
                            jnp.arange(NB, dtype=_I32), jnp.int32(NB))
-                 for k in static], axis=1)
+                 for k in lanes], axis=1)
             nblk = lax.associative_scan(jnp.minimum, blk, reverse=True,
                                         axis=0)
-            nblk = {k: nblk[:, i] for i, k in enumerate(static)}
+            nblk = {k: nblk[:, i] for i, k in enumerate(lanes)}
         else:
             nblk = {}
         return suffix, packed, nblk, NB
@@ -584,11 +807,47 @@ class ParallelChainKernel:
         # force a second structural compile at flush 2)
         seq = ev["__flat.__seq__"]
         valid = jnp.arange(F, dtype=_I32) < nev
-        masks = [self._hop_mask(ev, h, ts, valid, base_ts)
-                 for h in prog.hops]
+        nmask = {(pi, ni): self._node_mask(ev, n, ts, valid, base_ts)
+                 for pi, pos in enumerate(prog.positions)
+                 for ni, n in enumerate(pos.nodes)}
 
-        if self.family == "dfa":
-            suffix, packed, nblk, NB = self._dfa_tables(masks, F, L)
+        chase = _chase_lanes(prog) if self.family == "dfa" else []
+        if chase:
+            lane_of = {pn: k for k, pn in enumerate(chase)}
+            suffix, packed, nblk, NB = self._dfa_tables(
+                [nmask[pn] for pn in chase], F, L)
+
+        scan_next: dict = {}
+
+        def nxt(pi, ni, s):
+            """First index >= s matching chase node (pi, ni); L if none."""
+            if chase and (pi, ni) in lane_of:
+                return self._dfa_next(lane_of[(pi, ni)], s, suffix,
+                                      packed, nblk, NB, L)
+            key = (pi, ni)
+            if key not in scan_next:
+                scan_next[key] = _next_static_scan(nmask[key], L)
+            nx = scan_next[key]
+            return jnp.where(s < F, nx[jnp.clip(s, 0, F - 1)],
+                             jnp.int32(L))
+
+        # occurrence ranks per count position: inclusive cumulative match
+        # count + a segment tree over it — "the r-th occurrence after
+        # entry" is ONE monotone first-hit query (rank/select), so count
+        # minima and capture indices never iterate
+        ranks: dict = {}
+        rank_heaps: dict = {}
+        for pi, pos in enumerate(prog.positions):
+            if pos.kind != "count":
+                continue
+            r = jnp.cumsum(nmask[(pi, 0)].astype(_I32), dtype=_I32)
+            ranks[pi] = r
+            rank_heaps[pi] = _build_heap(r, valid, L, "max",
+                                         jnp.dtype(jnp.int64))
+
+        def select(pi, s, r):
+            """First index >= s whose inclusive occurrence rank >= r."""
+            return _first_hit(rank_heaps[pi], L, s, r, "ge")
 
         # expiry heap: the sequential kernel expires a waiting instance
         # on the FIRST arriving event whose age exceeds the position's
@@ -601,59 +860,277 @@ class ParallelChainKernel:
         ts_heap = _build_heap(ts, valid, L, "max", jnp.dtype(jnp.int64))
         ts64 = ts.astype(jnp.int64)
 
-        # pointer chase: every event index is a candidate head
+        def killer(s, within_ms):
+            """First event at or after s past the head's `within` horizon
+            (per-head v = head ts + W; queries indexed by head)."""
+            return _first_hit(ts_heap, L, s, ts64 + jnp.int64(within_ms),
+                              "gt")
+
+        def threshold_next(hop: HopNode, s, idx_of):
+            th = hop.threshold
+            agg = "max" if th.op in ("gt", "ge") else "min"
+            own = ev[f"__flat.{hop.scode}.{th.own_key.split('.', 1)[1]}"]
+            env = self._gather_env(ev, idx_of, th.rhs.reads, F, base_ts)
+            v = jnp.broadcast_to(th.rhs.fn(env), (F,))
+            dt = _tree_dtype(own.dtype, v.dtype)
+            heap = _build_heap(own, nmask[self.prog.ref_of[hop.ref]], L,
+                               agg, dt)
+            return _first_hit(heap, L, s, v, th.op)
+
+        # ---- the state chase: every event index is a candidate head ----
         j0 = jnp.arange(F, dtype=_I32)
-        ok = masks[0]
-        j_at = {0: j0}
+        head = prog.positions[0]
+        ok = nmask[(0, 0)]
+        dead = jnp.zeros((F,), bool)    # definitive failure (single-arm)
+        idx_of = {}                     # refpart -> per-head value index
+        pres_of = {}                    # refpart -> per-head presence bool
+        count_ctx = {}                  # pi -> (s_occ, ra) occurrence base
+        pend_count = None               # (pi, entry) awaiting its advance
         j = j0
-        for k in range(1, S):
-            hop = prog.hops[k]
-            s = j + 1
-            if hop.is_static:
-                if self.family == "dfa":
-                    jn = self._dfa_next(k, s, suffix, packed, nblk, NB, L)
+
+        def step_fail(alive, kl, jn):
+            """Advance-step outcome: (still_ok, definitively_dead).
+            Dead = the killer event exists in-block and the match did not
+            land before it; not-found with no killer stays pending."""
+            good = jn < kl
+            return alive & good, alive & ~good & (kl < F)
+
+        if head.kind == "count":
+            # the arming event IS occurrence 1 (host _alloc_head): the
+            # rank base excludes it, the select starts AT the head
+            ra = ranks[0][j0] - 1
+            count_ctx[0] = (j0, ra)
+            jmin = select(0, j0, ra + jnp.int32(head.min_count))
+            kl = killer(j0 + 1, head.within_ms)
+            if S > 1:
+                ok, d = step_fail(ok, kl, jmin)
+                dead = dead | d
+                pend_count = (0, head)
+                j = jnp.clip(jmin, 0, F - 1)
+        else:
+            idx_of[head.nodes[0].ref] = j0
+
+        final_count = prog.positions[S - 1].kind == "count"
+
+        for pi in range(1, S):
+            pos = prog.positions[pi]
+            if pos.kind == "single":
+                hop = pos.nodes[0]
+                s = j + 1
+                if not prog.sequence:
+                    if pend_count is not None:
+                        # the successor consumes the armed count: the
+                        # station never waits AT this position, so the
+                        # COUNT's within (anchored at the head) bounds
+                        # this advance and the successor's own never
+                        # applies (host parity: at_pos is never true for
+                        # a count's successor)
+                        _cpi, cpos = pend_count
+                        kl = killer(s, cpos.within_ms)
+                        pend_count = None
+                    else:
+                        kl = killer(s, pos.within_ms)
+                if prog.sequence:
+                    # strict succession: the hop consumes EXACTLY the
+                    # next valid event — mask/filter/expiry all resolve
+                    # by direct gather at s
+                    sc = jnp.clip(s, 0, F - 1)
+                    m = nmask[(pi, 0)][sc]
+                    if hop.step_conjs:
+                        senv = self._gather_env(ev, idx_of, set().union(
+                            *[ce.reads for ce in hop.step_conjs]), F,
+                            base_ts)
+                        for a in prog.schemas[hop.ref].attributes:
+                            col = ev.get(f"__flat.{hop.scode}.{a.name}")
+                            if col is not None:
+                                senv[f"{hop.ref}.{a.name}"] = col[sc]
+                        senv["__timestamp__"] = base_ts \
+                            + ts64[sc]
+                        for ce in hop.step_conjs:
+                            m = m & jnp.broadcast_to(ce.fn(senv), m.shape)
+                    expired = ts64[sc] > ts64[j0] \
+                        + jnp.int64(pos.within_ms)
+                    have = s < nev
+                    jn = jnp.where(have & m & ~expired, s, jnp.int32(L))
+                    dead = dead | (ok & have & (expired | ~m))
+                    ok = ok & (jn < F)
+                elif hop.threshold is not None:
+                    jn = threshold_next(hop, s, idx_of)
+                    ok, d = step_fail(ok, kl, jn)
+                    dead = dead | d
                 else:
-                    nxt = _next_static_scan(masks[k], L)
-                    jn = jnp.where(s < F, nxt[jnp.clip(s, 0, F - 1)],
-                                   jnp.int32(L))
-            else:
-                th = hop.threshold
-                agg = "max" if th.op in ("gt", "ge") else "min"
-                own = ev[f"__flat.{hop.scode}.{th.own_key.split('.', 1)[1]}"]
-                env = self._cap_env(ev, j_at, th.rhs.reads, F, base_ts)
-                v = jnp.broadcast_to(th.rhs.fn(env), (F,))
-                dt = _tree_dtype(own.dtype, v.dtype)
-                heap = _build_heap(own, masks[k], L, agg, dt)
-                jn = _first_hit(heap, L, s, v, th.op)
-            ok = ok & (jn < F)
-            js = jnp.clip(jn, 0, F - 1)
-            # the hop survives iff the match arrives BEFORE the first
-            # event that would expire the waiting instance (ts > head_ts
-            # + W_k); this also subsumes the matched event's own age
-            # check (a killer has ts strictly past the horizon)
-            killer = _first_hit(ts_heap, L, s,
-                                ts64 + jnp.int64(hop.within_ms), "gt")
-            ok = ok & (jn < killer)
-            j_at[k] = js
-            j = js
-        comp_j = j_at[S - 1]
-        lv = ok & (seq[comp_j] > prev_seq.astype(_I32))
+                    jn = nxt(pi, 0, s)
+                    ok, d = step_fail(ok, kl, jn)
+                    dead = dead | d
+                j = jnp.clip(jn, 0, F - 1)
+                idx_of[hop.ref] = j
+            elif pos.kind == "logical":
+                s = j + 1
+                jl = nxt(pi, 0, s)
+                jr = nxt(pi, 1, s)
+                if pos.op == "or":
+                    jd = jnp.minimum(jl, jr)
+                else:
+                    jd = jnp.where((jl < F) & (jr < F),
+                                   jnp.maximum(jl, jr), jnp.int32(L))
+                kl = killer(s, pos.within_ms)
+                ok, d = step_fail(ok, kl, jd)
+                dead = dead | d
+                jdc = jnp.clip(jd, 0, F - 1)
+                for ni, n in enumerate(pos.nodes):
+                    jside = jl if ni == 0 else jr
+                    if pos.op == "or":
+                        # winner captures its own first match; loser is
+                        # absent (presence row nulls it host-side)
+                        idx_of[n.ref] = jnp.clip(jside, 0, F - 1)
+                        pres_of[n.ref] = jside == jd
+                    else:
+                        # AND stations re-capture while waiting: the
+                        # emitted value is the LAST side match at or
+                        # before the done event
+                        pv = _prev_static_scan(nmask[(pi, ni)])
+                        idx_of[n.ref] = jnp.clip(pv[jdc], 0, F - 1)
+                        pres_of[n.ref] = jnp.ones((F,), bool)
+                j = jdc
+            else:                       # count (non-head entry)
+                entry = j
+                ra = ranks[pi][entry]   # entry event is NOT an occurrence
+                count_ctx[pi] = (entry + 1, ra)
+                if pi < S - 1:
+                    jmin = select(pi, entry + 1,
+                                  ra + jnp.int32(pos.min_count))
+                    kl = killer(entry + 1, pos.within_ms)
+                    ok, d = step_fail(ok, kl, jmin)
+                    dead = dead | d
+                    pend_count = (pi, pos)
+                    j = jnp.clip(jmin, 0, F - 1)
 
-        # compaction: one cumsum + one scatter per column (NFAKernel's
-        # flat-buffer layout; M overflow re-runs with a bigger buffer)
-        pos = jnp.cumsum(lv.astype(_I32), dtype=_I32) - lv
-        n = pos[-1] + lv[-1]
-        wpos = jnp.where(lv & (pos < M), pos, M)
-        jm = {k: jnp.zeros((M,), _I32).at[wpos].set(v, mode="drop")
-              for k, v in j_at.items()}
+        # ---- emission candidates --------------------------------------
+        if final_count:
+            fpos = prog.positions[S - 1]
+            s_occ, ra = count_ctx[S - 1]
+            kl = killer(s_occ, fpos.within_ms)
+            C = fpos.max_count - fpos.min_count + 1
+            lvs, comps = [], []
+            for c in range(fpos.min_count, fpos.max_count + 1):
+                jc = select(S - 1, s_occ, ra + jnp.int32(c))
+                lvs.append(ok & (jc < kl))
+                comps.append(jnp.clip(jc, 0, F - 1))
+            lv_all = jnp.stack(lvs)                 # (C, F)
+            comp_all = jnp.stack(comps)
+            # single-arm resolution: parked at max, or dead
+            resolved = dead | lvs[-1]
+        else:
+            C = 1
+            lv_all = ok[None, :]
+            comp_all = j[None, :]
+            resolved = dead | ok
 
-        # selector env over compacted capture gathers
+        # dedup: completions at or before the previous flush's last seq
+        # are tail replays — suppressed on device, per lane
+        lv_all = lv_all & (seq[comp_all] > prev_seq.astype(_I32))
+
+        arm_flag = jnp.int32(0)
+        if prog.single_arm:
+            # ONE instance ever: the first head match arms it; everything
+            # else never existed.  The meta flag tells the host whether
+            # the arm is still pending (keep dispatching) or resolved.
+            hm = nmask[(0, 0)]
+            h0 = jnp.min(jnp.where(hm, j0, jnp.int32(F)))
+            lv_all = lv_all & (j0[None, :] == h0)
+            arm_off = ev.get("__arm_done__")
+            if arm_off is not None:
+                lv_all = lv_all & (arm_off.astype(_I32) == 0)
+            has_head = h0 < F
+            r0 = resolved[jnp.clip(h0, 0, F - 1)]
+            arm_flag = jnp.where(
+                has_head,
+                jnp.where(r0, jnp.int32(ARM_RESOLVED),
+                          jnp.int32(ARM_PENDING)),
+                jnp.int32(ARM_NONE))
+            if arm_off is not None:
+                arm_flag = jnp.where(arm_off.astype(_I32) != 0,
+                                     jnp.int32(ARM_RESOLVED), arm_flag)
+
+        # ---- compaction: (slot, head) candidates -> M match rows ------
+        lvf = lv_all.reshape(C * F)
+        pos_ = jnp.cumsum(lvf.astype(_I32), dtype=_I32) - lvf
+        n = pos_[-1] + lvf[-1]
+        wpos = jnp.where(lvf & (pos_ < M), pos_, M)
+
+        def compact(a):
+            return jnp.zeros((M,), a.dtype).at[wpos].set(
+                a.reshape(C * F) if a.ndim == 2 else jnp.tile(a, C),
+                mode="drop")
+
+        hm_ = compact(jnp.broadcast_to(j0[None, :], (C, F)))
+        cm_ = compact(jnp.broadcast_to(
+            jnp.arange(C, dtype=_I32)[:, None], (C, F)))
+        comp_m = compact(comp_all)
+
+        # per-match capture indices: single/logical refs gather their
+        # per-head chase results; count refs rank/select at the match's
+        # completion index (collection is station-independent in the
+        # sequential kernel — occurrences keep absorbing until max or
+        # the park freeze at completion)
+        midx: dict = {}
+        mpres: dict = {}
+        for rp, arr in idx_of.items():
+            midx[rp] = arr[hm_] if arr is not j0 else hm_
+        for rp, arr in pres_of.items():
+            mpres[rp] = arr[hm_]
+
         need = set()
         for ce in list(nfak.sel_fns.values()) \
                 + ([nfak.having] if nfak.having else []):
             need.update(ce.reads)
-        env = self._cap_env(ev, jm, need, F, base_ts,
-                            comp_j=jm[S - 1])
+        need_bases: dict = {}
+        for k in need:
+            if "." in k and not k.startswith("__"):
+                need_bases.setdefault(_base_ref(k.split(".", 1)[0])[0],
+                                      set()).add(k.split(".", 1)[0])
+        for k in nfak.out_names:
+            if k.startswith("__present__."):
+                rp = k[len("__present__."):]
+                need_bases.setdefault(_base_ref(rp)[0], set()).add(rp)
+
+        for pi, pos in enumerate(prog.positions):
+            if pos.kind != "count":
+                continue
+            ref = pos.nodes[0].ref
+            rps = need_bases.get(ref)
+            if not rps:
+                continue
+            s_occ, ra = count_ctx[pi]
+            s_m = s_occ[hm_] if s_occ.ndim else s_occ
+            ra_m = ra[hm_]
+            if pi == S - 1:
+                q_m = jnp.int32(pos.min_count) + cm_
+            else:
+                avail = ranks[pi][comp_m] - ra_m
+                q_m = jnp.minimum(avail, jnp.int32(pos.max_count)) \
+                    if pos.max_count < UNBOUNDED else avail
+
+            def sel_q(r):
+                return jnp.clip(_first_hit(rank_heaps[pi], L, s_m,
+                                           ra_m + r, "ge"), 0, F - 1)
+            for rp in rps:
+                _b, cidx = _base_ref(rp)
+                if cidx is None or cidx == "last":
+                    if pi == S - 1:
+                        midx[rp] = comp_m   # the emitting occurrence
+                    else:
+                        midx[rp] = sel_q(q_m)
+                    mpres[rp] = q_m >= 1
+                elif cidx == "last-1":
+                    midx[rp] = sel_q(q_m - 1)
+                    mpres[rp] = q_m >= 2
+                else:
+                    want = jnp.int32(int(cidx) + 1)
+                    midx[rp] = sel_q(want)
+                    mpres[rp] = q_m >= want
+
+        env = self._gather_env(ev, midx, need, F, base_ts, comp_j=comp_m)
         sel = {name: jnp.broadcast_to(ce.fn(env), (M,))
                for name, ce in nfak.sel_fns.items()}
         mvalid = jnp.arange(1, M + 1, dtype=_I32) <= n
@@ -661,13 +1138,24 @@ class ParallelChainKernel:
             henv = dict(env)
             henv.update(sel)
             mvalid = mvalid & jnp.broadcast_to(nfak.having.fn(henv), (M,))
-        sel["__timestamp__"] = ts[jm[S - 1]]
-        sel["__seq__"] = seq[jm[S - 1]]
-        sel["__head_seq__"] = seq[jm[0]]
+        sel["__timestamp__"] = ts[comp_m]
+        sel["__seq__"] = seq[comp_m]
+        sel["__head_seq__"] = seq[hm_]
+        if nfak.emit_qid:
+            qid = ev.get("__lane_qid__", jnp.int32(0))
+            sel["__qid__"] = jnp.broadcast_to(qid.astype(_I32), (M,))
+        for name in nfak.out_names:
+            if not name.startswith("__present__."):
+                continue
+            rp = name[len("__present__."):]
+            pr = mpres.get(rp)
+            if pr is None:
+                pr = jnp.ones((M,), bool)
+            sel[name] = pr.astype(_I32)
 
         NO_DL = jnp.int32(2 ** 31 - 1)
         meta = (jnp.zeros((M,), _I32)
-                .at[0].set(n).at[3].set(NO_DL))
+                .at[0].set(n).at[3].set(NO_DL).at[4].set(arm_flag))
         irows = [meta]
         if nfak.having is not None:
             irows.append(mvalid.astype(_I32))

@@ -200,41 +200,75 @@ class DevicePatternPlan(QueryPlan):
         self._par_kerns: dict = {}              # family -> kernel
         self._of_dropped = 0
         self._family_dispatches: dict = {}
+        self._lane_dispatches = 0               # lane-vmapped block count
+        self._lanes_last = 0                    # lane width of the last one
+        # partitioned/fused lane bookkeeping (scan/dfa lane-vmap path):
+        # per-key replay tails + per-key last-emitted completion seq, and
+        # the per-lane single-arm resolution flags for non-`every` heads
+        self._lane_tail: Optional[dict] = None
+        self._lane_prev = np.zeros(0, dtype=np.int64)
+        self._lane_F = 0
+        self._arm_done: Optional[np.ndarray] = None
         self.family = "seq"
-        base = True
-        if broadcast_events:
-            base = "fused multi-query lane kernel"
-        elif part_key_fns is not None or partitions != 1:
-            base = "partitioned (persistent per-key lane state)"
-        elif getattr(rt, "_async_workers", 1) != 1:
-            base = "async ingest workers (flush order not deterministic)"
-        elif not self.spec.every_head:
-            base = "non-`every` head (single stateful arm)"
+        self._partitioned = part_key_fns is not None or \
+            (partitions != 1 and not broadcast_events)
+        # hard gates: no stateless family can run these shapes — blocks
+        # would need device state or a deterministic flush order
+        hard = None
+        if getattr(rt, "_async_workers", 1) != 1:
+            hard = "async ingest workers (flush order not deterministic)"
         elif self.kernel.has_absent or self.spec.needs_init_slot:
-            base = "absent state (timer-driven deadlines need device state)"
+            hard = "absent state (timer-driven deadlines need device state)"
         elif not all(p.within_ms is not None for p in self.spec.positions):
-            base = "position without a `within` bound"
+            hard = "position without a `within` bound"
         self.families: dict = {"seq": True}
         from .autotune import (chunk_lanes_for, pattern_family_for,
                                pipeline_depth_for)
         self._stateless_lanes = chunk_lanes_for(rt, q)
-        if base is True:
-            from .nfa_parallel import classify_parallel
-            self.families.update(classify_parallel(
-                self.spec, self.kernel, rt.strings, param_extra))
-            self.families["chunk"] = True if self._stateless_lanes > 1 \
-                else "chunk lanes <= 1 (@app:deviceChunkLanes)"
-            if self.mesh is not None:
-                for f in ("scan", "dfa"):
-                    if self.families[f] is True:
-                        self.families[f] = ("multi-device mesh (flat block "
-                                            "has no lane axis to shard)")
+        if hard is not None:
+            self.families.update({"chunk": hard, "scan": hard, "dfa": hard})
         else:
-            self.families.update({"chunk": base, "scan": base, "dfa": base})
+            from .nfa_parallel import classify_parallel
+            par = classify_parallel(self.spec, self.kernel, rt.strings,
+                                    param_extra)
+            if self._partitioned:
+                # per-key lanes ride ONE vmap of the flat scan/dfa block
+                # ((L, F) grids, per-lane tails/dedup); chunk's lane axis
+                # is already spent on own-chunks, and a non-`every` arm
+                # would need per-key persistent state
+                if not self.spec.every_head:
+                    par = {f: ("non-`every` head with partitioned lanes "
+                               "(per-key single-arm state)")
+                           if v is True else v for f, v in par.items()}
+                self.families["chunk"] = ("partitioned (the lane axis "
+                                          "holds partition keys)")
+            elif broadcast_events:
+                # fused multi-query lanes vmap the same way: per-lane
+                # `__qparam` constants, events broadcast
+                self.families["chunk"] = "fused multi-query lane kernel"
+            elif not self.spec.every_head:
+                self.families["chunk"] = ("non-`every` head (single "
+                                          "stateful arm)")
+            else:
+                self.families["chunk"] = True if self._stateless_lanes > 1 \
+                    else "chunk lanes <= 1 (@app:deviceChunkLanes)"
+            if self.mesh is not None and not self._partitioned \
+                    and not broadcast_events:
+                # partitioned/fused lane grids shard their LANE axis over
+                # the mesh (_dispatch_par); only the flat P=1 block has
+                # no axis to shard
+                for f in ("scan", "dfa"):
+                    if par.get(f) is True:
+                        par[f] = ("multi-device mesh (flat block has no "
+                                  "lane axis to shard)")
+            self.families.update(par)
         want = pattern_family_for(rt, q)
         fam = self._choose_family(want)
         if fam != "seq":
-            self.pipeline_depth = pipeline_depth_for(rt, "pattern", q)
+            # fused groups route matches through finalize_multi, which
+            # drains synchronously — no deferred-pull pipeline there
+            self.pipeline_depth = 0 if broadcast_events \
+                else pipeline_depth_for(rt, "pattern", q)
             self._enter_stateless(fam)
         # device grids shipped per block: only attrs some predicate or
         # capture row reads, per scode
@@ -244,13 +278,16 @@ class DevicePatternPlan(QueryPlan):
         # fail here (-> sequential fallback) instead of at first flush
         dummy = self._dense_dummy(T=2)
         jax.eval_shape(self.kernel.block_fn(2, 8), self.state, dummy)
+        lane_mode = self._partitioned or self.broadcast_events
         while self.family in ("scan", "dfa"):
             # same guarantee for the parallel-in-time families: a lowering
             # surprise demotes to the NEXT sound family at build (each
             # candidate validated in turn), never at first flush
             try:
-                jax.eval_shape(self._parallel_kernel().block_fn(8, 16),
-                               {}, self._flat_dummy(8))
+                jax.eval_shape(
+                    self._parallel_kernel().block_fn(
+                        (2, 8) if lane_mode else 8, 16),
+                    {}, self._flat_dummy(8, L=2 if lane_mode else None))
                 break
             except Exception as e:   # pragma: no cover - safety net
                 import warnings
@@ -304,6 +341,16 @@ class DevicePatternPlan(QueryPlan):
         return NamedSharding(self.mesh,
                              PartitionSpec(*((None,) * (ndim - 1) + ("part",))))
 
+    def _lane_sharding(self, ndim: int):
+        """Lane-MAJOR sharding for the vmapped scan/dfa grids: axis 0 is
+        the lane axis (partition keys / fused queries), everything else
+        replicates."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        if ndim == 0:
+            return NamedSharding(self.mesh, PartitionSpec())
+        return NamedSharding(self.mesh,
+                             PartitionSpec(*(("part",) + (None,) * (ndim - 1))))
+
     def _shard(self, tree):
         """Place every leaf with its partition-axis sharding (no-op when
         no mesh is configured).  Leaves whose last dim is not the lane
@@ -323,20 +370,32 @@ class DevicePatternPlan(QueryPlan):
             return np.float32
         return dtype_of(t)
 
-    def _flat_dummy(self, F: int) -> dict:
+    def _flat_dummy(self, F: int, L: Optional[int] = None) -> dict:
         """Tiny flat-block ev (the scan/dfa families' input layout) for
-        build-time shape validation."""
+        build-time shape validation.  L adds the lane axis: partitioned
+        grids carry per-lane event arrays; fused (broadcast) lanes share
+        the event arrays and vary only params/qids/arm flags."""
         import jax.numpy as jnp
-        ev = {"__flat.__ts__": jnp.zeros((F,), jnp.int32),
-              "__flat.__seq__": jnp.zeros((F,), jnp.int32),
-              "__nev__": jnp.zeros((), jnp.int32),
-              "__prev_seq__": jnp.zeros((), jnp.int32),
+        per_lane_ev = L is not None and not self.broadcast_events
+        fs = (L, F) if per_lane_ev else (F,)
+        ss = (L,) if per_lane_ev else ()
+        ls = (L,) if L is not None else ()
+        ev = {"__flat.__ts__": jnp.zeros(fs, jnp.int32),
+              "__flat.__seq__": jnp.zeros(fs, jnp.int32),
+              "__nev__": jnp.zeros(ss, jnp.int32),
+              "__prev_seq__": jnp.zeros(ss, jnp.int32),
               "__base_ts__": jnp.zeros((), jnp.int64),
               "__base_seq__": jnp.zeros((), jnp.int64)}
         if len(self.spec.stream_ids) > 1:
-            ev["__flat.__scode__"] = jnp.zeros((F,), jnp.int32)
+            ev["__flat.__scode__"] = jnp.zeros(fs, jnp.int32)
         for si, attr, t in self._grid_attrs:
-            ev[f"__flat.{si}.{attr}"] = jnp.zeros((F,), self._np_dtype(t))
+            ev[f"__flat.{si}.{attr}"] = jnp.zeros(fs, self._np_dtype(t))
+        for k, v in (self.kernel.params or {}).items():
+            ev[f"__param.{k}"] = jnp.zeros(ls, np.asarray(v).dtype)
+        if self.kernel.emit_qid:
+            ev["__lane_qid__"] = jnp.zeros(ls, jnp.int32)
+        if not self.spec.every_head:
+            ev["__arm_done__"] = jnp.zeros(ls, jnp.int32)
         return ev
 
     def _dense_dummy(self, T: int) -> dict:
@@ -374,7 +433,11 @@ class DevicePatternPlan(QueryPlan):
         for j, k in enumerate(uniq.tolist()):
             p = k2p.get(k)
             if p is None:
-                if len(k2p) >= self.P:
+                # stateless lane families size their (L, F) grid per
+                # flush: a hot-added key is just a new lane id — no
+                # device-state growth, no recompile below the next
+                # pow2 lane bucket
+                if self._chunk_cfg is None and len(k2p) >= self.P:
                     self._grow(2 * self.P)
                 p = k2p[k] = len(k2p)
             parts_u[j] = p
@@ -476,6 +539,12 @@ class DevicePatternPlan(QueryPlan):
             self._pipe = DispatchPipeline(
                 self.name, lambda e: [self._materialize_chunk(e)],
                 depth=self.pipeline_depth)
+        if not self.spec.every_head and self._arm_done is None:
+            # non-`every`: ONE instance per lane ever; the device reports
+            # resolution through the meta flag and the host stops
+            # dispatching once every lane's arm is resolved
+            nl = self.P if self.broadcast_events else 1
+            self._arm_done = np.zeros(nl, dtype=bool)
         self.retryable_finalize = True
 
     def _set_family(self, fam: str) -> None:
@@ -498,7 +567,7 @@ class DevicePatternPlan(QueryPlan):
             self.family = fam
             return
         if self._ts_base is None and self._tail is None \
-                and not self._buffered:
+                and self._lane_tail is None and not self._buffered:
             if fam == "seq":
                 self.family = "seq"
                 self._chunk_cfg = None
@@ -583,6 +652,11 @@ class DevicePatternPlan(QueryPlan):
         d["plan_family"] = self.family
         for f, n in self._family_dispatches.items():
             d[f"dispatches_{f}"] = int(n)
+        if self._lane_dispatches:
+            # lane-vmapped scan/dfa blocks (partitioned keys / fused
+            # queries ride ONE vmap of the flat block over the lanes)
+            d["dispatches_lane_vmapped"] = int(self._lane_dispatches)
+            d["lanes_last_dispatch"] = int(self._lanes_last)
         inel = {f: r for f, r in self.families.items() if r is not True}
         if inel:
             d["family_ineligible"] = inel
@@ -652,7 +726,7 @@ class DevicePatternPlan(QueryPlan):
             for k in cols:
                 cols[k] = cols[k][order]
         if self._chunk_cfg is not None:
-            return self._run_chunked_flat(ts, seq, scode, cols)
+            return self._run_chunked_flat(ts, seq, scode, cols, part)
         with self.rt.stats.stage("host_build", plan=self.name):
             if self.broadcast_events:
                 idx_within = np.arange(N, dtype=np.int64)
@@ -818,13 +892,20 @@ class DevicePatternPlan(QueryPlan):
             self._kern_by_p[K] = kern
         return kern
 
-    def _run_chunked_flat(self, ts, seq, scode, cols) -> list:
+    def _run_chunked_flat(self, ts, seq, scode, cols, part=None) -> list:
         """One stateless flat block per flush: [replayed tail | new events]
         split into K own-chunks, gathered into lanes on device.  Blocks
         carry no device state, so flushes pipeline independently
         (@app:devicePipeline) and retries are self-contained.  A dispatch
         failure rolls the host-side tail/seq bookkeeping back so the
-        runtime's degradation ladder can re-run the flush."""
+        runtime's degradation ladder can re-run the flush.
+
+        Partitioned patterns on a scan/dfa family route through the
+        lane-grid variant instead: each key's events form an independent
+        sub-stream, laid out as one (L, F) grid and executed by ONE vmap
+        of the flat block over the lane axis."""
+        if self._partitioned and self.family in ("scan", "dfa"):
+            return self._run_lanes_flat(ts, seq, scode, cols, part)
         saved = (self._tail, self._prev_last_seq, self._last_seq,
                  getattr(self, "_chunk_F", 0))
         try:
@@ -948,45 +1029,239 @@ class DevicePatternPlan(QueryPlan):
         # across 64K buckets as the replay tail varies, and every drift
         # is a ~10s recompile through the tunnel
         if fam != "chunk":
-            # scan/dfa: one candidate completion per head, so matches
-            # <= N <= F ALWAYS — M = F can never overflow, and riding
-            # the sticky F bucket means M never recompiles on its own
+            # scan/dfa: one candidate completion per head (times the
+            # final count's emission lanes), so M = F rarely overflows
+            # and riding the sticky F bucket means M never recompiles on
+            # its own; a final-count burst retries with a bigger M
+            lanes = None
+            if self.broadcast_events:
+                if self._arm_done is not None and self._arm_done.all():
+                    return []      # every lane's single arm is resolved
+                lanes = self.P
+                for k, v in (self.kernel.params or {}).items():
+                    ev[f"__param.{k}"] = np.asarray(v)
+                ev["__lane_qid__"] = np.arange(self.P, dtype=_I32)
+                if self._arm_done is not None:
+                    ev["__arm_done__"] = self._arm_done.astype(_I32)
+            elif self._arm_done is not None:
+                if self._arm_done.all():
+                    return []      # the one non-`every` arm is resolved
+                ev["__arm_done__"] = np.int32(0)
             return self._pipe.push(self._dispatch_par(
-                ev, F, F, ts_base, seq_base))
+                ev, F, F, ts_base, seq_base, lanes=lanes))
         M = (self._m_hint if self._m_hint >= 16384
              else max(self._m_hint, _m_bucket_chunk(N)))
         return self._pipe.push(self._dispatch_chunk(
             ev, K, T, M, ts_base, seq_base))
 
-    def _dispatch_par(self, ev, F, M, ts_base, seq_base) -> dict:
+    def _run_lanes_flat(self, ts, seq, scode, cols, part) -> list:
+        """Partitioned scan/dfa: each key's events are an independent
+        sub-stream — ONE (L, F) lane grid, ONE vmapped flat block, with
+        per-lane replay tails and per-lane completion-seq dedup.  A
+        dispatch failure rolls the per-lane bookkeeping back so the
+        degradation ladder can re-run the flush."""
+        saved = (self._lane_tail, self._lane_prev.copy(), self._last_seq,
+                 self._lane_F)
+        try:
+            return self._run_lanes_flat_inner(ts, seq, scode, cols, part)
+        except Exception:
+            (self._lane_tail, self._lane_prev, self._last_seq,
+             self._lane_F) = saved
+            raise
+
+    def _run_lanes_flat_inner(self, ts, seq, scode, cols, part) -> list:
+        with self.rt.stats.stage("host_build", plan=self.name):
+            W0 = int(self._chunk_cfg["W"])
+            tl = self._lane_tail
+            held = None
+            if tl is not None:
+                # only lanes with NEW events this flush replay their
+                # tail; a quiet lane cannot produce a new completion
+                # (everything it could emit is at or before its prev
+                # seq), and letting its old events into the flush would
+                # pin the shared i32 ts/seq bases forever (review
+                # finding: a long-quiet lane saturated every live
+                # lane's offsets at the 2^30 clip)
+                active = np.isin(tl["part"], np.unique(part))
+                if not active.all():
+                    inactive = ~active
+                    held = {"ts": tl["ts"][inactive],
+                            "seq": tl["seq"][inactive],
+                            "scode": tl["scode"][inactive],
+                            "part": tl["part"][inactive],
+                            "cols": {k: v[inactive]
+                                     for k, v in tl["cols"].items()}}
+                    tl = {"ts": tl["ts"][active], "seq": tl["seq"][active],
+                          "scode": tl["scode"][active],
+                          "part": tl["part"][active],
+                          "cols": {k: v[active]
+                                   for k, v in tl["cols"].items()}}
+                ts = np.concatenate([tl["ts"], ts])
+                seq = np.concatenate([tl["seq"], seq])
+                scode = np.concatenate([tl["scode"], scode])
+                part = np.concatenate([tl["part"], part])
+                cols = {k: np.concatenate([tl["cols"][k], v])
+                        for k, v in cols.items()}
+            N = len(ts)
+            order = np.lexsort((seq, part))
+            ts, seq, scode, part = (ts[order], seq[order], scode[order],
+                                    part[order])
+            cols = {k: v[order] for k, v in cols.items()}
+            change = np.r_[True, part[1:] != part[:-1]]
+            run_id = np.cumsum(change) - 1
+            run_start = np.flatnonzero(change)
+            lane_ids = part[run_start].astype(np.int64)
+            counts = np.diff(np.r_[run_start, N])
+            idx_within = np.arange(N) - run_start[run_id]
+            Lr = len(lane_ids)
+            run_end = run_start + counts - 1
+
+            # per-lane running-max ts in ONE pass (offset trick): feeds
+            # the tail-retention bound and the out-of-order `within`
+            # widening, exactly like the flat path's global cummax
+            span = int(ts.max()) - int(ts.min()) + 1
+            sh = ts.astype(np.int64) + run_id.astype(np.int64) * span
+            tsmono = np.maximum.accumulate(sh) \
+                - run_id.astype(np.int64) * span
+            W = W0 + int(np.max(tsmono - ts))
+
+            # lane-grid geometry: the lane axis pads to pow2 (hot-adding
+            # a key keeps the compiled (L, F) shape until the count
+            # crosses the next pow2 — no per-key recompile), and F rides
+            # a sticky 64-granule bucket so tail drift never recompiles:
+            # finer than pow2 because every padded cell multiplies by
+            # the lane count (pow2 wasted up to 2x the whole grid)
+            fm = int(counts.max())
+            f_min = pow2_at_least(fm, lo=16) if fm <= 64 \
+                else (fm // 64 + 2) * 64
+            F = max(self._lane_F, f_min)
+            if F > 4 * f_min:
+                F = f_min
+            self._lane_F = F
+            Lpad = pow2_at_least(max(Lr, 1), lo=8)
+            if self.mesh is not None:
+                nd = self.mesh.devices.size
+                Lpad = -(-Lpad // nd) * nd      # even lane shards
+
+            # bases anchor at the flush MAX with i32 headroom (like the
+            # dense path): a lane resuming after a >2^30 ms / seq gap
+            # saturates ITS stale offsets low — which reads as "ancient,
+            # expired, already-deduped" on device, the conservative and
+            # host-identical outcome — instead of saturating every live
+            # lane's offsets high
+            budget = LOCAL_SPAN - (1 << 16)
+            ts_base = max(int(ts.min()), int(ts.max()) - budget)
+            seq_base = max(int(seq.min()), int(seq.max()) - budget)
+            self._last_seq = max(self._last_seq, int(seq.max()))
+            if len(self._lane_prev) < len(self._key_to_part):
+                grown = np.full(len(self._key_to_part), -(2 ** 62),
+                                dtype=np.int64)
+                grown[:len(self._lane_prev)] = self._lane_prev
+                self._lane_prev = grown
+
+            def grid(a):
+                g = np.zeros((Lpad, F), dtype=a.dtype)
+                g[run_id, idx_within] = a
+                return g
+
+            nev = np.zeros(Lpad, _I32)
+            nev[:Lr] = counts
+            prev = np.full(Lpad, -LOCAL_SPAN, _I32)
+            prev[:Lr] = np.clip(self._lane_prev[lane_ids] - seq_base,
+                                -LOCAL_SPAN, LOCAL_SPAN).astype(_I32)
+            ev = {"__flat.__ts__": grid(np.clip(
+                      ts - ts_base, -LOCAL_SPAN, LOCAL_SPAN).astype(_I32)),
+                  "__flat.__seq__": grid(np.clip(
+                      seq - seq_base, -LOCAL_SPAN, LOCAL_SPAN).astype(_I32)),
+                  "__nev__": nev, "__prev_seq__": prev,
+                  "__base_ts__": np.int64(ts_base),
+                  "__base_seq__": np.int64(seq_base)}
+            if len(self.spec.stream_ids) > 1:
+                ev["__flat.__scode__"] = grid(scode)
+            for k, v in cols.items():
+                ev[f"__flat.{k}"] = grid(v)
+
+            # per-lane tail: the last `within` window of each lane's
+            # events replays at that lane's next flush (lanes quiet this
+            # flush keep their stored tail untouched)
+            last_ts = tsmono[run_end]
+            keep = tsmono >= (last_ts[run_id] - W)
+            self._lane_tail = {
+                "ts": ts[keep], "seq": seq[keep], "scode": scode[keep],
+                "part": part[keep],
+                "cols": {k: v[keep] for k, v in cols.items()}}
+            if held is not None:
+                # quiet lanes' tails ride along untouched (next flush
+                # re-sorts, so concatenation order is irrelevant)
+                self._lane_tail = {
+                    k: (np.concatenate([self._lane_tail[k], held[k]])
+                        if k != "cols" else
+                        {c: np.concatenate([self._lane_tail["cols"][c],
+                                            held["cols"][c]])
+                         for c in held["cols"]})
+                    for k in self._lane_tail}
+            self._lane_prev[lane_ids] = seq[run_end]
+
+        return self._pipe.push(self._dispatch_par(
+            ev, F, F, ts_base, seq_base, lanes=Lpad))
+
+    def _dispatch_par(self, ev, F, M, ts_base, seq_base,
+                      lanes=None) -> dict:
         """One stateless scan/dfa-family block over the whole flat flush
-        (no lane geometry — the kernel is log-depth in T)."""
+        (no chunk-lane geometry — the kernel is log-depth in T).  With
+        `lanes`, the SAME block runs once per lane under jax.vmap
+        (partitioned (L, F) grids / fused broadcast lanes)."""
         with self.rt.stats.stage("host_build", plan=self.name):
             kern = self._parallel_kernel()
-        _st, out = self._call_block(kern, F, M, {}, ev)
+            if self.mesh is not None and lanes:
+                # lane axis shards over the mesh; shared scalars and
+                # fused broadcast event arrays replicate
+                ev = {k: jax.device_put(
+                          v, self._lane_sharding(np.ndim(v))
+                          if np.ndim(v) and np.shape(v)[0] == lanes
+                          else self._lane_sharding(0))
+                      for k, v in ev.items()}
+        T = (lanes, F) if lanes else F
+        _st, out = self._call_block(kern, T, M, {}, ev)
         from .pipeline import start_d2h
         start_d2h(out)      # start the D2H pull while the device computes
         self._family_dispatches[self.family] = \
             self._family_dispatches.get(self.family, 0) + 1
-        return {"ev": ev, "F": F, "M": M, "out": out,
+        if lanes:
+            self._lane_dispatches += 1
+            self._lanes_last = int(lanes)
+        return {"ev": ev, "F": F, "M": M, "L": lanes, "out": out,
                 "ts_base": ts_base, "seq_base": seq_base}
 
     def _materialize_par(self, e: dict):
+        lanes = e.get("L")
         while True:
             with self.rt.stats.stage("transfer", plan=self.name):
                 ipack = np.asarray(e["out"]["i"])
                 fpack = np.asarray(e["out"]["f"]) if "f" in e["out"] \
                     else None
-            n = int(ipack[0, 0])
-            if n > e["M"]:      # unreachable with M=F; exact-retry safety
+            n = int(ipack[..., 0, 0].max()) if lanes else int(ipack[0, 0])
+            if n > e["M"]:      # final-count emission burst: exact retry
                 e = self._dispatch_par(e["ev"], e["F"], _m_bucket_chunk(n),
-                                       e["ts_base"], e["seq_base"])
+                                       e["ts_base"], e["seq_base"],
+                                       lanes=lanes)
                 continue
             break
+        if self._arm_done is not None:
+            from .nfa_parallel import ARM_RESOLVED
+            kern = self._parallel_kernel()
+            if kern.prog.single_arm:
+                flags = np.asarray(ipack[:, 0, 4] if lanes
+                                   else ipack[0, 4:5])
+                done = flags == ARM_RESOLVED
+                nl = min(len(self._arm_done), len(done))
+                self._arm_done[:nl] |= done[:nl]
         # NOTE: _m_hint deliberately not updated — it sizes the chunk/seq
         # match buffers, and par blocks ride M = F instead
         # bases are per-flush: _unpack_block must see THIS entry's
         self._ts_base, self._seq_base = e["ts_base"], e["seq_base"]
+        if lanes:
+            return self._unpack_lanes(ipack, fpack)
         return self._unpack_block(ipack, fpack, n)
 
     def _dispatch_chunk(self, ev, K, T, M, ts_base, seq_base) -> dict:
@@ -1078,14 +1353,31 @@ class DevicePatternPlan(QueryPlan):
         chunks = self._pipe.collect()
         return self._rows_to_batches(chunks) if chunks else []
 
+    def _unpack_lanes(self, ipack, fpack):
+        """Columnar match table from one lane-vmapped block's packed
+        output: (L, rows, M) transposes to (rows, L*M) and the per-lane
+        match counts become one validity mask — the row decode is then
+        identical to the flat path (no per-lane python)."""
+        Ln, rows, Mm = ipack.shape
+        n_l = ipack[:, 0, 0]
+        ip2 = np.swapaxes(ipack, 0, 1).reshape(rows, Ln * Mm)
+        fp2 = (np.swapaxes(fpack, 0, 1).reshape(fpack.shape[1], Ln * Mm)
+               if fpack is not None else None)
+        base = (np.arange(Mm)[None, :] < n_l[:, None]).reshape(-1)
+        return self._unpack_rows(ip2, fp2, base)
+
     def _unpack_block(self, ipack, fpack, n: int):
-        """Columnar match table from one block's packed output."""
+        """Columnar match table from one flat block's packed output."""
+        return self._unpack_rows(ipack, fpack,
+                                 np.arange(ipack.shape[1]) < n)
+
+    def _unpack_rows(self, ipack, fpack, base_valid):
         with self.rt.stats.stage("scatter", plan=self.name):
             if self.kernel.having is not None:
-                valid = ipack[1] != 0                 # (M,)
+                valid = base_valid & (ipack[1] != 0)
                 ii = 2
             else:
-                valid = np.arange(ipack.shape[1]) < n
+                valid = base_valid
                 ii = 1
             if not valid.any():
                 return None
@@ -1259,10 +1551,15 @@ class DevicePatternPlan(QueryPlan):
              "start_anchor": self._start_anchor}
         if self._chunk_cfg is not None:
             # chunked mode keeps no device state: continuity lives in the
-            # replayed tail + the last-emitted completion seq
+            # replayed tail + the last-emitted completion seq (per lane
+            # for partitioned grids, plus single-arm resolution flags)
             d["chunk_tail"] = self._tail
             d["chunk_prev_last_seq"] = self._prev_last_seq
             d["chunk_of_dropped"] = self._of_dropped
+            d["lane_tail"] = self._lane_tail
+            d["lane_prev"] = np.asarray(self._lane_prev)
+            d["arm_done"] = (np.asarray(self._arm_done)
+                             if self._arm_done is not None else None)
         return d
 
     def load_state_dict(self, d: dict) -> None:
@@ -1323,3 +1620,9 @@ class DevicePatternPlan(QueryPlan):
             self._tail = d.get("chunk_tail")
             self._prev_last_seq = int(d["chunk_prev_last_seq"])
             self._of_dropped = int(d.get("chunk_of_dropped", 0))
+            self._lane_tail = d.get("lane_tail")
+            if d.get("lane_prev") is not None:
+                self._lane_prev = np.asarray(d["lane_prev"],
+                                             dtype=np.int64)
+            if d.get("arm_done") is not None:
+                self._arm_done = np.asarray(d["arm_done"], dtype=bool)

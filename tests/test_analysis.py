@@ -84,9 +84,9 @@ FIXTURES = {
         @app:patternFamily('scan')
         define stream S (v double);
         define stream Out (a double, b double, c double);
-        @info(name='q') from every e1=S[v > 1]<1:3> -> e2=S[v < 0]
-        within 1 sec
-        select e1[0].v as a, e1[last].v as b, e2.v as c insert into Out;
+        @info(name='q') from every e1=S[v > 1] -> e2=S[v < 0]<0:3>
+        -> e3=S[v > 2] within 1 sec
+        select e1.v as a, e2[last].v as b, e3.v as c insert into Out;
     """,
     "SA09": """
         @source(type='tcp', rate.limit='0')

@@ -77,8 +77,12 @@ def _run(head, q, n=1800, batches=6, seed=11, dt=9):
     if n in ("head_count", "count") else n
     for n in QUERIES])
 def test_chunked_differential(name):
+    # force the CHUNK family: since the ISSUE-13 eligibility expansion
+    # the scan family would otherwise win these shapes by default, and
+    # this file is chunk's own differential corpus
     q = QUERIES[name]
-    chunked, dev = _run("@app:devicePatterns('always')\n", q)
+    chunked, dev = _run("@app:patternFamily('chunk')\n"
+                        "@app:devicePatterns('always')\n", q)
     _h, host = _run("@app:devicePatterns('never')\n", q)
     assert chunked, f"{name}: chunked mode did not engage"
     assert dev == host, (name, len(dev), len(host),
@@ -90,7 +94,8 @@ def test_chunked_many_small_flushes():
     """Replay-tail dedup across dozens of tiny flushes (every flush
     overlaps the previous one's within-window)."""
     q = QUERIES["two_state"]
-    chunked, dev = _run("@app:devicePatterns('always')\n", q,
+    chunked, dev = _run("@app:patternFamily('chunk')\n"
+                        "@app:devicePatterns('always')\n", q,
                         n=900, batches=30, dt=25, seed=5)
     _h, host = _run("@app:devicePatterns('never')\n", q,
                     n=900, batches=30, dt=25, seed=5)
@@ -102,7 +107,8 @@ def test_chunked_sparse_data_reduces_lanes():
     """Halo-dominated data (few events per within-window) still matches:
     the geometry collapses to fewer lanes rather than mis-matching."""
     q = QUERIES["two_state"]
-    chunked, dev = _run("@app:devicePatterns('always')\n", q,
+    chunked, dev = _run("@app:patternFamily('chunk')\n"
+                        "@app:devicePatterns('always')\n", q,
                         n=300, batches=3, dt=400, seed=7)
     _h, host = _run("@app:devicePatterns('never')\n", q,
                     n=300, batches=3, dt=400, seed=7)

@@ -86,28 +86,77 @@ ELIGIBLE = {
         "select e1.sym as s1, e2.sym as s2, e2.price as p "
         "insert into Out;",
         {"seq", "chunk", "scan", "dfa"}),
-}
-
-# ineligible shapes (from the chunked corpus + extras): every parallel
-# family must REJECT them — forced requests fall back, outputs stay
-# identical to the interpreter
-INELIGIBLE = {
-    "count": (
+    # ---- the expanded algebra (ISSUE 13): counts, logical AND/OR,
+    # strict sequences, and non-`every` single arms all lower onto the
+    # rank/select + prev-scan state chase now
+    "count_head": (
         "from every e1=S[price > 110]<1:3> -> e2=S[price < 95] "
         "within 1 sec select e1[0].price as a, e1[last].price as b, "
         "e2.price as c insert into Out;",
-        "count quantifier"),
+        {"seq", "chunk", "scan", "dfa"}),
+    "count_mid": (
+        "from every e1=S[price > 118] -> e2=S[price > 112]<2:4> -> "
+        "e3=S[price < 96] within 2 sec select e1.price as a, "
+        "e2[0].price as b, e2[last].price as c, e3.price as d "
+        "insert into Out;",
+        {"seq", "chunk", "scan", "dfa"}),
+    "count_final": (
+        "from every e1=S[price > 118] -> e2=S[price < 97]<2:3> "
+        "within 1 sec select e1.price as a, e2[last].price as b "
+        "insert into Out;",
+        {"seq", "chunk", "scan"}),
     "logical_and": (
         "from every e1=S[price > 120] -> e2=S[price < 100] and "
         "e3=S[price > 125] within 1 sec "
         "select e1.price as a, e2.price as b, e3.price as c "
         "insert into Out;",
-        "logical"),
+        {"seq", "chunk", "scan", "dfa"}),
+    "logical_or": (
+        "from every e1=S[price > 122] -> e2=S[price < 95] or "
+        "e3=S[price > 126] within 1 sec "
+        "select e1.price as a, e2.price as b, e3.price as c "
+        "insert into Out;",
+        {"seq", "chunk", "scan", "dfa"}),
     "sequence": (
         "from every e1=S[price > 115], e2=S[price > e1.price] "
         "within 1 sec select e1.price as a, e2.price as b "
         "insert into Out;",
-        "sequence"),
+        {"seq", "chunk", "scan"}),
+    "sequence_conj": (
+        "from every e1=S[price > 110], "
+        "e2=S[price > e1.price and volume > e1.volume] within 1 sec "
+        "select e1.price as a, e2.price as b insert into Out;",
+        {"seq", "chunk", "scan"}),
+    "nonevery": (
+        "from e1=S[price > 125] -> e2=S[price > e1.price] "
+        "within 1 sec select e1.price as a, e2.price as b "
+        "insert into Out;",
+        {"seq", "scan"}),
+    "count_null_idx": (
+        "from every e1=S[price > 115]<1:3> -> e2=S[price < 95] "
+        "within 1 sec select e1[1].price as b, e2.price as c "
+        "insert into Out;",
+        {"seq", "chunk", "scan", "dfa"}),
+}
+
+# ineligible shapes: every parallel family must REJECT them — forced
+# requests fall back, outputs stay identical to the interpreter
+INELIGIBLE = {
+    "every_mid": (
+        "from every e1=S[price > 127] -> every e2=S[price < 91] "
+        "within 200 milliseconds select e1.price as a, e2.price as b "
+        "insert into Out;",
+        "every"),
+    "optional_count": (
+        "from every e1=S[price > 110] -> e2=S[price < 100]<0:3> -> "
+        "e3=S[price > 124] within 1 sec select e1.price as a, "
+        "e2[last].price as b, e3.price as c insert into Out;",
+        "count quantifier"),
+    "adjacent_counts": (
+        "from every e1=S[price > 118]<1:2> -> e2=S[price < 97]<1:2> -> "
+        "e3=S[price > 124] within 1 sec select e1[last].price as a, "
+        "e2[last].price as b, e3.price as c insert into Out;",
+        "adjacent"),
     "no_within": (
         "from every e1=S[price > 120] -> e2=S[price < 95] "
         "select e1.price as a, e2.price as b insert into Out;",
@@ -163,8 +212,18 @@ def host_rows():
     return get
 
 
-@pytest.mark.parametrize("fam", FAMILIES)
-@pytest.mark.parametrize("name", list(ELIGIBLE))
+# dfa provably rejects these (sequence/nonevery/final-count shapes) and
+# falls back to scan — running them under a forced dfa would just re-run
+# the scan differential, so they ride the slow lane only.  count_null_idx
+# joins them: its dfa count machinery is count_head/count_mid's coverage
+_DFA_FALLBACK = {"count_final", "sequence", "sequence_conj", "nonevery",
+                 "count_null_idx"}
+
+
+@pytest.mark.parametrize("name,fam", [
+    pytest.param(n, f, marks=pytest.mark.slow)
+    if f == "dfa" and n in _DFA_FALLBACK else (n, f)
+    for n in ELIGIBLE for f in FAMILIES])
 def test_eligible_differential(name, fam, host_rows):
     q, ok_fams = ELIGIBLE[name]
     used, families, dev = _run(
@@ -384,6 +443,311 @@ def test_threshold_hop_nan_column_matches_sequential():
         dev = run(f"@app:patternFamily('{fam}')\n"
                   "@app:devicePatterns('always')\n")
         assert dev == host, (fam, dev, host)
+
+
+def test_classifier_agreement_build_vs_analysis():
+    """Satellite: classify_shape (analysis time, AST only) and
+    classify_parallel (build time, lowered kernel) must agree — same
+    eligibility verdict AND same reason string — across the full
+    eligible matrix and every ineligible shape, so SA08 can never
+    disagree with the family the build actually selects."""
+    from siddhi_tpu.core.nfa_parallel import classify_shape
+    from siddhi_tpu.core.schema import StringTable
+    from siddhi_tpu.query.parser import parse
+
+    from siddhi_tpu.core.schema import StreamSchema
+    for name, q in [(n, e[0]) for n, e in ELIGIBLE.items()] \
+            + [(n, e[0]) for n, e in INELIGIBLE.items()]:
+        app = parse(HEAD + q)
+        query = app.execution_elements[0]
+        schemas = {"S": StreamSchema.of(app.stream_definitions["S"])}
+        shape = classify_shape(query.input, schemas, StringTable())
+        mgr = SiddhiManager()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            rt = mgr.create_app_runtime(
+                "@app:devicePatterns('always')\n" + HEAD + q)
+        plan = next(p for p in rt._plans
+                    if isinstance(p, DevicePatternPlan))
+        for fam in ("chunk", "scan", "dfa"):
+            assert plan.families[fam] == shape[fam], \
+                (name, fam, plan.families[fam], shape[fam])
+        mgr.shutdown()
+
+
+PART_HEAD = "define stream S (sym string, price double, volume int);\n"
+PART_Q = """partition with (sym of S)
+begin
+  @info(name='q')
+  from every e1=S[price > 100] -> e2=S[price > e1.price]
+    -> e3=S[price > e2.price] within 10 sec
+  select e1.price as p1, e2.price as p2, e3.price as p3 insert into Out;
+end;
+"""
+
+
+def _run_part(head, n=1200, batches=4, seed=3, dt=7, keys=37,
+              plan_out=None):
+    mgr = SiddhiManager()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        rt = mgr.create_app_runtime(head + PART_HEAD + PART_Q)
+    rows = []
+    rt.add_callback("Out", lambda evs: rows.extend(
+        (e.timestamp, tuple(round(float(x), 3) for x in e.data))
+        for e in evs))
+    rt.start()
+    plan = next((p for p in rt._plans
+                 if isinstance(p, DevicePatternPlan)), None)
+    rng = np.random.default_rng(seed)
+    ih = rt.input_handler("S")
+    ts0 = 1_700_000_000_000
+    for b in range(batches):
+        for j in range(n // batches):
+            i = b * (n // batches) + j
+            ih.send((f"K{rng.integers(0, keys)}",
+                     float(np.round(rng.uniform(90, 130) * 4) / 4),
+                     int(rng.integers(1, 1000))), timestamp=ts0 + i * dt)
+        rt.flush()
+    fam = plan.family if plan is not None else None
+    if plan_out is not None:
+        plan_out["metrics"] = plan.device_metrics() if plan else {}
+        plan_out["explain"] = rt.explain()
+    mgr.shutdown()
+    # host clones deliver per instance: order differs from the device's
+    # global completion order — compare as multisets with timestamps
+    return fam, sorted(rows)
+
+
+def test_partitioned_lanes_run_parallel_family_by_default():
+    """The ISSUE 13 headline: a partitioned pattern (config 4's shape)
+    runs a lane-vmapped parallel family BY DEFAULT, byte-identical to
+    the per-key host clones, with zero D-FAMILY demotions."""
+    _f, host = _run_part("@app:devicePatterns('never')\n")
+    info: dict = {}
+    fam, dev = _run_part("@app:partitionCapacity(64)\n", plan_out=info)
+    assert fam == "scan", fam
+    assert dev == host, (len(dev), len(host), dev[:3], host[:3])
+    m = info["metrics"]
+    assert m.get("dispatches_lane_vmapped", 0) >= 1
+    assert m.get("lanes_last_dispatch", 0) >= 37
+    ent = info["explain"]["queries"]["q"]
+    assert ent["path"] == "device" and ent["family"] == "scan", ent
+    assert not [d for d in ent.get("demotions", ())
+                if d["rule_id"] in ("D-FAMILY", "D-PARTITION")], ent
+
+
+@pytest.mark.slow
+def test_partitioned_lanes_forced_dfa_differential():
+    """The bit-packed family under the lane vmap: a static partitioned
+    chain forced onto dfa matches the host clones byte-for-byte."""
+    q_static = PART_Q.replace("e2=S[price > e1.price]",
+                              "e2=S[price < 96]") \
+                     .replace("e3=S[price > e2.price]",
+                              "e3=S[price > 124]")
+
+    def run(head):
+        mgr = SiddhiManager()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            rt = mgr.create_app_runtime(head + PART_HEAD + q_static)
+        rows = []
+        rt.add_callback("Out", lambda evs: rows.extend(
+            (e.timestamp, tuple(round(float(x), 3) for x in e.data))
+            for e in evs))
+        rt.start()
+        plan = next((p for p in rt._plans
+                     if isinstance(p, DevicePatternPlan)), None)
+        rng = np.random.default_rng(3)
+        ih = rt.input_handler("S")
+        ts0 = 1_700_000_000_000
+        for b in range(3):
+            for j in range(300):
+                i = b * 300 + j
+                ih.send((f"K{rng.integers(0, 16)}",
+                         float(np.round(rng.uniform(90, 130) * 4) / 4),
+                         1), timestamp=ts0 + i * 7)
+            rt.flush()
+        fam = plan.family if plan is not None else None
+        mgr.shutdown()
+        return fam, sorted(rows)
+
+    _f, host = run("@app:devicePatterns('never')\n")
+    fam, dev = run("@app:patternFamily('dfa')\n"
+                   "@app:partitionCapacity(32)\n")
+    assert fam == "dfa", fam
+    assert len(dev) > 0 and dev == host, (len(dev), len(host))
+
+
+def test_partition_hot_add_reuses_lane_plan():
+    """Satellite: a partitioned app that sees a NEW key mid-stream must
+    reuse the vmapped lane plan — no per-key recompile (the (L, F) lane
+    bucket absorbs it), no D-PARTITION demotion, and the placement
+    plane keeps reporting one device query."""
+    mgr = SiddhiManager()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        rt = mgr.create_app_runtime(
+            "@app:partitionCapacity(16)\n" + PART_HEAD + PART_Q)
+    rows = []
+    rt.add_callback("Out", lambda evs: rows.extend(evs))
+    rt.start()
+    plan = next(p for p in rt._plans if isinstance(p, DevicePatternPlan))
+    assert plan.family == "scan"
+    rng = np.random.default_rng(9)
+    ih = rt.input_handler("S")
+    ts0 = 1_700_000_000_000
+    for i in range(300):                       # 5 keys, warm compile
+        ih.send((f"K{rng.integers(0, 5)}",
+                 float(np.round(rng.uniform(90, 130) * 4) / 4), 1),
+                timestamp=ts0 + i * 7)
+    rt.flush()
+    kern = plan._parallel_kernel()
+    compiled_before = set(kern._block_cache)
+    lanes_before = plan._lane_dispatches
+    for i in range(300, 600):                  # 3 NEW keys hot-added
+        ih.send((f"K{rng.integers(0, 8)}",
+                 float(np.round(rng.uniform(90, 130) * 4) / 4), 1),
+                timestamp=ts0 + i * 7)
+    rt.flush()
+    assert plan._lane_dispatches > lanes_before
+    # 8 keys still fit the pow2 lane bucket of 8: the SAME compiled
+    # (L, F) block served the new keys — zero recompiles
+    assert set(kern._block_cache) == compiled_before, \
+        (compiled_before, set(kern._block_cache))
+    ent = rt.explain()["queries"]["q"]
+    assert ent["path"] == "device" and ent["family"] == "scan"
+    assert not [d for d in ent.get("demotions", ())
+                if d["rule_id"] == "D-PARTITION"], ent
+    assert len(plan._key_to_part) == 8
+    mgr.shutdown()
+
+
+def test_partitioned_quiet_lane_tail_held_aside():
+    """Review regression: a lane with no new events this flush must NOT
+    replay its tail (it cannot produce a new completion, and its old
+    events would pin the shared i32 offset bases forever).  The held
+    tail still resumes correctly when the key speaks again."""
+    mgr = SiddhiManager()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        rt = mgr.create_app_runtime(
+            "@app:partitionCapacity(8)\n" + PART_HEAD + PART_Q.replace(
+                "within 10 sec", "within 1 hour"))
+    rows = []
+    rt.add_callback("Out", lambda evs: rows.extend(
+        tuple(e.data) for e in evs))
+    rt.start()
+    plan = next(p for p in rt._plans if isinstance(p, DevicePatternPlan))
+    assert plan.family == "scan"
+    ih = rt.input_handler("S")
+    ts0 = 1_700_000_000_000
+    ih.send(("A", 110.0, 1), timestamp=ts0)       # A arms a pending head
+    ih.send(("B", 101.0, 1), timestamp=ts0 + 1)
+    rt.flush()
+    # flush 2: only B speaks — A's tail must be held aside, not gridded
+    ih.send(("B", 102.0, 1), timestamp=ts0 + 2)
+    rt.flush()
+    tail_parts = set(plan._lane_tail["part"].tolist())
+    assert len(tail_parts) == 2, tail_parts        # A held + B kept
+    # flush 3: A resumes and completes its 3-chain from the held tail
+    ih.send(("A", 120.0, 1), timestamp=ts0 + 3)
+    ih.send(("A", 130.0, 1), timestamp=ts0 + 4)
+    rt.flush()
+    assert (110.0, 120.0, 130.0) in rows, rows
+    mgr.shutdown()
+
+
+def test_fused_lanes_run_parallel_family():
+    """Fused multi-query groups (config 5's substrate) ride the SAME
+    lane vmap: per-lane `__qparam` thresholds, events broadcast —
+    byte-identical to per-query host matchers."""
+    def app():
+        parts = [PART_HEAD]
+        for i in range(10):
+            lo = 110 + (i % 5)
+            parts.append(
+                f"@info(name='q{i}') from every e1=S[price > {lo}] -> "
+                f"e2=S[price > e1.price] within 1 sec "
+                f"select e1.price as p1, e2.price as p2 "
+                f"insert into Out{i % 3};")
+        return "\n".join(parts) + "\n"
+
+    def run(head):
+        from siddhi_tpu.core.multi_query import MultiQueryDevicePatternPlan
+        mgr = SiddhiManager()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            rt = mgr.create_app_runtime(head + app())
+        rows = []
+        for o in range(3):
+            rt.add_callback(f"Out{o}", lambda evs, o=o: rows.extend(
+                (o, e.timestamp, tuple(round(float(x), 3)
+                                       for x in e.data)) for e in evs))
+        rt.start()
+        mq = next((p for p in rt._plans
+                   if isinstance(p, MultiQueryDevicePatternPlan)), None)
+        fam = mq.inner.family if mq is not None else None
+        rng = np.random.default_rng(5)
+        ih = rt.input_handler("S")
+        ts0 = 1_700_000_000_000
+        for b in range(3):
+            for j in range(200):
+                i = b * 200 + j
+                ih.send((f"K{rng.integers(0, 4)}",
+                         float(np.round(rng.uniform(90, 130) * 4) / 4),
+                         int(rng.integers(1, 1000))),
+                        timestamp=ts0 + i * 7)
+            rt.flush()
+        mgr.shutdown()
+        return fam, sorted(rows)
+
+    _f, host = run("@app:devicePatterns('never')\n")
+    fam, dev = run("")
+    assert fam == "scan", fam
+    assert len(dev) > 0 and dev == host, (fam, len(dev), len(host))
+
+
+def test_nonevery_single_arm_resolves_across_flushes():
+    """A non-`every` chain arms ONCE, globally: a pending arm spans the
+    flush boundary through the replay tail, and once resolved the host
+    stops dispatching (the meta-row flag)."""
+    q = ("from e1=S[price > 100] -> e2=S[price > e1.price] "
+         "within 1 sec select e1.price as a, e2.price as b "
+         "insert into Out;")
+    sends = [(0, 90.0), (10, 101.0),            # flush 1: arm pending
+             (20, 95.0), (30, 107.0),           # flush 2: completes
+             (40, 120.0), (50, 130.0)]          # flush 3: must NOT match
+
+    def run(head):
+        mgr = SiddhiManager()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            rt = mgr.create_app_runtime(head + HEAD + q)
+        rows = []
+        rt.add_callback("Out", lambda evs: rows.extend(
+            tuple(e.data) for e in evs))
+        rt.start()
+        ih = rt.input_handler("S")
+        ts0 = 1_700_000_000_000
+        plan = next((p for p in rt._plans
+                     if isinstance(p, DevicePatternPlan)), None)
+        for i, (dt, p) in enumerate(sends):
+            ih.send(("K", p, 1), timestamp=ts0 + dt)
+            if i % 2 == 1:
+                rt.flush()
+        rt.flush()
+        done = (None if plan is None or plan._arm_done is None
+                else bool(plan._arm_done.all()))
+        mgr.shutdown()
+        return rows, done
+
+    host, _d = run("@app:devicePatterns('never')\n")
+    assert host == [(101.0, 107.0)]
+    dev, done = run("@app:patternFamily('scan')\n"
+                    "@app:devicePatterns('always')\n")
+    assert dev == host, (dev, host)
+    assert done is True
 
 
 def test_tuning_cache_plan_family_round_trip(tmp_path):
