@@ -1037,17 +1037,32 @@ def trace_breakdown(app, n_batches=16, batch=2048, keys=8,
     rt.flush()
     wall = time.perf_counter() - t0
     rep = rt.statistics()
+    expl = rt.explain()
     n_trace = rt.stats.export_chrome_trace(trace_out)
     mgr.shutdown()
 
     stages = {st: td for st, td in rep["stages"].items()
               if td.get("seconds") and st not in ("parse", "plan")}
     covered = sum(td["seconds"] for td in stages.values())
+    # kernel-vs-host-dispatch split (ROADMAP item 2 "push the
+    # host-dispatch share down"): `kernel` + `transfer` is device-side
+    # wall (dispatch + execution wait + D2H); everything else — incl.
+    # uncovered python glue between spans — is host dispatch
+    dev_s = sum(stages.get(st, {}).get("seconds", 0.0)
+                for st in ("kernel", "transfer"))
+    # the chosen pattern plan family per query (the PR-6/13 families):
+    # a trace that can't name the family can't attribute a regression
+    families = {q: ent["family"] for q, ent in
+                expl.get("queries", {}).items() if ent.get("family")}
     out = {
         "events": n_timed, "batch": batch, "matches": delivered[0],
         "end_to_end_s": round(wall, 4),
         "eps": round(n_timed / wall),
         "coverage": round(covered / wall, 3),
+        "plan_family": (next(iter(families.values()))
+                        if len(families) == 1 else families) or None,
+        "kernel_share": round(dev_s / wall, 3),
+        "host_dispatch_share": round((wall - dev_s) / wall, 3),
         "stages": {st: {
             "seconds": round(td["seconds"], 4),
             "share": round(td["seconds"] / wall, 3),
@@ -1058,6 +1073,64 @@ def trace_breakdown(app, n_batches=16, batch=2048, keys=8,
     }
     if "device" in rep:
         out["device"] = rep["device"]
+    return out
+
+
+def tracing_overhead(smoke=True, reps=None) -> dict:
+    """The tracing plane's overhead contract (docs/OBSERVABILITY.md):
+    config-3 TCP-frame ingest eps with tracing OFF (`@app:trace('off')`
+    — `rt.tracing is None`, the pre-tracing hot path), ON-BUT-UNSAMPLED
+    (tracer live, the sampling modulo never fires — the always-on-ring
+    steady state), and the default 1-in-16 sampling.  Off and unsampled
+    must both cost <= 5% vs each other's envelope; variants run
+    interleaved round-robin and score best-of so thermal/GC drift
+    lands on every variant equally."""
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.net import TcpFrameClient
+
+    n = 1 << 14 if smoke else 1 << 16
+    batch = 1024 if smoke else 4096
+    warm = 2
+    tape = make_tape(n + warm * batch, batch)
+    batches = _tape_str_batches(tape)
+    n_timed = sum(t["n"] for t in tape[warm:])
+    reps = reps if reps is not None else (2 if smoke else 3)
+
+    def run(head):
+        mgr = SiddhiManager()
+        rt = mgr.create_app_runtime(
+            head + "@source(type='tcp', port='0')\n" + DEV["patterns"] + C3)
+        rt.start()
+        cli = TcpFrameClient("127.0.0.1", rt.sources[0].port, STREAM,
+                             TcpFrameClient.cols_of_schema(
+                                 rt.schemas[STREAM]))
+        for cols, ts in batches[:warm]:
+            cli.send_batch(cols, ts)
+        cli.barrier(timeout=120)
+        t0 = time.perf_counter()
+        for cols, ts in batches[warm:]:
+            cli.send_batch(cols, ts)
+        cli.barrier(timeout=120)
+        dt = time.perf_counter() - t0
+        cli.close()
+        mgr.shutdown()
+        return n_timed / dt
+
+    variants = {"off": "@app:trace('off')\n",
+                "unsampled": "@app:trace(sample='1000000000')\n",
+                "sampled_16": ""}           # the default
+    runs: dict = {k: [] for k in variants}
+    for _ in range(reps):
+        for name, head in variants.items():
+            runs[name].append(run(head))
+    eps = {k: max(v) for k, v in runs.items()}
+    out = {"events": n_timed, "batch": batch,
+           "eps": {k: round(v) for k, v in eps.items()}}
+    for k in ("unsampled", "sampled_16"):
+        out[f"{k}_overhead_pct"] = round(
+            100.0 * (1.0 - eps[k] / eps["off"]), 2)
+    # the acceptance bar: off and on-but-unsampled within 5%
+    out["pass"] = out["unsampled_overhead_pct"] <= 5.0
     return out
 
 
@@ -1996,8 +2069,8 @@ def _print_summary(summary: dict, cap: int = 2048) -> None:
     so the last stdout line ALWAYS round-trips through json.loads
     (pinned by scripts/smoke.sh and tests/test_bench_summary.py)."""
     drop_order = ("stage_shares_config3", "configs", "roofline",
-                  "transport", "trace_coverage_config3", "durability",
-                  "placement")
+                  "transport", "trace_coverage_config3", "tracing",
+                  "durability", "placement")
     try:
         line = json.dumps(summary)
         for key in drop_order:
@@ -2153,14 +2226,27 @@ def main(argv=None):
         }))
         return
     if "--trace" in argv:
-        # fast mode: per-stage breakdown of config 3 only (the
-        # diagnosability check — where does a detect-latency millisecond
-        # go?), one JSON line, ~seconds of runtime
+        # fast mode: per-stage breakdown (the diagnosability check —
+        # where does a detect-latency millisecond go?) of config 3 AND
+        # the partitioned config 4, each naming its chosen plan family
+        # and the kernel-vs-host-dispatch split (ROADMAP item 2's
+        # measurement), plus the frame-tracing overhead contract
         tr = trace_breakdown(DEV["patterns"] + C3)
+        head4 = "@app:partitionCapacity(1000)\n@app:deviceSlots(32)\n"
+        tr4 = _safe("trace config4", lambda: trace_breakdown(
+            head4 + C4, n_batches=8, batch=2048, keys=1000,
+            trace_out="bench_trace_c4.json"), {})
+        ov = _safe("tracing overhead",
+                   lambda: tracing_overhead(smoke=True), {})
         print(json.dumps({"metric": "stage_breakdown_config3",
                           "value": tr["coverage"],
                           "unit": "fraction_of_e2e_latency_attributed",
-                          **tr}))
+                          **tr,
+                          "config4": {k: tr4.get(k) for k in
+                                      ("eps", "coverage", "plan_family",
+                                       "kernel_share",
+                                       "host_dispatch_share")},
+                          "tracing_overhead": ov}))
         return
     t0 = time.perf_counter()
     configs = {}
@@ -2247,6 +2333,12 @@ def main(argv=None):
     configs["4_partitioned_1k"]["kernel_eps"] = kernel_eps(
         head + C4, "pattern", batch=1 << 18, keys=1000, info=info4)
     configs["4_partitioned_1k"]["plan_family"] = info4.get("plan_family")
+    # per-config stage breakdown (BENCH_DETAIL.json): the partitioned
+    # config's plan family + kernel-vs-host-dispatch split, small scale
+    configs["4_partitioned_1k"]["trace"] = _safe(
+        "trace config4", lambda: trace_breakdown(
+            head + C4, n_batches=8, batch=2048, keys=1000,
+            trace_out="bench_trace_c4.json"), {})
 
     c5 = c5_app(1000)
     c5_outs = tuple(f"Out{i}" for i in range(16))
@@ -2362,6 +2454,12 @@ def main(argv=None):
                     lambda: durability_bench(smoke=True), {})
     _mark("durability overhead done", t0)
 
+    # tracing-overhead column (ISSUE 15): the frame-tracing plane must
+    # cost <= 5% of config-3 TCP-ingest eps when off or on-but-unsampled
+    trace_ov = _safe("tracing overhead",
+                     lambda: tracing_overhead(smoke=True), {})
+    _mark("tracing overhead done", t0)
+
     # transport-vs-host-vs-kernel breakdown per config: the
     # "transport-bound" calibration note as a MEASURED column.  For each
     # config: the kernel-only ceiling, the end-to-end in-process engine
@@ -2419,6 +2517,7 @@ def main(argv=None):
         "roofline": roofline,
         "transport": net_res,
         "durability": dur_res,
+        "tracing": trace_ov,
         "transport_breakdown": breakdown,
         "configs": configs,
     }
@@ -2440,6 +2539,15 @@ def main(argv=None):
         "trace_coverage_config3": tr.get("coverage"),
         "stage_shares_config3": {st: d.get("share") for st, d in
                                  tr.get("stages", {}).items()},
+        # the tracing plane's overhead contract: off vs on-but-unsampled
+        # TCP-ingest eps (<= 5% — docs/OBSERVABILITY.md overhead table)
+        "tracing": ({"eps": trace_ov.get("eps"),
+                     "unsampled_overhead_pct":
+                         trace_ov.get("unsampled_overhead_pct"),
+                     "sampled_16_overhead_pct":
+                         trace_ov.get("sampled_16_overhead_pct"),
+                     "pass": trace_ov.get("pass")}
+                    if trace_ov else None),
         "roofline": {k: {kk: v.get(kk) for kk in
                          ("plan_family", "kernel_eps", "vs_native_cpp")}
                      for k, v in roofline.items()},

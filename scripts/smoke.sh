@@ -60,8 +60,8 @@ echo "== lock-witness vs static graph =="
 WITNESS_OUT="$(mktemp -u /tmp/siddhi_lock_witness.XXXXXX.json)"
 SIDDHI_LOCK_CHECK=1 SIDDHI_LOCK_WITNESS_OUT="$WITNESS_OUT" \
     python -m pytest tests/test_net_admission.py tests/test_net_server.py \
-    tests/test_wal.py tests/test_service.py -q -m 'not slow' \
-    -p no:cacheprovider
+    tests/test_wal.py tests/test_service.py tests/test_tracing.py \
+    -q -m 'not slow' -p no:cacheprovider
 python -m siddhi_tpu.analysis --threads --witness "$WITNESS_OUT"
 rm -f "$WITNESS_OUT"
 
@@ -79,6 +79,7 @@ base = f"http://127.0.0.1:{svc.port}"
 deadline = time.time() + 5.0
 try:
     app = ("@app:name('Smoke')\n"
+           "@app:trace('all')\n"       # every frame traced -> exemplars
            "define stream S (sym string, p double);\n"
            "@info(name='q') from S[p > 10] select sym, p insert into Out;\n")
     req = urllib.request.Request(f"{base}/siddhi/artifact/deploy",
@@ -103,12 +104,28 @@ try:
     assert 'siddhi_tpu_events_total{app="Smoke",stream="S"} 20' in text, \
         "events_total never reached 20:\n" + text[:1500]
     assert "siddhi_tpu_query_latency_seconds" in text
+    # classic 0.0.4 response: exemplar syntax is ILLEGAL here — a real
+    # Prometheus text parser would reject the whole exposition
+    assert " # {trace_id=" not in text
     for ln in text.splitlines():             # exposition parses
         if ln and not ln.startswith("#"):
             float("nan") if ln.rsplit(" ", 1)[1] == "NaN" \
                 else float(ln.rsplit(" ", 1)[1])
-    print(f"OK: /metrics valid, nonzero counters "
-          f"({len(text.splitlines())} lines)")
+    # the tracing plane's exemplars ride the Accept-negotiated
+    # OpenMetrics form (docs/OBSERVABILITY.md): the dispatch-latency
+    # histogram buckets must carry a trace id there
+    req = urllib.request.Request(
+        f"{base}/metrics",
+        headers={"Accept": "application/openmetrics-text; version=1.0.0"})
+    with urllib.request.urlopen(req) as r:
+        assert "openmetrics-text" in r.headers["Content-Type"]
+        om = r.read().decode()
+    assert "siddhi_tpu_stream_dispatch_latency_seconds_bucket" in om
+    assert any(" # {trace_id=" in ln for ln in om.splitlines()), \
+        "no exemplar on the dispatch-latency histogram"
+    assert om.rstrip().endswith("# EOF")
+    print(f"OK: /metrics valid, nonzero counters; exemplars on the "
+          f"OpenMetrics form ({len(text.splitlines())} lines)")
 finally:
     svc.stop()
 EOF
@@ -160,6 +177,76 @@ try:
 finally:
     svc.stop()
 EOF
+
+echo "== frame tracing smoke =="
+# the causal tracing plane end-to-end (docs/OBSERVABILITY.md "Frame
+# tracing"): deploy over REST, send one TCP columnar frame with a
+# PRODUCER-stamped trace id, then assert GET /siddhi/artifact/trace
+# serves a Chrome trace_event object containing that trace.  The JSON
+# is linted on disk with `python -m json.tool` + a required-key check.
+TRACE_JSON="$(mktemp -u /tmp/siddhi_trace_smoke.XXXXXX.json)"
+python - "$TRACE_JSON" <<'EOF'
+import json
+import sys
+import urllib.request
+
+import numpy as np
+
+from siddhi_tpu.net import TcpFrameClient
+from siddhi_tpu.service import SiddhiService
+
+out_path = sys.argv[1]
+svc = SiddhiService(port=0).start()
+base = f"http://127.0.0.1:{svc.port}"
+try:
+    app = ("@app:name('TraceSmoke')\n"
+           "@app:trace('all')\n"
+           "define stream S (sym string, p double);\n"
+           "@info(name='q') from S[p > 10] select sym, p insert into Out;\n")
+    req = urllib.request.Request(f"{base}/siddhi/artifact/deploy",
+                                 data=app.encode(), method="POST")
+    urllib.request.urlopen(req).read()
+    rt = svc.runtimes["TraceSmoke"]
+    cli = TcpFrameClient("127.0.0.1", svc.net_port, "S",
+                         TcpFrameClient.cols_of_schema(rt.schemas["S"]),
+                         app="TraceSmoke")
+    cli.send_batch({"sym": np.array(["A", "B", "C", "D"]),
+                    "p": np.array([11.0, 12.0, 13.0, 14.0])},
+                   np.arange(4, dtype=np.int64),
+                   trace_id="smoke-trace-1")
+    cli.barrier(timeout=30)
+    cli.close()
+    with urllib.request.urlopen(
+            f"{base}/siddhi/artifact/trace?siddhiApp=TraceSmoke") as r:
+        blob = r.read()
+    with open(out_path, "wb") as f:
+        f.write(blob)
+    obj = json.loads(blob)
+    spans = [ev for ev in obj["traceEvents"] if ev.get("ph") == "X"
+             and ev.get("args", {}).get("trace") == "smoke-trace-1"]
+    names = {ev["name"] for ev in spans}
+    for want in ("frame", "admit", "freeze", "dispatch"):
+        assert want in names, (want, sorted(names))
+    print(f"OK: producer trace id served with {len(spans)} spans "
+          f"({sorted(names)})")
+finally:
+    svc.stop()
+EOF
+# Chrome trace_event schema lint: valid JSON + the required keys
+python -m json.tool "$TRACE_JSON" > /dev/null
+python - "$TRACE_JSON" <<'EOF'
+import json
+import sys
+obj = json.load(open(sys.argv[1]))
+assert isinstance(obj.get("traceEvents"), list) and obj["traceEvents"]
+md = obj.get("metadata")
+assert isinstance(md, dict) and md.get("hostname"), md
+for ev in obj["traceEvents"]:
+    assert ev.get("ph") in ("X", "M") and "name" in ev and "pid" in ev, ev
+print("OK: Chrome trace JSON schema valid "
+      f"({len(obj['traceEvents'])} events, host {md['hostname']})")
+EOF
+rm -f "$TRACE_JSON"
 
 echo "== kill -9 recovery smoke =="
 # exactly-once durable serving end-to-end (docs/RELIABILITY.md): start a

@@ -221,10 +221,27 @@ def _inventory(files: list, model: PackageModel) -> list:
     return files
 
 
+# sentinel class ID for receivers constructed from stdlib modules:
+# their method calls (Thread.start, Event.set, ...) must resolve to
+# NOTHING instead of falling back onto same-named engine methods —
+# `t = threading.Thread(...); t.start()` used to compose every engine
+# `start()` (SiddhiAppRuntime.start included) into the caller's
+# blocking closure, minting false SL05 chains through nonblocking
+# stdlib calls
+_EXTERNAL = "<external>"
+_EXTERNAL_MODULES = {"threading", "queue", "socket", "subprocess"}
+
+
 def _expr_type(value, model: PackageModel) -> Optional[str]:
     """Best-effort class ID for an assigned expression (None when the
-    constructor name is ambiguous across modules)."""
+    constructor name is ambiguous across modules; the `_EXTERNAL`
+    sentinel for stdlib-module constructors)."""
     if isinstance(value, pyast.Call):
+        f = value.func
+        if isinstance(f, pyast.Attribute) and \
+                isinstance(f.value, pyast.Name) and \
+                f.value.id in _EXTERNAL_MODULES:
+            return _EXTERNAL
         name = call_name(value)
         if name is not None:
             return model.class_id_for_name(name)
@@ -630,8 +647,12 @@ def _resolve_callees(model: PackageModel, site: CallSite,
     """Candidate MethodInfos for a call site.  An unresolved receiver
     with a non-generic method name owned by a FEW classes resolves to
     ALL of them — over-approximation keeps the static graph a superset
-    of what the runtime lock-witness can observe."""
+    of what the runtime lock-witness can observe.  A receiver typed to
+    the stdlib sentinel resolves to nothing: its methods are real but
+    not engine code, and the name fallback must not alias them."""
     owner = cls if site.recv == "self" else site.recv
+    if owner == _EXTERNAL:
+        return []
     if owner is not None:
         ci = model.classes.get(owner)
         m = ci.methods.get(site.name) if ci is not None else None

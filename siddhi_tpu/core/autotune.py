@@ -548,6 +548,12 @@ class SLOController:
         # ADMISSION before engine latency collapses (ROADMAP item 3)
         self.admission_factor = 1.0
         self.admission_floor = 0.1
+        # SLO-breach trace trigger (core/tracing.py): called with the
+        # decision record whenever a window's p99 overshoots the target.
+        # The runtime wires it to FrameTracer.trigger — nonblocking
+        # enqueue, safe even though maybe_decide runs under the runtime
+        # lock (the dump builds on the siddhi-trace-export thread)
+        self.on_breach: Optional[Callable[[dict], None]] = None
 
     def observe(self, seconds: float) -> None:
         """One per-batch latency sample (first buffered event ->
@@ -592,6 +598,14 @@ class SLOController:
                "batch_from": old, "batch": new,
                "admission_factor": round(self.admission_factor, 4)}
         self.decisions.append(dec)
+        if action == "decrease" and self.on_breach is not None:
+            # a p99 breach IS the trigger the tracing plane retains a
+            # dump for — the handler only enqueues, so firing under the
+            # runtime lock (the _drain call site) is safe
+            try:
+                self.on_breach(dec)
+            except Exception:
+                pass
         self._win.reset()
         self._last_decide = now_s
         return dec

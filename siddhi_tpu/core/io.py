@@ -564,9 +564,29 @@ class Sink:
 
     def publish_attempt(self, payload) -> None:
         """One raw publish attempt through the fault-injection point
-        (also the replay entry used by ErrorStore.replay)."""
+        (also the replay entry used by ErrorStore.replay).  Records a
+        `sink.publish` span on the originating frame's trace: the live
+        thread-local scope (set by runtime._flush_sink_outbox) for
+        in-line publishes, or the resumable ctx a stored payload
+        carries — so an ErrorStore replay after a breaker shed still
+        lands on the SAME tree, not an orphan."""
         self.rt.inject("sink.publish", self.stream_id)
-        self.publish(payload)
+        h = getattr(getattr(self.rt, "_trace_tls", None), "handle", None)
+        if h is None:
+            tc = getattr(payload, "trace_ctx", None)
+            tr = getattr(self.rt, "tracing", None)
+            if tc is not None and tr is not None:
+                h = tr.resume(*tc)
+        if h is None:
+            self.publish(payload)
+            return
+        t0 = time.perf_counter()
+        try:
+            self.publish(payload)
+        finally:
+            h.mark("sink.publish", t0, time.perf_counter() - t0,
+                  sink=self.stream_id,
+                  transport=getattr(self, "transport", type(self).__name__))
 
     def _publish_guarded(self, payload) -> None:
         if not self.breaker.allow():
@@ -585,6 +605,13 @@ class Sink:
                 self.failures += 1
                 self.breaker.on_failure()
                 if self.breaker.state == self.breaker.OPEN:
+                    tr = getattr(self.rt, "tracing", None)
+                    if tr is not None:
+                        # enqueue-only (cooldown-throttled): the dump
+                        # builds on the siddhi-trace-export thread
+                        tr.trigger("breaker_open",
+                                   f"sink on {self.stream_id!r}: "
+                                   f"{type(e).__name__}: {e}")
                     break
                 delay = next(delays, None)
                 if delay is None:
@@ -814,9 +841,13 @@ def build_io(rt) -> None:
                 rt.sinks.append(sink)
                 # stage into the runtime's outbox instead of publishing
                 # under the runtime lock (cross-runtime ABBA deadlock —
-                # runtime._flush_sink_outbox delivers after release)
+                # runtime._flush_sink_outbox delivers after release).
+                # The active frame-trace handle (scatter runs under the
+                # frame's scope) rides the entry so egress spans land on
+                # the right tree when the outbox flushes later
                 def _stage(events, _sink=sink, _rt=rt):
-                    _rt._sink_outbox.append((_sink.on_events, events))
+                    _rt._sink_outbox.append(
+                        (_sink.on_events, events, _rt.current_trace()))
                 rt._stream_callbacks[sid].append(_stage)
 
 

@@ -145,10 +145,27 @@ class DispatchPipeline:
             t_disp = self._t_disp.pop(0)
             t0 = time.perf_counter()
             self.overlap_s += t0 - t_disp
+            # frame tracing: a deferred entry still knows the batch it
+            # was dispatched for — the materialize span (which may land
+            # D batches later, on the scheduler thread) parents on that
+            # frame's tree, and the materialized outputs inherit the
+            # handle so sink egress stays connected
+            od = None if origin is None \
+                else getattr(origin[1], "__dict__", None)
+            h = None if od is None else od.get("_trace")
             try:
                 if self.inject is not None:
                     self.inject()       # "d2h" fault-injection point
-                self._ready.extend(self._materialize(entry))
+                res = self._materialize(entry)
+                if h is not None:
+                    res = list(res)
+                    h.mark("materialize", t0, time.perf_counter() - t0,
+                          plan=self.plan)
+                    for r in res:
+                        b = getattr(r, "batch", None)
+                        if b is not None:
+                            b.__dict__.setdefault("_trace", h)
+                self._ready.extend(res)
             except Exception as e:
                 # attribute the failure to the batch this entry was
                 # dispatched for; the entry is consumed — later entries

@@ -277,6 +277,22 @@ class SiddhiAppRuntime:
         self._wal_recovery = None        # last recover() report
         self.last_revision_descriptor = None   # last persist() Revision
 
+        # end-to-end frame tracing (core/tracing.py): cross-thread span
+        # trees carried by Work/EventBatch/sink-outbox entries, plus the
+        # trigger registry that promotes the always-on ring into retained
+        # dumps.  `@app:trace('off')` -> None (zero hot-path cost); the
+        # thread-local scope hands the active frame's handle across the
+        # feed -> freeze -> dispatch -> egress call chain.
+        from .tracing import tracer_from_annotations
+        self.tracing = tracer_from_annotations(app)
+        self._trace_tls = threading.local()
+        if self.slo is not None and self.tracing is not None:
+            _tr = self.tracing
+            self.slo.on_breach = lambda dec: _tr.trigger(
+                "slo_breach",
+                f"window p99 {dec.get('p99_ms')}ms > target "
+                f"{dec.get('target_ms')}ms at batch {dec.get('batch_from')}")
+
         # fault-tolerance state: the replayable ErrorStore behind
         # @OnError(action='store') and sink on.error, the per-plan
         # degradation ladders, and the (optional) seeded fault injector
@@ -437,6 +453,11 @@ class SiddhiAppRuntime:
         sources + trigger schedulers; Scheduler.java:89 timer service)."""
         from .trigger import TriggerRuntime
         self._started = True
+        if self.tracing is not None:
+            # shutdown()/start() cycle: the closed tracer must re-arm
+            # (the WAL-reopen analog) or every trigger after the restart
+            # would be silently dropped
+            self.tracing.reopen()
         if self.durability != "off" and self.wal is None:
             if self._wal_recovery is None:
                 # the recovery manager runs on start (start/redeploy):
@@ -627,6 +648,22 @@ class SiddhiAppRuntime:
     def statistics(self) -> dict:
         return self.stats.report()
 
+    # -- frame tracing (core/tracing.py) -------------------------------------
+
+    def current_trace(self):
+        """The frame TraceHandle active on THIS thread (None when the
+        in-flight work is untraced) — set by the net feed path, the
+        dispatch loop's scatter block, and the sink outbox flush."""
+        return getattr(self._trace_tls, "handle", None)
+
+    def _set_trace(self, h):
+        """Install `h` as this thread's active trace; returns the
+        previous handle for the caller's finally-restore."""
+        tls = self._trace_tls
+        prev = getattr(tls, "handle", None)
+        tls.handle = h
+        return prev
+
     def explain(self) -> dict:
         """The EXPLAIN plane (core/placement.py): per-query execution
         path (device family vs interpreter), chosen pattern plan family,
@@ -689,6 +726,8 @@ class SiddhiAppRuntime:
             # post-shutdown send has no durability claim to honor)
             self.wal.close()
             self._wal_closed, self.wal = self.wal, None
+        if self.tracing is not None:
+            self.tracing.close()     # flush pending dumps, join exporter
         self._started = False
 
     # -- time ----------------------------------------------------------------
@@ -920,12 +959,28 @@ class SiddhiAppRuntime:
         append propagates: the frame must not be processed with no
         durable record (the net feed path captures it whole into the
         ErrorStore; direct senders see the error)."""
+        # frame tracing: a net-fed frame carries its handle in the
+        # thread-local scope (producer-stamped or admission-sampled);
+        # anything else — direct sends, REST rows — makes its sampling
+        # decision here, where every externally admitted frame is born
+        h = getattr(self._trace_tls, "handle", None)
+        if h is None and self.tracing is not None:
+            h = self.tracing.begin_frame(stream_id)
+        t0f = time.perf_counter() if h is not None else 0.0
         batch = b.freeze_and_clear()
+        if h is not None:
+            batch.__dict__["_trace"] = h
         if self.wal is not None and not self._wal_replaying:
             try:
-                self.wal.append(stream_id, batch.timestamps,
-                                batch.columns, self.strings,
-                                schema=batch.schema)
+                t0w = time.perf_counter() if h is not None else 0.0
+                seq = self.wal.append(stream_id, batch.timestamps,
+                                      batch.columns, self.strings,
+                                      schema=batch.schema)
+                if h is not None:
+                    # the trace rides the WAL plane's frame identity:
+                    # the per-stream durable seq names this frame
+                    h.mark("wal.append", t0w, time.perf_counter() - t0w,
+                          stream=stream_id, seq=seq)
             except BaseException as e:
                 # the builder is already cleared: rows buffered by
                 # EARLIER successful sends ride this frozen batch, so a
@@ -941,6 +996,9 @@ class SiddhiAppRuntime:
                 self.stats.on_fault(stream_id, "wal.append")
                 e._wal_captured = True
                 raise
+        if h is not None:
+            h.mark("freeze", t0f, time.perf_counter() - t0f,
+                  stream=stream_id, events=batch.n)
         if self.slo is not None:
             t0 = self._builder_t0.pop(stream_id, None)
             batch.__dict__["_slo_t0"] = \
@@ -1058,13 +1116,28 @@ class SiddhiAppRuntime:
     def _flush_sink_outbox(self) -> None:
         """Deliver staged sink payloads outside the runtime lock.  When
         called from a nested frame the outer frame may still hold the RLock;
-        the outermost public entry always ends with an unlocked flush."""
+        the outermost public entry always ends with an unlocked flush.
+        The net feed path DEFERS delivery past its feed-vs-retire gate
+        (thread-local `defer_sink`): a sink retry backoff must never
+        stall an undeploy waiting on the gate."""
+        if getattr(self._trace_tls, "defer_sink", 0):
+            return                      # the gate holder flushes after
         while True:
             try:        # pop-then-use: safe vs the scheduler pump thread
-                fn, events = self._sink_outbox.pop(0)
+                fn, events, h = self._sink_outbox.pop(0)
             except IndexError:
                 return
-            fn(events)
+            if h is None:
+                fn(events)
+                continue
+            # deliver under the originating frame's trace scope so the
+            # sink records its publish span on the right tree even when
+            # the flush happens on the scheduler/ingest thread
+            prev = self._set_trace(h)
+            try:
+                fn(events)
+            finally:
+                self._trace_tls.handle = prev
 
     def _drain(self) -> None:
         guard = 0
@@ -1122,16 +1195,32 @@ class SiddhiAppRuntime:
                 self._pending[:0] = [(sid, h) for h in halves]
                 continue
             # the stream timer opens a batch-trace scope and feeds the
-            # per-stream latency histogram (one clock read per batch)
-            with self.stats.time_stream(sid, batch.n):
+            # per-stream latency histogram (one clock read per batch);
+            # a traced frame's id rides into the histogram as the
+            # bucket exemplar (`/metrics` OpenMetrics exemplars)
+            h_tr = batch.__dict__.get("_trace")
+            with self.stats.time_stream(
+                    sid, batch.n,
+                    trace_id=None if h_tr is None else h_tr.trace_id):
                 cbs_b = self._batch_callbacks.get(sid, ())
                 cbs_s = self._stream_callbacks.get(sid, ())
                 if cbs_b or cbs_s:
-                    with self.stats.stage("scatter", events=batch.n):
-                        for cb in cbs_b:
-                            cb(batch)
-                        for cb in cbs_s:    # junction callbacks: each gets
-                            cb(self._decode(batch))   # its own Event list
+                    # scatter under the frame's trace scope: the sink
+                    # stage callback (io.build_io) snapshots the active
+                    # handle into its outbox entry, so egress spans land
+                    # on this frame's tree even though publish happens
+                    # later, outside the lock, possibly on another thread
+                    prev_tr = self._set_trace(h_tr) \
+                        if h_tr is not None else None
+                    try:
+                        with self.stats.stage("scatter", events=batch.n):
+                            for cb in cbs_b:
+                                cb(batch)
+                            for cb in cbs_s:  # junction callbacks: each
+                                cb(self._decode(batch))  # gets its own list
+                    finally:
+                        if h_tr is not None:
+                            self._trace_tls.handle = prev_tr
                 fault_err = None
                 subs = self._subscribers.get(sid, ())
                 # dispatch round: every subscribed plan dispatches its
@@ -1147,6 +1236,7 @@ class SiddhiAppRuntime:
                 for plan in subs:
                     if self._debugger is not None:
                         self._debugger.check_in(plan, batch)
+                    t0d = time.perf_counter() if h_tr is not None else 0.0
                     try:
                         if self.stats.enabled:
                             with self.stats.time_plan(plan.name, batch.n):
@@ -1160,6 +1250,14 @@ class SiddhiAppRuntime:
                                 raise
                             fault_err = e    # route once per batch, below
                             continue
+                    if h_tr is not None:
+                        h_tr.mark("dispatch", t0d,
+                                 time.perf_counter() - t0d, plan=plan.name)
+                        for ob in obs:
+                            # derived emissions inherit the frame's trace
+                            # so downstream drains + sink egress stay on
+                            # one connected tree
+                            ob.batch.__dict__.setdefault("_trace", h_tr)
                     if self._debugger is not None:
                         self._debugger.check_out(plan, obs)
                     for ob in obs:
@@ -1471,6 +1569,12 @@ class SiddhiAppRuntime:
             "plan": plan.name, "at_ms": self.now_ms(),
             "after_failures": lad.failures,
             "error": f"{type(err).__name__}: {err}"})
+        if self.tracing is not None:
+            # nonblocking enqueue (we hold the runtime lock here): the
+            # dump itself is built on the siddhi-trace-export thread
+            self.tracing.trigger(
+                "quarantine", f"plan {plan.name!r}: "
+                              f"{type(err).__name__}: {err}")
         warnings.warn(
             f"plan {plan.name!r} quarantined onto the interpreter path "
             f"after {lad.consecutive} consecutive device dispatch "
@@ -1890,11 +1994,17 @@ class SiddhiAppRuntime:
                 f"{self._wal_disabled_reason}", RuntimeWarning)
             return None
         from .wal import WriteAheadLog
+        tr = self.tracing
         self.wal = WriteAheadLog(d, policy=self.durability,
                                  segment_bytes=self._wal_segment_bytes,
                                  inject=self.inject,
                                  armed=lambda:
-                                 self.fault_injector is not None)
+                                 self.fault_injector is not None,
+                                 on_stall=None if tr is None else
+                                 (lambda dt: tr.trigger(
+                                     "wal_stall",
+                                     f"durability barrier took "
+                                     f"{dt * 1e3:.1f}ms")))
         # seq continuity past what the disk scan can see: truncation
         # behind a snapshot barrier may have emptied the log, so floor
         # the counters with the restored watermark (crash recovery) and

@@ -126,23 +126,71 @@ class Histogram:
 # trackers
 # ---------------------------------------------------------------------------
 
+# coarse upper bounds (seconds) for the Prometheus histogram render +
+# its trace-id exemplars; the +Inf bucket is implicit
+EXEMPLAR_LE = (0.001, 0.005, 0.025, 0.1, 0.5, 2.5)
+
+
+def _le_label(seconds: float) -> str:
+    for le in EXEMPLAR_LE:
+        if seconds <= le:
+            return str(le)
+    return "+Inf"
+
+
 class Tracker:
-    __slots__ = ("events", "batches", "seconds", "hist")
+    __slots__ = ("events", "batches", "seconds", "hist", "exemplars")
 
     def __init__(self):
         self.events = 0
         self.batches = 0
         self.seconds = 0.0
         self.hist = Histogram()
+        # le-label -> (trace_id, observed_seconds, unix_ts): the last
+        # TRACED sample per coarse bucket — OpenMetrics exemplars on
+        # the /metrics histogram render (docs/OBSERVABILITY.md)
+        self.exemplars: Optional[dict] = None
 
-    def observe(self, seconds: float, events: int = 0) -> None:
-        """One timed batch."""
+    def observe(self, seconds: float, events: int = 0,
+                trace_id: Optional[str] = None) -> None:
+        """One timed batch; a traced frame's id becomes the bucket
+        exemplar linking the latency histogram back to its span tree."""
         self.events += events
         self.batches += 1
         self.seconds += seconds
         self.hist.record(seconds)
+        if trace_id is not None:
+            if self.exemplars is None:
+                self.exemplars = {}
+            self.exemplars[_le_label(seconds)] = (
+                trace_id, seconds, time.time())
 
-    def as_dict(self) -> dict:
+    def bucket_counts(self) -> dict:
+        """Cumulative sample counts per EXEMPLAR_LE bound (+Inf last),
+        aggregated from the fine log buckets — the Prometheus
+        histogram render; computed at scrape time, never on the hot
+        path."""
+        edges = EXEMPLAR_LE
+        totals = [0] * (len(edges) + 1)
+        for i, c in enumerate(self.hist.counts):
+            if not c:
+                continue
+            hi = self.hist.bucket_hi(i)
+            for j, le in enumerate(edges):
+                if hi <= le * (1.0 + 1e-9):
+                    totals[j] += c
+                    break
+            else:
+                totals[-1] += c
+        out = {}
+        acc = 0
+        for j, le in enumerate(edges):
+            acc += totals[j]
+            out[str(le)] = acc
+        out["+Inf"] = self.hist.count
+        return out
+
+    def as_dict(self, buckets: bool = False) -> dict:
         d = {"events": self.events, "batches": self.batches}
         if self.seconds:
             d["seconds"] = self.seconds
@@ -156,6 +204,13 @@ class Tracker:
                 v = self.hist.percentile(p)
                 if v is not None:
                     d[f"p{p}_ms"] = round(v * 1e3, 4)
+            if buckets:
+                d["buckets"] = self.bucket_counts()
+                if self.exemplars:
+                    # list() snapshot: a scrape races the dispatch
+                    # thread's first insert into a new coarse bucket
+                    d["exemplars"] = {k: list(v) for k, v in
+                                      list(self.exemplars.items())}
         return d
 
 
@@ -305,12 +360,13 @@ class _PlanTimer:
 
 
 class _StreamTimer:
-    __slots__ = ("mgr", "sid", "n", "start")
+    __slots__ = ("mgr", "sid", "n", "start", "trace_id")
 
-    def __init__(self, mgr, sid, n):
+    def __init__(self, mgr, sid, n, trace_id=None):
         self.mgr = mgr
         self.sid = sid
         self.n = n
+        self.trace_id = trace_id
 
     def __enter__(self):
         self.mgr.tracer.begin_batch(f"{self.sid} x{self.n}")
@@ -319,7 +375,8 @@ class _StreamTimer:
 
     def __exit__(self, *exc):
         dt = time.perf_counter() - self.start
-        self.mgr.stream_in[self.sid].observe(dt, self.n)
+        self.mgr.stream_in[self.sid].observe(dt, self.n,
+                                             trace_id=self.trace_id)
         self.mgr.tracer.end_batch()
         return False
 
@@ -481,19 +538,31 @@ def _fmt(v) -> str:
 
 class _Prom:
     """Accumulates samples grouped per metric so # HELP / # TYPE render
-    exactly once per metric name (the exposition-format requirement)."""
+    exactly once per metric name (the exposition-format requirement).
+    `openmetrics=True` attaches exemplars and the `# EOF` terminator —
+    exemplar syntax is ONLY legal under the OpenMetrics content type; a
+    classic text-format (0.0.4) scrape must never meet one, or a real
+    Prometheus parser rejects the whole exposition."""
 
-    def __init__(self):
+    def __init__(self, openmetrics: bool = False):
+        self.openmetrics = openmetrics
         self.metrics: dict = {}          # name -> (type, help, [samples])
 
     def add(self, name, mtype, help_, labels: dict, value,
-            suffix: str = "") -> None:
+            suffix: str = "", exemplar=None) -> None:
         if value is None:
             return
         ent = self.metrics.setdefault(name, (mtype, help_, []))
         lab = ",".join(f'{k}="{_esc(v)}"' for k, v in labels.items())
-        ent[2].append(f"{name}{suffix}{{{lab}}} {_fmt(value)}"
-                      if lab else f"{name}{suffix} {_fmt(value)}")
+        line = (f"{name}{suffix}{{{lab}}} {_fmt(value)}"
+                if lab else f"{name}{suffix} {_fmt(value)}")
+        if exemplar is not None and self.openmetrics:
+            # OpenMetrics exemplar syntax: `# {labels} value timestamp`
+            # — the trace id links this bucket back to its span tree
+            tid, ev, ets = exemplar
+            line += (f' # {{trace_id="{_esc(tid)}"}} '
+                     f'{_fmt(float(ev))} {_fmt(float(ets))}')
+        ent[2].append(line)
 
     def render(self) -> str:
         out = []
@@ -501,6 +570,8 @@ class _Prom:
             out.append(f"# HELP {name} {help_}")
             out.append(f"# TYPE {name} {mtype}")
             out.extend(samples)
+        if self.openmetrics:
+            out.append("# EOF")
         return "\n".join(out) + "\n"
 
 
@@ -516,10 +587,14 @@ def _summary(doc: _Prom, name: str, help_: str, labels: dict, td: dict):
             suffix="_count")
 
 
-def render_prometheus(reports: dict) -> str:
+def render_prometheus(reports: dict, openmetrics: bool = False) -> str:
     """reports: {app_name: StatisticsManager.report() dict} ->
-    Prometheus text exposition (format 0.0.4)."""
-    doc = _Prom()
+    text exposition.  Default: classic Prometheus format 0.0.4 (no
+    exemplars).  `openmetrics=True` — served when the scraper's Accept
+    header negotiates `application/openmetrics-text` — attaches
+    trace-id exemplars to histogram buckets and terminates with
+    `# EOF`."""
+    doc = _Prom(openmetrics=openmetrics)
     for app, rep in reports.items():
         al = {"app": app}
         doc.add("siddhi_tpu_uptime_seconds", "gauge",
@@ -534,6 +609,23 @@ def render_prometheus(reports: dict) -> str:
             if "p50_ms" in td:
                 _summary(doc, "siddhi_tpu_stream_latency_seconds",
                          "per-batch dispatch latency per stream", sl, td)
+            bk = td.get("buckets")
+            if bk:
+                # real histogram render of the same latency data: the
+                # bucket lines carry trace-id exemplars for frames the
+                # tracing plane sampled (docs/OBSERVABILITY.md)
+                hn = "siddhi_tpu_stream_dispatch_latency_seconds"
+                hh = ("per-batch dispatch latency histogram per stream; "
+                      "buckets carry trace-id exemplars")
+                ex = td.get("exemplars") or {}
+                for le, c in bk.items():
+                    doc.add(hn, "histogram", hh, {**sl, "le": le}, c,
+                            suffix="_bucket",
+                            exemplar=tuple(ex[le]) if le in ex else None)
+                doc.add(hn, "histogram", hh, sl, td.get("seconds", 0.0),
+                        suffix="_sum")
+                doc.add(hn, "histogram", hh, sl, td.get("batches", 0),
+                        suffix="_count")
         for qn, td in rep.get("queries", {}).items():
             ql = {**al, "query": qn}
             doc.add("siddhi_tpu_query_events_total", "counter",
@@ -731,6 +823,22 @@ def render_prometheus(reports: dict) -> str:
                 doc.add("siddhi_tpu_wal_replayed_events", "gauge",
                         "events replayed by the last recovery", al,
                         rec.get("replayed_events"))
+        # frame-tracing series (core/tracing.py)
+        trc = rep.get("tracing")
+        if trc:
+            doc.add("siddhi_tpu_trace_traces_total", "counter",
+                    "frame traces started (sampled + producer-stamped)",
+                    al, trc.get("traces_started"))
+            doc.add("siddhi_tpu_trace_ring_spans", "gauge",
+                    "spans currently retained in the flight ring", al,
+                    trc.get("ring_spans"))
+            doc.add("siddhi_tpu_trace_dumps", "gauge",
+                    "retained trigger-promoted trace dumps", al,
+                    trc.get("dumps"))
+            for kind, n in (trc.get("triggers") or {}).items():
+                doc.add("siddhi_tpu_trace_triggers_total", "counter",
+                        "trace-dump triggers by kind",
+                        {**al, "kind": kind}, n)
         slo = rep.get("slo")
         if slo:
             doc.add("siddhi_tpu_slo_target_seconds", "gauge",
@@ -832,12 +940,14 @@ class StatisticsManager:
 
     # -- recording hooks -----------------------------------------------------
 
-    def time_stream(self, sid: str, n: int):
+    def time_stream(self, sid: str, n: int, trace_id=None):
         """Times one micro-batch's full pass through the dispatch loop
-        (callbacks + every subscribed plan) and opens a batch-trace scope."""
+        (callbacks + every subscribed plan) and opens a batch-trace
+        scope; a traced frame's id rides into the latency histogram as
+        the bucket exemplar."""
         if not self.enabled:
             return _NOOP
-        return _StreamTimer(self, sid, n)
+        return _StreamTimer(self, sid, n, trace_id)
 
     def time_plan(self, name: str, n: int):
         """Context manager timing one plan.process batch."""
@@ -929,7 +1039,9 @@ class StatisticsManager:
         rep = {
             "uptime_s": up,
             # list() snapshots: scrapes race the dispatch thread's inserts
-            "streams": {k: v.as_dict()
+            # (streams carry histogram buckets + trace-id exemplars for
+            # the /metrics histogram render)
+            "streams": {k: v.as_dict(buckets=True)
                         for k, v in list(self.stream_in.items())},
             "queries": {k: v.as_dict() for k, v in list(self.query.items())},
             "stages": {k: v.as_dict() for k, v in list(self.stages.items())},
@@ -1016,10 +1128,17 @@ class StatisticsManager:
         # silent demotion would be
         if getattr(self.rt, "durability", "off") != "off":
             rep["durability"] = self.rt.durability_report()
+        # frame tracing (core/tracing.py): sampling/ring/trigger gauges.
+        # ALWAYS present when the tracer exists (not gated on `enabled`)
+        # — a triggered dump must be discoverable from any scrape
+        tr = getattr(self.rt, "tracing", None)
+        if tr is not None:
+            rep["tracing"] = tr.metrics()
         return rep
 
-    def prometheus(self) -> str:
-        return render_prometheus({self.rt.app.name: self.report()})
+    def prometheus(self, openmetrics: bool = False) -> str:
+        return render_prometheus({self.rt.app.name: self.report()},
+                                 openmetrics=openmetrics)
 
     def export_chrome_trace(self, path: str) -> int:
         """Write the flight recorder as Chrome trace_event JSON; returns

@@ -119,7 +119,9 @@ class WriteAheadLog:
     def __init__(self, directory: str, policy: str = "batch",
                  segment_bytes: int = 8 << 20,
                  inject: Optional[Callable[[str, str], None]] = None,
-                 armed: Optional[Callable[[], bool]] = None):
+                 armed: Optional[Callable[[], bool]] = None,
+                 on_stall: Optional[Callable[[float], None]] = None,
+                 stall_budget_s: Optional[float] = None):
         if policy not in POLICIES or policy == "off":
             raise WalError(f"unknown WAL sync policy {policy!r} "
                            f"(have: batch | fsync)")
@@ -133,6 +135,13 @@ class WriteAheadLog:
         # unarmed fast path is ONE buffered write — the per-frame cost
         # the <=15% 'batch' overhead budget is built on.
         self.armed = armed or (lambda: False)
+        # barrier-stall observability (core/tracing.py trigger registry):
+        # a durability barrier slower than the budget fires `on_stall`
+        # AFTER the lock is released — the callback (a trace-dump
+        # trigger) must never run under the WAL lock
+        self.on_stall = on_stall
+        self.stall_budget_s = stall_budget_s if stall_budget_s is not None \
+            else float(os.environ.get("SIDDHI_WAL_STALL_S", "0.25"))
         self._lock = new_rlock("WriteAheadLog._lock")
         self._f = None                  # open segment file object
         self._seg_no = 0
@@ -340,12 +349,24 @@ class WriteAheadLog:
 
     def barrier(self) -> None:
         """Make everything appended so far durable (the PING/ACK and
-        snapshot barrier).  Cheap when nothing new was appended."""
+        snapshot barrier).  Cheap when nothing new was appended.  A
+        barrier slower than `stall_budget_s` reports through `on_stall`
+        (outside the lock) — the ACK path is blocked exactly that long,
+        which is the latency the trigger's trace dump attributes."""
+        t0 = time.perf_counter()
         with self._lock:
             if self._f is None or not self._unsynced:
                 return
             self._f.flush()
             self._fsync_locked()
+        dt = time.perf_counter() - t0
+        if self.on_stall is not None and dt > self.stall_budget_s:
+            try:
+                self.on_stall(dt)
+            except Exception:
+                # the observability hook must never fail a durability
+                # barrier that already succeeded
+                pass
 
     # -- rotation / truncation -----------------------------------------------
 

@@ -94,12 +94,16 @@ class Work:
     """One admitted-or-pending unit: a decoded frame ready to feed.
     `feed` ingests it (already bound to runtime + stream); `rows`
     lazily decodes to [(ts_ms, row_tuple), ...] for ErrorStore
-    capture on shed."""
+    capture on shed.  `trace` is the frame's TraceHandle
+    (core/tracing.py) — it rides the park queue, so a frame drained
+    and fed on ANOTHER thread (scheduler pump, a later connection
+    tick) still lands its spans on the same tree."""
     n: int
     nbytes: int
     feed: Callable[[], None]
     rows: Callable[[], list]
     stream_id: str = ""
+    trace: object = None
 
 
 @dataclass
@@ -134,7 +138,8 @@ class AdmissionController:
                  burst: Optional[float] = None, error_store=None,
                  on_fault: Optional[Callable] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 now_ms: Optional[Callable[[], int]] = None):
+                 now_ms: Optional[Callable[[], int]] = None,
+                 on_shed: Optional[Callable[[str, str], None]] = None):
         policy = (policy or "block").lower()
         if policy not in self.POLICIES:
             raise ValueError(f"stream {stream_id!r}: unknown shed.policy "
@@ -145,6 +150,14 @@ class AdmissionController:
         self.max_pending_bytes = int(max_pending_bytes)
         self.error_store = error_store
         self.on_fault = on_fault        # stats.on_fault hook
+        # shed-burst trace trigger (core/tracing.py): nonblocking
+        # enqueue, safe under this controller's lock; the tracer's
+        # per-kind cooldown turns a shed storm into at most one dump.
+        # Named after its target (FrameTracer.trigger) like wal's
+        # injected `inject`, so the static lock graph composes the
+        # AdmissionController._lock -> FrameTracer._lock edge the
+        # runtime lock-witness observes
+        self.trigger = on_shed
         self.now_ms = now_ms or (lambda: int(time.time() * 1000))
         self._pending: deque = deque()  # Work, oldest first
         self._inflight = 0              # drained-but-not-yet-fed frames
@@ -311,7 +324,8 @@ class AdmissionController:
                     self._inflight -= 1
 
         return Work(n=work.n, nbytes=work.nbytes, feed=feed,
-                    rows=work.rows, stream_id=work.stream_id)
+                    rows=work.rows, stream_id=work.stream_id,
+                    trace=work.trace)
 
     def _enqueue_locked(self, work: Work, ready: list) -> Decision:
         self._pending.append(work)
@@ -339,6 +353,13 @@ class AdmissionController:
                      from_pending: bool = False) -> None:
         self.shed_frames += 1
         self.shed_events += work.n
+        if self.trigger is not None:
+            try:
+                self.trigger("shed_burst",
+                             f"stream {self.stream_id!r}: {why} "
+                             f"({self.shed_frames} frames shed)")
+            except Exception:
+                pass
         if self.on_fault is not None:
             try:
                 self.on_fault(self.stream_id, "net.shed")
@@ -390,6 +411,7 @@ def controller_from_options(stream_id: str, options: dict, rt,
     """Build a controller from @source annotation options
     (`rate.limit`, `shed.policy`, `max.pending`, `burst`)."""
     rate = options.get("rate.limit")
+    tracer = getattr(rt, "tracing", None)
     return AdmissionController(
         stream_id,
         rate_limit=float(rate) if rate is not None else None,
@@ -399,4 +421,5 @@ def controller_from_options(stream_id: str, options: dict, rt,
         error_store=rt.error_store,
         on_fault=rt.stats.on_fault,
         clock=clock,
-        now_ms=rt.now_ms)
+        now_ms=rt.now_ms,
+        on_shed=None if tracer is None else tracer.trigger)

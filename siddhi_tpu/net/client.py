@@ -169,11 +169,17 @@ class FrameClient:
             if ftype == fp.ERROR:
                 raise NetClientError(json.loads(payload)["error"])
 
-    def send_batch(self, columns: dict, timestamps) -> None:
+    def send_batch(self, columns: dict, timestamps,
+                   trace_id: Optional[str] = None) -> None:
         """Encode + ship one columnar batch (strings as str arrays —
-        dictionary codes are connection-local, never caller-visible)."""
+        dictionary codes are connection-local, never caller-visible).
+        `trace_id` stamps a wire TRACE frame ahead of the DATA frame:
+        the server adopts it as the frame's trace id (always traced,
+        bypassing sampling) — docs/OBSERVABILITY.md "Frame tracing"."""
         blob = self.enc.encode_batch(columns, timestamps,
                                      synced=self._synced)
+        if trace_id is not None:
+            blob = fp.encode_trace(trace_id) + blob
         self._respect_credit()
         self._send(blob)
         self._synced = len(self.enc.strings)
@@ -388,7 +394,10 @@ class RingProducer(FrameClient):
     def _recv_frame(self, timeout):
         return None
 
-    def send_batch(self, columns: dict, timestamps) -> None:
+    def send_batch(self, columns: dict, timestamps,
+                   trace_id: Optional[str] = None) -> None:
+        if trace_id is not None:        # own slot: rings carry whole frames
+            self._send(fp.encode_trace(trace_id))
         blob = self.enc.encode_batch(columns, timestamps,
                                      synced=self._synced)
         if len(blob) > self.ring.capacity:
@@ -461,6 +470,10 @@ class FrameReceiver:
         self.batches: list = []         # (stream, [(ts, row), ...])
         self.frames = 0
         self.strings_frames = 0         # dictionary deltas received
+        # trace-context extension: one entry per DATA frame — the
+        # trace id its preceding TRACE frame carried, or None.  Tests
+        # pin "the egress frame carries the ingress trace id" here.
+        self.trace_ids: list = []
         self._fail_first = fail_first   # refuse N connections (tests)
         self._stop = threading.Event()
         self._threads: list = []
@@ -497,6 +510,7 @@ class FrameReceiver:
         strings = [None]                # connection dictionary
         schema = None                   # decode via fp.decode_data —
         stream_id = ""                  # ONE wire-walk implementation
+        next_trace = None               # TRACE ctx for the next DATA
         try:
             while not self._stop.is_set():
                 ftype, payload = fp.read_frame(read)
@@ -514,15 +528,20 @@ class FrameReceiver:
                     strings[start:start + len(new)] = new
                     with self._lock:
                         self.strings_frames += 1
+                elif ftype == fp.TRACE:
+                    next_trace = fp.decode_trace(payload)
                 elif ftype == fp.DATA:
                     if schema is None:
                         raise fp.FrameError("DATA before HELLO")
                     ts, cols = fp.decode_data(payload, schema)
                     rows = rows_of_columns(
                         schema, ts, cols, SimpleNamespace(_to_str=strings))
+                    tid, next_trace = next_trace, None
                     with self._lock:
                         self.frames += 1
                         self.batches.append((stream_id, rows))
+                        self.trace_ids.append(
+                            None if tid is None else tid[0])
                 elif ftype == fp.PING:
                     sock.sendall(fp.encode_ack(fp.decode_u64(payload)))
                 elif ftype == fp.BYE:

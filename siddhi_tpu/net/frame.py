@@ -45,6 +45,16 @@ Frame types and payloads:
     ERROR (7), server->client, JSON: {"error": "..."}.
     PING (8), client->server: u64 token (the flush barrier).
     BYE (9): empty; graceful close.
+    TRACE (10), either direction, JSON: {"trace": "<id>", "span": int}
+        — OPTIONAL trace-context extension (docs/OBSERVABILITY.md
+        "Frame tracing").  Applies to the NEXT DATA frame on this
+        connection: the server adopts the producer-stamped trace id
+        for that frame's span tree (always traced, bypassing
+        sampling), and net sinks re-stamp egress DATA frames with the
+        ingress id so traces compose across engine hops.  `span`
+        is the sender's current head span id (0 = none), recorded as
+        the downstream root's `remote_parent` annotation (span ids
+        are host-local).  Receivers that do not trace consume it.
 
 docs/SERVING.md carries the normative spec with a worked hex example.
 """
@@ -72,10 +82,11 @@ ACK = 6
 ERROR = 7
 PING = 8
 BYE = 9
+TRACE = 10
 
 _TYPE_NAMES = {HELLO: "HELLO", HELLO_OK: "HELLO_OK", DATA: "DATA",
                STRINGS: "STRINGS", CREDIT: "CREDIT", ACK: "ACK",
-               ERROR: "ERROR", PING: "PING", BYE: "BYE"}
+               ERROR: "ERROR", PING: "PING", BYE: "BYE", TRACE: "TRACE"}
 
 
 class FrameError(Exception):
@@ -132,6 +143,25 @@ def encode_ack(token: int) -> bytes:
 
 def encode_ping(token: int) -> bytes:
     return encode_frame(PING, struct.pack("<Q", int(token)))
+
+
+def encode_trace(trace_id: str, span: int = 0) -> bytes:
+    """Trace-context frame stamping the NEXT DATA frame (see the module
+    docstring); `span` is the sender's head span id (0 = none) — the
+    receiver annotates its root with it as `remote_parent`."""
+    return encode_frame(TRACE, json.dumps(
+        {"trace": str(trace_id), "span": int(span)}).encode())
+
+
+def decode_trace(payload: bytes) -> tuple:
+    """-> (trace_id, span)."""
+    try:
+        d = json.loads(payload)
+        if not isinstance(d, dict) or not d.get("trace"):
+            raise ValueError("missing trace id")
+        return str(d["trace"]), int(d.get("span", 0) or 0)
+    except (ValueError, TypeError, UnicodeDecodeError) as e:
+        raise FrameError(f"bad TRACE payload: {e}") from None
 
 
 def encode_strings(new_strings: list, start_code: int = None) -> bytes:

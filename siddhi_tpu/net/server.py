@@ -216,6 +216,9 @@ class Connection:
         self._str_cols: list = []
         self.frames = 0
         self.events = 0
+        # producer-stamped trace context (TRACE frame) for the NEXT
+        # DATA frame on this connection
+        self._next_trace = None
 
     # -- frame dispatch -----------------------------------------------------
 
@@ -234,6 +237,11 @@ class Connection:
             start, new = fp.decode_strings(payload)
             with self.rt._lock:         # StringTable writes are shared
                 self.remap.extend(start, new, self.rt.strings)
+            return True
+        if ftype == fp.TRACE:
+            # wire trace context: adopt the producer's id for the next
+            # DATA frame (always traced, bypassing sampling)
+            self._next_trace = fp.decode_trace(payload)
             return True
         if ftype == fp.DATA:
             self._on_data(payload)
@@ -322,9 +330,27 @@ class Connection:
         n = int(ts.shape[0])
         self.frames += 1
         self.events += n
+        # frame tracing: a producer-stamped id (TRACE frame) always
+        # traces; otherwise the runtime tracer makes the sampling call.
+        # The handle rides the Work so a parked ('oldest') frame fed
+        # later on another thread keeps its tree.
+        tc, self._next_trace = self._next_trace, None
+        h = None
+        tracer = getattr(rt, "tracing", None)
+        if tracer is not None:
+            h = tracer.begin_frame(
+                self.stream_id, trace_id=None if tc is None else tc[0],
+                parent=0 if tc is None else tc[1])
         work = self.server.make_work(rt, self.stream_id, self.schema,
-                                     ts, cols, len(payload))
+                                     ts, cols, len(payload), trace=h)
+        t0a = time.perf_counter() if h is not None else 0.0
         d = self.ctrl.submit(work, stop=self.server.stopping)
+        if h is not None:
+            # the admit span covers the admission decision including
+            # any block-policy wait; a parked frame's queue time shows
+            # as the gap between admit and its (later) wal.append
+            h.mark("admit", t0a, time.perf_counter() - t0a,
+                  action=d.action, events=n)
         for w in d.ready:
             # guarded: queued work is mixed-provenance (REST batches
             # share the controller and their feeds can raise, e.g. a
@@ -447,38 +473,62 @@ class NetServer:
             rt._net_retired_store = rt.error_store
 
     def make_work(self, rt, stream_id: str, schema, ts, cols,
-                  nbytes: int) -> Work:
+                  nbytes: int, trace=None) -> Work:
         from ..core.batch import rows_of_columns
         gate = self._gate_of(rt)
 
-        def feed(rt=rt, stream_id=stream_id, ts=ts, cols=cols):
-            with gate:
-                store = getattr(rt, "_net_retired_store", None)
-                if store is not None:
-                    store.add(stream_id, "net.undeployed",
-                              "frame admitted before undeploy",
-                              rt.now_ms(),
-                              events=rows_of_columns(schema, ts, cols,
-                                                     rt.strings))
-                    return
+        def _feed_inner(rt=rt, stream_id=stream_id, ts=ts, cols=cols):
+            # sink deliveries staged by this feed are deferred past the
+            # gate (runtime._flush_sink_outbox honors `defer_sink`): a
+            # sink retry backoff sleeping under the gate would stall
+            # retire()/undeploy for the whole backoff schedule
+            tls = rt._trace_tls
+            tls.defer_sink = getattr(tls, "defer_sink", 0) + 1
+            try:
+                with gate:
+                    store = getattr(rt, "_net_retired_store", None)
+                    if store is not None:
+                        store.add(stream_id, "net.undeployed",
+                                  "frame admitted before undeploy",
+                                  rt.now_ms(),
+                                  events=rows_of_columns(schema, ts, cols,
+                                                         rt.strings))
+                        return
+                    try:
+                        rt.inject("net.feed", stream_id)
+                        rt.send_columnar(stream_id, cols, ts)
+                    except Exception as e:
+                        # an admitted frame must NEVER vanish: capture
+                        # whole — unless the WAL append path already did
+                        # (a second entry would double-ingest on replay)
+                        if not getattr(e, "_wal_captured", False):
+                            rt.error_store.add(
+                                stream_id, "net.feed", e, rt.now_ms(),
+                                events=rows_of_columns(schema, ts, cols,
+                                                       rt.strings))
+                        rt.stats.on_fault(stream_id, "net.feed")
+            finally:
+                tls.defer_sink -= 1
+            rt._flush_sink_outbox()
+
+        if trace is None:
+            feed = _feed_inner
+        else:
+            def feed(rt=rt):
+                # install the frame's trace handle on WHICHEVER thread
+                # ends up feeding (connection, scheduler pump, another
+                # connection's drain): runtime._freeze picks it up so
+                # wal.append/freeze/dispatch spans join the same tree
+                prev = rt._set_trace(trace)
                 try:
-                    rt.inject("net.feed", stream_id)
-                    rt.send_columnar(stream_id, cols, ts)
-                except Exception as e:
-                    # an admitted frame must NEVER vanish: capture
-                    # whole — unless the WAL append path already did
-                    # (a second entry would double-ingest on replay)
-                    if not getattr(e, "_wal_captured", False):
-                        rt.error_store.add(
-                            stream_id, "net.feed", e, rt.now_ms(),
-                            events=rows_of_columns(schema, ts, cols,
-                                                   rt.strings))
-                    rt.stats.on_fault(stream_id, "net.feed")
+                    _feed_inner()
+                finally:
+                    rt._trace_tls.handle = prev
 
         return Work(n=int(ts.shape[0]), nbytes=nbytes, feed=feed,
                     rows=lambda: rows_of_columns(schema, ts, cols,
                                                  rt.strings),
-                    stream_id=stream_id)
+                    stream_id=stream_id, trace=trace)
 
     # -- lifecycle ----------------------------------------------------------
 

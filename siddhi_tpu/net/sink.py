@@ -45,9 +45,15 @@ class _SinkPayload(bytes):
     delta that would otherwise re-ship every dictionary delta twice.
     Degrades safely: anything that strips the attributes (they do not
     survive pickling) just falls back to catch-up duplication, which
-    the server-side remap accepts idempotently."""
+    the server-side remap accepts idempotently.
+
+    `trace_ctx` is the originating frame's resumable (trace_id, head)
+    (core/tracing.py): a stored payload replayed from the ErrorStore
+    records its publish span on the SAME tree, and the blob itself
+    already embeds the wire TRACE frame re-stamping the egress DATA."""
     start_code: Optional[int] = None
     end_code: Optional[int] = None
+    trace_ctx: Optional[tuple] = None
 
 
 class TcpSink(Sink):
@@ -207,9 +213,19 @@ class TcpSink(Sink):
                 cols[name] = np.asarray(
                     [fill if v is None else v for v in vals], dtype=dt)
         start = len(self.enc.strings)
-        payload = _SinkPayload(self.enc.encode_batch(cols, ts))
+        blob = self.enc.encode_batch(cols, ts)
+        # wire trace-context re-stamp: the egress DATA frame carries the
+        # INGRESS frame's trace id (the batch callback staged us under
+        # its scope), so traces compose across engine hops — the
+        # downstream engine adopts the id for its own span tree
+        h = self.rt.current_trace()
+        if h is not None:
+            blob = fp.encode_trace(h.trace_id, h.head) + blob
+        payload = _SinkPayload(blob)
         payload.start_code = start
         payload.end_code = len(self.enc.strings)
+        if h is not None:
+            payload.trace_ctx = h.ctx()
         return payload
 
     def publish(self, payload) -> None:

@@ -51,7 +51,20 @@ Endpoints (JSON unless noted):
                                     reason chain for every rejected
                                     alternative
   GET  /metrics[?siddhiApp=<name>]  Prometheus text exposition (0.0.4) over
-                                    every deployed app (or just <name>)
+                                    every deployed app (or just <name>);
+                                    the per-stream dispatch-latency
+                                    histogram buckets carry OpenMetrics
+                                    trace-id exemplars
+  GET  /siddhi/artifact/trace[?siddhiApp=<name>]
+                                    the frame-tracing plane
+                                    (docs/OBSERVABILITY.md "Frame
+                                    tracing"): Chrome trace_event JSON
+                                    ({"traceEvents": [...], "metadata":
+                                    {hostname, apps, dumps}}) of the
+                                    live span ring — load in
+                                    chrome://tracing / ui.perfetto.dev;
+                                    `metadata.dumps` lists trigger-
+                                    promoted retained dumps
   GET  /siddhi/artifact/tuning[?siddhiApp=<name>]
                                     the persisted execution-geometry tuning
                                     cache (docs/AUTOTUNING.md): entries +
@@ -88,6 +101,10 @@ from .query import ast as qast
 from .utils.locks import new_lock
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+# negotiated via the Accept header: exemplar syntax is only legal in
+# OpenMetrics — a classic 0.0.4 parser rejects a line carrying one
+OPENMETRICS_CONTENT_TYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
 
 class _ControlServer(ThreadingHTTPServer):
@@ -271,6 +288,13 @@ class SiddhiService:
                         else:
                             self._reply(200, service.errors(
                                 app, q.get("stream", [None])[0]))
+                    elif u.path == "/siddhi/artifact/trace":
+                        app = q.get("siddhiApp", [None])[0]
+                        if app is not None and app not in service.runtimes:
+                            self._reply(404, {"error":
+                                              f"no deployed app {app!r}"})
+                        else:
+                            self._reply(200, service.trace(app))
                     elif u.path == "/siddhi/artifact/tuning":
                         app = q.get("siddhiApp", [None])[0]
                         if app is not None and app not in service.runtimes:
@@ -286,7 +310,17 @@ class SiddhiService:
                             self._reply(404, {"error":
                                               f"no deployed app {app!r}"})
                         else:
-                            self._reply_text(200, service.metrics(app))
+                            # content negotiation: Prometheus asks for
+                            # OpenMetrics by default and gets the
+                            # exemplar-carrying form; anything else gets
+                            # classic 0.0.4 (exemplars stripped — they
+                            # are illegal in that format)
+                            om = "application/openmetrics-text" in \
+                                (self.headers.get("Accept") or "")
+                            self._reply_text(
+                                200, service.metrics(app, openmetrics=om),
+                                ctype=OPENMETRICS_CONTENT_TYPE if om
+                                else PROM_CONTENT_TYPE)
                     else:
                         self._reply(404, {"error": f"no route {u.path}"})
                 except Exception as e:
@@ -643,6 +677,31 @@ class SiddhiService:
             out["recovery"] = rt._wal_recovery
         return out
 
+    def trace(self, app: Optional[str] = None) -> dict:
+        """GET /siddhi/artifact/trace: the frame-tracing plane as one
+        Chrome `trace_event` object (docs/OBSERVABILITY.md).  Spans of
+        every deployed app (or just `app`) merge with one pid per app;
+        the hostname metadata is what lets cross-host federation merge
+        dumps from several engines into one timeline."""
+        import socket as _socket
+        names = [app] if app is not None else sorted(self.runtimes)
+        evs: list = []
+        apps_meta: list = []
+        dumps: list = []
+        for i, name in enumerate(names):
+            tr = getattr(self.runtimes[name], "tracing", None)
+            if tr is None:
+                apps_meta.append({"app": name, "tracing": False})
+                continue
+            evs.extend(tr.chrome_events(pid=i + 1))
+            apps_meta.append({"app": name, "tracing": True,
+                              **tr.metrics()})
+            dumps.extend({"app": name, **d}
+                         for d in tr.dump_summaries())
+        return {"traceEvents": evs,
+                "metadata": {"hostname": _socket.gethostname(),
+                             "apps": apps_meta, "dumps": dumps}}
+
     def tuning(self, app: Optional[str] = None) -> dict:
         """The persisted execution-geometry tuning cache (autotune.py):
         globally, or one deployed app's view of it (its hit/miss gauges
@@ -656,12 +715,15 @@ class SiddhiService:
                 "jax": jax_version(), "hits": c.hits, "misses": c.misses,
                 "corrupt": c.corrupt, "entries": c.entries()}
 
-    def metrics(self, app: Optional[str] = None) -> str:
-        """Prometheus text exposition rendered LIVE from every deployed
-        runtime's statistics (or just `app`'s when given)."""
+    def metrics(self, app: Optional[str] = None,
+                openmetrics: bool = False) -> str:
+        """Text exposition rendered LIVE from every deployed runtime's
+        statistics (or just `app`'s when given); `openmetrics=True` is
+        the Accept-negotiated exemplar-carrying form."""
         names = [app] if app is not None else sorted(self.runtimes)
         return render_prometheus(
-            {n: self.runtimes[n].stats.report() for n in names})
+            {n: self.runtimes[n].stats.report() for n in names},
+            openmetrics=openmetrics)
 
     # -- lifecycle --------------------------------------------------------
 

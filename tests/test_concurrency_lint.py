@@ -329,6 +329,48 @@ def test_sl05_clean_counterexample():
     assert lint_threads_source(SL05_CLEAN_OUTSIDE) == []
 
 
+SL05_STDLIB_RECEIVER_CLEAN = """
+import threading
+import time
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.src = None
+    def start(self):
+        # a real blocking path behind the engine's own `start` name
+        self.src.connect_with_retry()
+    def connect_with_retry(self):
+        time.sleep(0.1)
+class Spawner:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.worker = threading.Thread(name="siddhi-w", daemon=True)
+    def kick(self):
+        t = threading.Thread(target=self._run, name="siddhi-w",
+                             daemon=True)
+        with self._lock:
+            self.worker = t
+        t.start()
+    def kick_attr(self):
+        with self._lock:
+            pass
+        self.worker.start()
+    def _run(self):
+        pass
+"""
+
+
+def test_sl05_stdlib_receiver_does_not_alias_engine_methods():
+    """`threading.Thread(...).start()` must NOT resolve onto an engine
+    class's `start()` through the unique-method-name fallback: the
+    stdlib-typed receiver is external, so spawning a thread near a lock
+    cannot mint a false blocking chain through Engine.start's real
+    time.sleep (the regression the tracing plane's trigger exporter
+    surfaced)."""
+    fs = lint_threads_source(SL05_STDLIB_RECEIVER_CLEAN)
+    assert [f.rule_id for f in fs if f.rule_id == "SL05"] == []
+
+
 # ---------------------------------------------------------------------------
 # SL06 — thread lifecycle
 # ---------------------------------------------------------------------------
