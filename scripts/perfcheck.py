@@ -1,0 +1,261 @@
+#!/usr/bin/env python
+"""Perf-regression sentinel (ISSUE 17): compare a fresh
+`bench.py --trace --smoke` report against the checked-in
+scripts/perf_baseline.json and exit 1 when the attribution moved
+outside the tolerance bands.
+
+What it guards is the *shape* of device-time attribution, not raw eps:
+absolute throughput varies machine to machine, but the phase shares —
+where a processed second goes — are a property of the code.  A change
+that doubles host-dispatch seconds doubles the host-share *odds*
+(odds = s / (1 - s)); comparing in odds space makes the band
+symmetric across the share range (0.3 -> 0.46 and 0.7 -> 0.82 are the
+same 2x regression), so the band is a max odds ratio, default 1.6 —
+tight enough that a 2x host-seconds regression (odds ratio 2.0) always
+trips it, loose enough for run-to-run jitter.
+
+Checks (fail -> exit 1):
+  * host_dispatch_share odds ratio vs baseline, config 3 and config 4
+  * per-phase aggregate shares (config 3) within +-`share_abs`
+  * phase-attribution coverage >= `coverage_min` of the dispatch wall
+
+Warn-only (never fail CI on wall-clock luck):
+  * end-to-end / kernel eps ratio bands
+  * the profiler/tracing overhead contract flags
+
+A harness config-hash mismatch means the workload itself changed —
+every band would be comparing different programs, so the sentinel
+reports "stale baseline" and passes; refresh with `--write-baseline`.
+
+Usage:
+    python scripts/perfcheck.py                  # run bench, compare
+    python scripts/perfcheck.py --input FILE     # compare a saved report
+    python scripts/perfcheck.py --write-baseline # run bench, refresh
+    python scripts/perfcheck.py --input FILE --inject-host-share-x2
+                                # seeded 2x host-seconds regression
+                                # (self-test: MUST exit 1)
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(ROOT, "scripts", "perf_baseline.json")
+
+TOLERANCES = {
+    "host_share_odds_x": 1.6,   # max odds ratio fresh/baseline (2x trips)
+    "share_abs": 0.2,           # per-phase share drift band
+    "coverage_min": 0.9,        # attribution floor (ISSUE 17 acceptance)
+    "eps_ratio": [0.4, 2.5],    # warn-only wall-clock band
+}
+
+
+def _last_json_line(text: str) -> dict:
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise ValueError("no JSON object line in input")
+
+
+def load_report(path=None) -> dict:
+    if path:
+        with open(path) as f:
+            return _last_json_line(f.read())
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"),
+         "--trace", "--smoke"],
+        capture_output=True, text=True, timeout=1800, cwd=ROOT)
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr[-4000:])
+        raise RuntimeError(f"bench.py --trace --smoke exited {r.returncode}")
+    return _last_json_line(r.stdout)
+
+
+def _metrics_of(rep: dict) -> dict:
+    """The comparable slice of a --trace report: config3 top-level +
+    profile aggregate, config4 sub-block."""
+    prof = rep.get("profile") or {}
+    plans = prof.get("plans") or {}
+    kernel_eps = max((p.get("kernel_eps") or 0.0 for p in plans.values()),
+                     default=0.0) or None
+    c4 = rep.get("config4") or {}
+    return {
+        "config3": {
+            "eps": rep.get("eps"),
+            "coverage": prof.get("coverage"),
+            "kernel_share": rep.get("kernel_share"),
+            "host_dispatch_share": rep.get("host_dispatch_share"),
+            "shares": prof.get("shares"),
+            "kernel_eps": kernel_eps,
+        },
+        "config4": {
+            "eps": c4.get("eps"),
+            "coverage": ((c4.get("profile") or {}).get("coverage")),
+            "host_dispatch_share": c4.get("host_dispatch_share"),
+        },
+    }
+
+
+def write_baseline(rep: dict, path: str) -> dict:
+    base = {
+        "schema": 1,
+        "written_unix": round(time.time(), 1),
+        "harness": rep.get("harness") or {},
+        "metrics": _metrics_of(rep),
+        "overhead": {
+            "profile_sampled_32_pct": (rep.get("profile_overhead") or {})
+            .get("sampled_32_overhead_pct"),
+            "tracing_unsampled_pct": (rep.get("tracing_overhead") or {})
+            .get("unsampled_overhead_pct"),
+        },
+        "tolerances": TOLERANCES,
+    }
+    # the native single-thread roofline column the live profiler's
+    # fold_roofline() reads back (keys match _native_roofline's parse)
+    try:
+        sys.path.insert(0, ROOT)
+        import bench
+        nat = bench.native_baseline()
+        base["native_cpp_eps"] = {
+            "3_sequence": (nat.get("sequence") or {}).get("eps"),
+            "4_partitioned": (nat.get("partitioned") or {}).get("eps"),
+        }
+    except Exception as e:      # no g++ in a stripped image: no column
+        sys.stderr.write(f"[perfcheck] native roofline skipped: {e}\n")
+        base["native_cpp_eps"] = {}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(base, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+    return base
+
+
+def _odds(s):
+    s = min(max(float(s), 1e-6), 1.0 - 1e-6)
+    return s / (1.0 - s)
+
+
+def inject_host_share_x2(rep: dict) -> dict:
+    """Seeded regression for the self-test: double the host-dispatch
+    *seconds* of both configs — in share terms, double the odds."""
+    def bump(s):
+        o = 2.0 * _odds(s)
+        return round(o / (1.0 + o), 4)
+    if rep.get("host_dispatch_share") is not None:
+        rep["host_dispatch_share"] = bump(rep["host_dispatch_share"])
+    prof = rep.get("profile") or {}
+    if prof.get("host_dispatch_share") is not None:
+        prof["host_dispatch_share"] = bump(prof["host_dispatch_share"])
+    c4 = rep.get("config4") or {}
+    if c4.get("host_dispatch_share") is not None:
+        c4["host_dispatch_share"] = bump(c4["host_dispatch_share"])
+    return rep
+
+
+def compare(rep: dict, base: dict) -> dict:
+    tol = {**TOLERANCES, **(base.get("tolerances") or {})}
+    fresh = _metrics_of(rep)
+    bm = base.get("metrics") or {}
+    failures, warnings = [], []
+
+    bh = (base.get("harness") or {}).get("config_hash")
+    fh = (rep.get("harness") or {}).get("config_hash")
+    if bh and fh and bh != fh:
+        return {"metric": "perfcheck", "pass": True, "stale_baseline": True,
+                "note": f"config hash {fh} != baseline {bh}: workload "
+                        "changed, bands not comparable — refresh with "
+                        "--write-baseline", "failures": [], "warnings": []}
+
+    for cfg in ("config3", "config4"):
+        fs = (fresh.get(cfg) or {}).get("host_dispatch_share")
+        bs = (bm.get(cfg) or {}).get("host_dispatch_share")
+        if fs is None or bs is None:
+            warnings.append(f"{cfg}: host_dispatch_share missing "
+                            f"(fresh={fs}, baseline={bs})")
+            continue
+        ratio = _odds(fs) / _odds(bs)
+        if ratio > tol["host_share_odds_x"]:
+            failures.append(
+                f"{cfg}: host_dispatch_share {fs:.3f} vs baseline "
+                f"{bs:.3f} — odds ratio {ratio:.2f} > "
+                f"{tol['host_share_odds_x']} (host dispatch regressed)")
+
+    f_sh = (fresh["config3"].get("shares") or {})
+    b_sh = ((bm.get("config3") or {}).get("shares") or {})
+    for ph in sorted(set(f_sh) | set(b_sh)):
+        d = abs((f_sh.get(ph) or 0.0) - (b_sh.get(ph) or 0.0))
+        if d > tol["share_abs"]:
+            failures.append(
+                f"config3 phase {ph}: share moved {d:.3f} > "
+                f"{tol['share_abs']} ({b_sh.get(ph)} -> {f_sh.get(ph)})")
+
+    for cfg in ("config3", "config4"):
+        cov = (fresh.get(cfg) or {}).get("coverage")
+        if cov is not None and cov < tol["coverage_min"]:
+            failures.append(f"{cfg}: phase coverage {cov:.3f} < "
+                            f"{tol['coverage_min']}")
+
+    lo, hi = tol["eps_ratio"]
+    for cfg in ("config3", "config4"):
+        fe = (fresh.get(cfg) or {}).get("eps")
+        be = (bm.get(cfg) or {}).get("eps")
+        if fe and be and not (lo <= fe / be <= hi):
+            warnings.append(f"{cfg}: eps ratio {fe / be:.2f} outside "
+                            f"[{lo}, {hi}] (fresh {fe}, baseline {be})")
+    pov = rep.get("profile_overhead") or {}
+    if pov and pov.get("pass") is False:
+        warnings.append("profiler overhead contract failed: "
+                        f"{pov.get('sampled_32_overhead_pct')}% > 3%")
+
+    return {"metric": "perfcheck", "pass": not failures,
+            "failures": failures, "warnings": warnings,
+            "host_dispatch_share": {
+                cfg: {"fresh": (fresh.get(cfg) or {})
+                      .get("host_dispatch_share"),
+                      "baseline": (bm.get(cfg) or {})
+                      .get("host_dispatch_share")}
+                for cfg in ("config3", "config4")}}
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    path = None
+    if "--input" in argv:
+        path = argv[argv.index("--input") + 1]
+    base_path = BASELINE
+    if "--baseline" in argv:
+        base_path = argv[argv.index("--baseline") + 1]
+
+    rep = load_report(path)
+
+    if "--write-baseline" in argv:
+        i = argv.index("--write-baseline")
+        out = (argv[i + 1] if i + 1 < len(argv)
+               and not argv[i + 1].startswith("--") else base_path)
+        base = write_baseline(rep, out)
+        print(json.dumps({"metric": "perfcheck", "pass": True,
+                          "wrote_baseline": out,
+                          "metrics": base["metrics"]}))
+        return 0
+
+    if "--inject-host-share-x2" in argv:
+        rep = inject_host_share_x2(rep)
+
+    if not os.path.exists(base_path):
+        print(json.dumps({"metric": "perfcheck", "pass": True,
+                          "note": f"no baseline at {base_path} — run "
+                                  "--write-baseline first"}))
+        return 0
+    with open(base_path) as f:
+        base = json.load(f)
+    res = compare(rep, base)
+    print(json.dumps(res))
+    return 0 if res["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -248,6 +248,63 @@ print("OK: Chrome trace JSON schema valid "
 EOF
 rm -f "$TRACE_JSON"
 
+echo "== phase-profiler smoke =="
+# the device-time attribution plane end-to-end (docs/OBSERVABILITY.md
+# "Device-time profiling"): deploy over REST with the profiler in
+# 'all' mode, push TCP frames, then assert GET /siddhi/artifact/profile
+# serves per-plan phase shares that sum to 1.0 and that /metrics
+# exposes the siddhi_tpu_phase_seconds_total series.
+python - <<'EOF'
+import json
+import urllib.request
+
+import numpy as np
+
+from siddhi_tpu.net import TcpFrameClient
+from siddhi_tpu.service import SiddhiService
+
+svc = SiddhiService(port=0).start()
+base = f"http://127.0.0.1:{svc.port}"
+try:
+    app = ("@app:name('ProfSmoke')\n"
+           "@app:profile('all')\n"
+           "define stream S (sym string, p double);\n"
+           "@info(name='q') from every e1=S[p > 10] -> e2=S[p > e1.p] "
+           "select e1.sym as s1, e2.p as p2 insert into Out;\n")
+    req = urllib.request.Request(f"{base}/siddhi/artifact/deploy",
+                                 data=app.encode(), method="POST")
+    urllib.request.urlopen(req).read()
+    rt = svc.runtimes["ProfSmoke"]
+    cli = TcpFrameClient("127.0.0.1", svc.net_port, "S",
+                         TcpFrameClient.cols_of_schema(rt.schemas["S"]),
+                         app="ProfSmoke")
+    for k in range(4):
+        cli.send_batch({"sym": np.array(["A", "B", "C", "D"]),
+                        "p": np.array([11.0, 12.0, 13.0, 14.0])},
+                       np.arange(4 * k, 4 * k + 4, dtype=np.int64))
+    cli.barrier(timeout=30)
+    cli.close()
+    with urllib.request.urlopen(
+            f"{base}/siddhi/artifact/profile?siddhiApp=ProfSmoke") as r:
+        prof = json.loads(r.read())["apps"]["ProfSmoke"]
+    assert prof["mode"] == "all", prof.get("mode")
+    assert prof["plans"], "no plan accumulated any attribution"
+    for name, pv in prof["plans"].items():
+        s = sum(pv["shares"].values())
+        assert abs(s - 1.0) < 5e-4, (name, pv["shares"])
+    agg = prof["aggregate"]
+    assert agg["rounds"] > 0 and agg["coverage"] >= 0.9, agg
+    with urllib.request.urlopen(f"{base}/metrics") as r:
+        text = r.read().decode()
+    assert "siddhi_tpu_phase_seconds_total{" in text
+    assert "siddhi_tpu_host_dispatch_share{" in text
+    print(f"OK: profile plane live ({len(prof['plans'])} plans, "
+          f"coverage {agg['coverage']}, "
+          f"host share {agg['host_dispatch_share']})")
+finally:
+    svc.stop()
+EOF
+
 echo "== kill -9 recovery smoke =="
 # exactly-once durable serving end-to-end (docs/RELIABILITY.md): start a
 # service subprocess with @app:durability('batch'), feed N TCP frames
@@ -415,5 +472,17 @@ parsed = json.loads(line)          # raises -> smoke fails
 assert isinstance(parsed, dict) and "metric" in parsed, parsed
 print("OK: bench --smoke last line parses:", parsed["metric"])
 EOF
+
+echo "== perf-regression sentinel =="
+# scripts/perfcheck.py: fresh bench.py --trace --smoke vs the checked-in
+# scripts/perf_baseline.json.  Exits 1 when the host-dispatch-share odds
+# move past the band (the "someone made dispatch 2x more host-bound"
+# regression), when any phase share drifts beyond its absolute band, or
+# when attribution coverage drops below 0.9.  Raw eps is warn-only (CI
+# machines jitter); a baseline written on a different workload config
+# (config_hash mismatch) downgrades to a stale-baseline note so config
+# refactors don't hard-fail until the baseline is regenerated
+# (perfcheck.py --write-baseline, committed alongside)
+python scripts/perfcheck.py
 
 echo "smoke: PASS"

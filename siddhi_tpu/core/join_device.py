@@ -515,14 +515,15 @@ class DeviceJoinPlan(QueryPlan):
         # mirror advance) keeps the flush retryable
         self.rt.inject("dispatch", self.name)
         M = M if M is not None else max(self._m_hint, 16)
-        if not self.rt.stats.enabled:
+        prof = self.rt.profiler
+        if not self.rt.stats.enabled and prof is None:
             res = self._block_fn(TL, TR, NL, NR, M)(lev, rev)
         else:
             hit = (TL, TR, NL, NR, M) in self._fn_cache
             fn = self._block_fn(TL, TR, NL, NR, M)
             res = call_kernel(
                 self.rt.stats, self.name, fn, (lev, rev), cache_hit=hit,
-                nbytes=env_nbytes(lev) + env_nbytes(rev))
+                nbytes=env_nbytes(lev) + env_nbytes(rev), prof=prof)
         from .pipeline import start_d2h
         start_d2h(res)      # start the D2H pull while the device computes
         # snapshot the mirrors the probe actually saw: with pipelining

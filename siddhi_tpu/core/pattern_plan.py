@@ -628,12 +628,14 @@ class DevicePatternPlan(QueryPlan):
         block-cache hit/miss, and the H2D payload size."""
         self.rt.inject("dispatch", self.name)   # fault-injection boundary
         stats = self.rt.stats
-        if not stats.enabled:
+        prof = self.rt.profiler
+        if not stats.enabled and prof is None:
             return kern.block_fn(T, M)(st, ev)
         hit = (T, M) in kern._block_cache
         fn = kern.block_fn(T, M)
         return call_kernel(stats, self.name, fn, (st, ev),
-                           cache_hit=hit, nbytes=env_nbytes(ev))
+                           cache_hit=hit, nbytes=env_nbytes(ev),
+                           prof=prof)
 
     def device_metrics(self) -> dict:
         """Sampled device gauges: lane occupancy + state-frontier width

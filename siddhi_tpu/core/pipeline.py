@@ -40,6 +40,25 @@ from typing import Callable, Optional
 import numpy as np
 
 
+def _entry_device_nbytes(entry) -> int:
+    """Best-effort D2H payload size of one in-flight entry: sum nbytes
+    of device arrays (anything exposing copy_to_host_async) found one
+    or two levels into the entry tuple — the packed result buffers the
+    materialize below will pull."""
+    try:
+        total = 0
+        items = entry if isinstance(entry, (tuple, list)) else (entry,)
+        for it in items:
+            vals = it.values() if isinstance(it, dict) else (
+                it if isinstance(it, (tuple, list)) else (it,))
+            for v in vals:
+                if hasattr(v, "copy_to_host_async"):
+                    total += int(getattr(v, "nbytes", 0) or 0)
+        return total
+    except Exception:
+        return 0
+
+
 def start_d2h(out, keys=("i", "f", "b")) -> None:
     """Start async device->host copies for the packed result buffers so
     the pull overlaps remaining device compute (best-effort: some
@@ -67,7 +86,7 @@ class DispatchPipeline:
 
     __slots__ = ("plan", "depth", "entries", "_materialize", "_t_disp",
                  "_held", "dispatches", "max_depth", "overlap_s", "wait_s",
-                 "origin", "_origins", "inject", "_ready")
+                 "origin", "_origins", "inject", "_ready", "prof")
 
     def __init__(self, plan_name: str, materialize: Callable,
                  depth: int = 0):
@@ -90,6 +109,11 @@ class DispatchPipeline:
         self.origin = None
         self._origins: list = []
         self.inject: Optional[Callable] = None
+        # device-time profiler (core/profiler.py), wired by
+        # runtime._register_plan: the blocking pull below is THE
+        # d2h_materialize phase (outermost-wins: inner `transfer`
+        # stages inside a plan's materialize are suppressed)
+        self.prof = None
         # results materialized but not yet handed to the caller: a later
         # entry failing mid-drain must not discard an earlier entry's
         # already-materialized outputs — they survive here and return on
@@ -153,6 +177,12 @@ class DispatchPipeline:
             od = None if origin is None \
                 else getattr(origin[1], "__dict__", None)
             h = None if od is None else od.get("_trace")
+            pspan = None
+            if self.prof is not None:
+                self.prof.note_bytes(self.plan, "d2h",
+                                     _entry_device_nbytes(entry))
+                pspan = self.prof.phase("d2h_materialize")
+                pspan.__enter__()
             try:
                 if self.inject is not None:
                     self.inject()       # "d2h" fault-injection point
@@ -178,6 +208,9 @@ class DispatchPipeline:
                     except Exception:
                         pass
                 raise
+            finally:
+                if pspan is not None:
+                    pspan.__exit__(None, None, None)
             self.wait_s += time.perf_counter() - t0
         out, self._ready = self._ready, []
         return out

@@ -299,6 +299,9 @@ class FilterProjectPlan(QueryPlan):
             need = {"__timestamp__"}
         self._need = need
         self._step = jax.jit(self._make_step())
+        # first real dispatch pays trace+XLA compile: the device-time
+        # profiler must not fold that into its kernel_compute estimate
+        self._warm = False
 
     def _make_step(self):
         filt, sel = self._filter, self._sel
@@ -346,7 +349,15 @@ class FilterProjectPlan(QueryPlan):
                if k in host_env and host_env[k].dtype != np.dtype(object)}
         if self.rt is not None:
             self.rt.inject("dispatch", self.name)
-        mask_w, outs = self._step(env)
+        prof = None if self.rt is None else self.rt.profiler
+        if prof is not None:
+            from .telemetry import env_nbytes
+            prof.note_bytes(self.name, "h2d", env_nbytes(env))
+            mask_w, outs = prof.run_kernel(self._step, (env,),
+                                           cache_hit=self._warm)
+        else:
+            mask_w, outs = self._step(env)
+        self._warm = True
         from .pipeline import start_d2h
         start_d2h([mask_w] + list(outs))    # pulls overlap device compute
         return self._pipe.push((mask_w, outs, host_env, batch, None))

@@ -286,6 +286,18 @@ class SiddhiAppRuntime:
         from .tracing import tracer_from_annotations
         self.tracing = tracer_from_annotations(app)
         self._trace_tls = threading.local()
+        # continuous device-time attribution (core/profiler.py): every
+        # dispatch round splits its wall into the six-phase taxonomy,
+        # kernel/h2d via duty-cycle block_until_ready sampling.
+        # `@app:profile('off')` -> None (zero hot-path cost); a windowed
+        # host-dispatch-share breach promotes a flight-recorder dump
+        # through the tracing trigger registry (enqueue-only)
+        from .profiler import profiler_from_annotations
+        self.profiler = profiler_from_annotations(app)
+        if self.profiler is not None and self.tracing is not None:
+            _trc = self.tracing
+            self.profiler.on_host_share_breach = (
+                lambda detail: _trc.trigger("host_share_breach", detail))
         if self.slo is not None and self.tracing is not None:
             _tr = self.tracing
             self.slo.on_breach = lambda dec: _tr.trigger(
@@ -402,6 +414,8 @@ class SiddhiAppRuntime:
         if pipe is not None:
             # D2H-readback injection point (faults.FaultInjector "d2h")
             pipe.inject = (lambda p=plan: self.inject("d2h", p.name))
+            # the pipeline's blocking pull is the d2h_materialize phase
+            pipe.prof = self.profiler
         self._known_query_names.add(getattr(plan, "callback_name", plan.name))
         for sid in plan.input_streams:
             self._subscribers[sid].append(plan)
@@ -647,6 +661,20 @@ class SiddhiAppRuntime:
 
     def statistics(self) -> dict:
         return self.stats.report()
+
+    def profile(self, window: Optional[int] = None) -> dict:
+        """Device-time attribution report (core/profiler.py): per-plan
+        phase seconds/shares, host-dispatch share, the windowed ring
+        (last `window` snapshots; all when None), and the roofline fold
+        — kernel eps (sampled estimate) vs the bench's native-C++
+        roofline eps vs end-to-end eps per plan family.  `{"mode":
+        "off"}` when `@app:profile('off')` disabled the plane."""
+        if self.profiler is None:
+            return {"mode": "off"}
+        from .profiler import fold_roofline
+        rep = self.profiler.profile(window=window)
+        fold_roofline(rep, self._plans)
+        return rep
 
     # -- frame tracing (core/tracing.py) -------------------------------------
 
@@ -1122,25 +1150,38 @@ class SiddhiAppRuntime:
         stall an undeploy waiting on the gate."""
         if getattr(self._trace_tls, "defer_sink", 0):
             return                      # the gate holder flushes after
+        prof = self.profiler
         while True:
             try:        # pop-then-use: safe vs the scheduler pump thread
                 fn, events, h = self._sink_outbox.pop(0)
             except IndexError:
                 return
-            if h is None:
-                fn(events)
-                continue
-            # deliver under the originating frame's trace scope so the
-            # sink records its publish span on the right tree even when
-            # the flush happens on the scheduler/ingest thread
-            prev = self._set_trace(h)
+            _st0 = time.perf_counter() if prof is not None else 0.0
             try:
-                fn(events)
+                if h is None:
+                    fn(events)
+                    continue
+                # deliver under the originating frame's trace scope so
+                # the sink records its publish span on the right tree
+                # even when the flush happens on the scheduler/ingest
+                # thread
+                prev = self._set_trace(h)
+                try:
+                    fn(events)
+                finally:
+                    self._trace_tls.handle = prev
             finally:
-                self._trace_tls.handle = prev
+                if prof is not None:
+                    try:
+                        n = len(events)
+                    except TypeError:
+                        n = 0
+                    prof.note("_sink", "sink_egress",
+                              time.perf_counter() - _st0, events=n)
 
     def _drain(self) -> None:
         guard = 0
+        prof = self.profiler
         while True:
             guard += 1
             if guard > 100_000:
@@ -1162,7 +1203,11 @@ class SiddhiAppRuntime:
                         pipe.origin = None
                 for plan in self._plans:
                     try:
-                        obs = plan.finalize()
+                        if prof is not None:
+                            with prof.round(plan.name):
+                                obs = plan.finalize()
+                        else:
+                            obs = plan.finalize()
                     except Exception as e:
                         obs = self._recover_finalize(plan, e)
                         if obs is None:
@@ -1199,6 +1244,9 @@ class SiddhiAppRuntime:
             # a traced frame's id rides into the histogram as the
             # bucket exemplar (`/metrics` OpenMetrics exemplars)
             h_tr = batch.__dict__.get("_trace")
+            # batch wall = the profiler's coverage denominator: rounds +
+            # scatter must attribute >= ~90% of this (docs/OBSERVABILITY.md)
+            _pt0 = time.perf_counter() if prof is not None else 0.0
             with self.stats.time_stream(
                     sid, batch.n,
                     trace_id=None if h_tr is None else h_tr.trace_id):
@@ -1238,7 +1286,15 @@ class SiddhiAppRuntime:
                         self._debugger.check_in(plan, batch)
                     t0d = time.perf_counter() if h_tr is not None else 0.0
                     try:
-                        if self.stats.enabled:
+                        if prof is not None:
+                            with prof.round(plan.name, batch.n):
+                                if self.stats.enabled:
+                                    with self.stats.time_plan(plan.name,
+                                                              batch.n):
+                                        obs = plan.process(sid, batch)
+                                else:
+                                    obs = plan.process(sid, batch)
+                        elif self.stats.enabled:
                             with self.stats.time_plan(plan.name, batch.n):
                                 obs = plan.process(sid, batch)
                         else:
@@ -1264,7 +1320,11 @@ class SiddhiAppRuntime:
                         self._emit(plan, ob)
                 for plan in subs:
                     try:
-                        obs = plan.collect_ready()
+                        if prof is not None:
+                            with prof.round(plan.name):
+                                obs = plan.collect_ready()
+                        else:
+                            obs = plan.collect_ready()
                     except Exception as e:
                         # pipelined entries carry their origin batch: a
                         # depth-D materialization failure routes the batch
@@ -1297,6 +1357,9 @@ class SiddhiAppRuntime:
                 if fault_err is not None:
                     if not self._handle_batch_fault(sid, batch, fault_err):
                         raise fault_err
+            if prof is not None:
+                prof.note_batch(time.perf_counter() - _pt0, batch.n)
+                prof.maybe_roll()
             if self.slo is not None:
                 # one end-to-end latency sample per dispatched batch; AIMD
                 # decisions land between batches — a flush boundary — so
@@ -1344,7 +1407,11 @@ class SiddhiAppRuntime:
         routing: a pipelined entry that fails to materialize routes the
         batch it was dispatched for (per its stream's @OnError action)
         while later entries keep flowing."""
+        prof = self.profiler
         try:
+            if prof is not None:
+                with prof.round(plan.name):
+                    return getattr(plan, fn_name)()
             return getattr(plan, fn_name)()
         except Exception as e:
             origin = getattr(e, "fault_origin", None)

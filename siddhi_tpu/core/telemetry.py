@@ -319,16 +319,22 @@ _NOOP = _Noop()
 
 
 class _StageTimer:
-    __slots__ = ("mgr", "name", "events", "plan", "t0", "seconds")
+    __slots__ = ("mgr", "name", "events", "plan", "t0", "seconds",
+                 "_pspan")
 
-    def __init__(self, mgr, name, events, plan):
+    def __init__(self, mgr, name, events, plan, pspan=None):
         self.mgr = mgr
         self.name = name
         self.events = events
         self.plan = plan
         self.seconds = 0.0
+        # piggy-backed profiler phase span (core/profiler.py): stages
+        # that map onto a dispatch phase record both from one timer
+        self._pspan = pspan
 
     def __enter__(self):
+        if self._pspan is not None:
+            self._pspan.__enter__()
         self.t0 = time.perf_counter()
         return self
 
@@ -337,6 +343,8 @@ class _StageTimer:
         self.seconds = dt
         self.mgr.stages[self.name].observe(dt, self.events)
         self.mgr.tracer.add(self.name, self.t0, dt, plan=self.plan)
+        if self._pspan is not None:
+            self._pspan.__exit__(*exc)
         return False
 
 
@@ -421,20 +429,34 @@ def env_nbytes(env) -> int:
 
 
 def call_kernel(stats, plan: str, fn, args: tuple, *, cache_hit: bool,
-                nbytes: int = 0):
+                nbytes: int = 0, prof=None):
     """Invoke a jitted kernel `fn(*args)` recording: per-plan fn-cache
     hit/miss, H2D bytes, and a `compile` (fn-cache miss — the call that
     pays trace + XLA compilation) or `kernel` (steady-state dispatch)
     stage span.  Classification rides the caller's cache probe so a
     block compiled while stats were off is never misreported as a
-    compile after `enable_stats(True)`."""
+    compile after `enable_stats(True)`.
+
+    `prof` (core/profiler.py PhaseProfiler, or None) routes the call
+    through the sampled h2d/kernel probe and records H2D bytes into the
+    phase plane.  Note: on a *sampled* round the stats `kernel` span
+    includes the probe's block_until_ready (full device wait), where
+    the steady-state span measures only the async dispatch — the
+    profiler's kernel_compute estimate is the authoritative device
+    time; the stage histogram keeps its dispatch-latency meaning for
+    the 31-in-32 unsampled majority."""
+    if prof is not None and nbytes:
+        prof.note_bytes(plan, "h2d", nbytes)
     if stats is None or not stats.enabled:
+        if prof is not None:
+            return prof.run_kernel(fn, args, cache_hit=cache_hit)
         return fn(*args)
     stats.on_kernel_cache(plan, cache_hit)
     if nbytes:
         stats.add_transfer_bytes(plan, nbytes)
     with stats.stage("kernel" if cache_hit else "compile", plan=plan) as sp:
-        out = fn(*args)
+        out = prof.run_kernel(fn, args, cache_hit=cache_hit) \
+            if prof is not None else fn(*args)
     if not cache_hit:
         stats.on_compile(plan, sp.seconds)
     return out
@@ -839,6 +861,29 @@ def render_prometheus(reports: dict, openmetrics: bool = False) -> str:
                 doc.add("siddhi_tpu_trace_triggers_total", "counter",
                         "trace-dump triggers by kind",
                         {**al, "kind": kind}, n)
+        # device-time attribution series (core/profiler.py)
+        prof = rep.get("profile")
+        if prof:
+            for plan, pd in (prof.get("plans") or {}).items():
+                pl2 = {**al, "plan": plan}
+                for phase, secs in (pd.get("phases_s") or {}).items():
+                    doc.add("siddhi_tpu_phase_seconds_total", "counter",
+                            "attributed wall seconds per plan and "
+                            "dispatch phase (sampled kernel/h2d "
+                            "extrapolated; docs/OBSERVABILITY.md)",
+                            {**pl2, "phase": phase}, secs)
+                if "host_dispatch_share" in pd:
+                    doc.add("siddhi_tpu_host_dispatch_share", "gauge",
+                            "share of a plan's dispatch wall spent "
+                            "host-side (pack/unpack + python + sink)",
+                            pl2, pd["host_dispatch_share"])
+            agg = prof.get("aggregate")
+            if agg and "host_dispatch_share" in agg:
+                doc.add("siddhi_tpu_host_dispatch_share", "gauge",
+                        "share of a plan's dispatch wall spent "
+                        "host-side (pack/unpack + python + sink)",
+                        {**al, "plan": "_aggregate"},
+                        agg["host_dispatch_share"])
         slo = rep.get("slo")
         if slo:
             doc.add("siddhi_tpu_slo_target_seconds", "gauge",
@@ -953,11 +998,23 @@ class StatisticsManager:
         """Context manager timing one plan.process batch."""
         return _PlanTimer(self, name, n)
 
+    # pipeline stages that map onto a dispatch phase of the device-time
+    # profiler (core/profiler.py): one timer records both planes
+    _STAGE_PHASE = {"host_build": "host_pack_unpack",
+                    "transfer": "d2h_materialize",
+                    "scatter": "host_pack_unpack"}
+
     def stage(self, name: str, events: int = 0, plan: Optional[str] = None):
-        """Context manager timing one pipeline-stage span."""
+        """Context manager timing one pipeline-stage span.  Stages that
+        map onto a profiler phase keep recording into the phase plane
+        even with statistics disabled (the profiler is its own knob)."""
+        prof = getattr(self.rt, "profiler", None)
+        phase = self._STAGE_PHASE.get(name) if prof is not None else None
         if not self.enabled:
-            return _NOOP
-        return _StageTimer(self, name, events, plan)
+            return prof.phase(phase) if phase is not None else _NOOP
+        return _StageTimer(self, name, events, plan,
+                           pspan=None if phase is None
+                           else prof.phase(phase))
 
     def note_stage(self, name: str, seconds: float, events: int = 0) -> None:
         """Record an already-measured span (parse time measured before
@@ -1134,6 +1191,13 @@ class StatisticsManager:
         tr = getattr(self.rt, "tracing", None)
         if tr is not None:
             rep["tracing"] = tr.metrics()
+        # device-time attribution (core/profiler.py): per-plan phase
+        # shares + host-dispatch share.  ALWAYS present when the
+        # profiler exists (not gated on `enabled`) — the phase plane is
+        # its own knob (@app:profile) and feeds its own /metrics series
+        prof = getattr(self.rt, "profiler", None)
+        if prof is not None:
+            rep["profile"] = prof.metrics()
         return rep
 
     def prometheus(self, openmetrics: bool = False) -> str:

@@ -58,6 +58,8 @@ TRIGGER_KINDS = (
     "quarantine",     # runtime: a device plan quarantined onto the interpreter
     "shed_burst",     # net.admission: frames shed by rate limit / watermark
     "wal_stall",      # core.wal: a durability barrier exceeded its budget
+    "host_share_breach",  # core.profiler: windowed host-dispatch share
+                          # above @app:hostShareAlert — the profile dump
 )
 
 # span names the engine records (docs/OBSERVABILITY.md span taxonomy)
@@ -324,24 +326,30 @@ class FrameTracer:
                 "at_unix_s": round(wall_ts, 3), "spans": len(spans),
                 "chrome": self.chrome_dump(
                     spans, extra_meta={"reason": kind, "detail": detail})}
-        with self._lock:
-            self.dumps.append(dump)
-            n = self.exported_files
+        # export BEFORE publication: a dump visible through dumps /
+        # dump_summaries / statistics()["tracing"] must never mutate
+        # afterwards — the old order set dump["path"] outside the lock
+        # on an already-published dict, a torn read for any scraper
+        path = None
         if self.export_dir:
             try:
                 os.makedirs(self.export_dir, exist_ok=True)
                 safe_app = self.app.replace(os.sep, "_") or "_app"
+                with self._lock:
+                    n = self.exported_files
                 path = os.path.join(
                     self.export_dir, f"trace-{safe_app}-{kind}-{n}.json")
                 tmp = path + ".tmp"
                 with open(tmp, "w") as f:
                     json.dump(dump["chrome"], f)
                 os.replace(tmp, path)
-                dump["path"] = path
-                with self._lock:
-                    self.exported_files += 1
             except OSError:
-                pass
+                path = None
+        with self._lock:
+            if path is not None:
+                dump["path"] = path
+                self.exported_files += 1
+            self.dumps.append(dump)
 
     # -- lifecycle / telemetry ----------------------------------------------
 

@@ -963,14 +963,15 @@ class DeviceWindowAggPlan(QueryPlan):
         # with a split batch — half the pad footprint)
         self.rt.inject("dispatch", self.name)
         pre = self.state
-        if not self.rt.stats.enabled:
+        prof = self.rt.profiler
+        if not self.rt.stats.enabled and prof is None:
             res = self._step_fn(T, self.C)(self.state, env)
         else:
             hit = (T, self.C) in getattr(self, "_step_cache", {})
             fn = self._step_fn(T, self.C)
             res = call_kernel(
                 self.rt.stats, self.name, fn, (self.state, env),
-                cache_hit=hit, nbytes=env_nbytes(env))
+                cache_hit=hit, nbytes=env_nbytes(env), prof=prof)
         start_d2h(res, keys=("b", "i", "f"))
         self.state = res["nst"]
         return {"pre": pre, "env": env, "batch": batch, "T": T, "res": res}

@@ -65,6 +65,12 @@ Endpoints (JSON unless noted):
                                     chrome://tracing / ui.perfetto.dev;
                                     `metadata.dumps` lists trigger-
                                     promoted retained dumps
+  GET  /siddhi/artifact/profile[?siddhiApp=<name>&window=<n>]
+                                    the device-time attribution plane
+                                    (docs/OBSERVABILITY.md "Device-time
+                                    profiling"): per-plan phase shares,
+                                    host-dispatch share, windowed ring
+                                    (last <n> snapshots), roofline fold
   GET  /siddhi/artifact/tuning[?siddhiApp=<name>]
                                     the persisted execution-geometry tuning
                                     cache (docs/AUTOTUNING.md): entries +
@@ -295,6 +301,15 @@ class SiddhiService:
                                               f"no deployed app {app!r}"})
                         else:
                             self._reply(200, service.trace(app))
+                    elif u.path == "/siddhi/artifact/profile":
+                        app = q.get("siddhiApp", [None])[0]
+                        if app is not None and app not in service.runtimes:
+                            self._reply(404, {"error":
+                                              f"no deployed app {app!r}"})
+                        else:
+                            w = q.get("window", [None])[0]
+                            self._reply(200, service.profile(
+                                app, window=None if w is None else int(w)))
                     elif u.path == "/siddhi/artifact/tuning":
                         app = q.get("siddhiApp", [None])[0]
                         if app is not None and app not in service.runtimes:
@@ -701,6 +716,17 @@ class SiddhiService:
         return {"traceEvents": evs,
                 "metadata": {"hostname": _socket.gethostname(),
                              "apps": apps_meta, "dumps": dumps}}
+
+    def profile(self, app: Optional[str] = None,
+                window: Optional[int] = None) -> dict:
+        """GET /siddhi/artifact/profile: the device-time attribution
+        plane (docs/OBSERVABILITY.md "Device-time profiling") — per-plan
+        phase seconds/shares, host-dispatch share, windowed ring, and
+        the roofline fold, for every deployed app (or just `app`).
+        `window` limits each app's ring to its last N snapshots."""
+        names = [app] if app is not None else sorted(self.runtimes)
+        return {"apps": {n: self.runtimes[n].profile(window=window)
+                         for n in names}}
 
     def tuning(self, app: Optional[str] = None) -> dict:
         """The persisted execution-geometry tuning cache (autotune.py):

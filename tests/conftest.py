@@ -64,8 +64,10 @@ def _siddhi_thread_leak_gate():
         # the trace exporter (core/tracing.py) is daemonized BUT must
         # never outlive the session: tracer.close() joins it on
         # shutdown, and an unclosed tracer's exporter self-terminates
-        # after ~0.5 s idle — either way it must be gone by now
-        if t.name == "siddhi-trace-export":
+        # after ~0.5 s idle — either way it must be gone by now.  The
+        # phase profiler (core/profiler.py) spawns no threads by
+        # design; the gate pins that contract too
+        if t.name in ("siddhi-trace-export", "siddhi-profile"):
             return True
         return not t.daemon
 
