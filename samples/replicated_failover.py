@@ -1,0 +1,96 @@
+"""Machine-loss-tolerant HA quickstart: hot-standby WAL replication +
+failover (docs/RELIABILITY.md "High availability & failover").
+
+A durable pattern app runs as the PRIMARY behind a frame server; a
+second runtime deploys the same app as a STANDBY replica that dials the
+primary's frame port and tails its write-ahead log (REPL frames,
+docs/SERVING.md).  The demo feeds frames, waits for the standby's
+applied watermark to converge, "loses the machine" (abandons the
+primary without shutdown), promotes the standby — fence, heal, replay
+to head — and shows the promoted node serving the identical match
+table.
+
+(The app string deliberately keeps the analyzer's SA14 warning visible:
+'semi-sync' behind an unbounded block-policy source means a standby
+stall surfaces only as producer backpressure — the smoke corpus pins
+it.)
+
+    python samples/replicated_failover.py
+"""
+import os, sys, shutil, tempfile, time
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.persistence import IncrementalFileSystemPersistenceStore
+from siddhi_tpu.net.server import NetServer
+
+APP = """
+@app:name('HADemo')
+@app:durability('batch')
+@app:replication('semi-sync', degrade='async')
+@source(type='tcp', port='0')
+define stream Ticks (symbol string, price double);
+define table Surges (symbol string, p1 double, p2 double);
+
+@info(name='surge')
+from every e1=Ticks[price > 100] -> e2=Ticks[price > e1.price] within 1 sec
+select e1.symbol as symbol, e1.price as p1, e2.price as p2
+insert into Surges;
+"""
+
+work = tempfile.mkdtemp(prefix="siddhi_ha_")
+rng = np.random.default_rng(7)
+ts0 = 1_700_000_000_000
+frames = [({"symbol": np.array([f"K{i}" for i in
+                                rng.integers(0, 4, 256)]),
+            "price": np.round(rng.uniform(90, 130, 256), 2)},
+           ts0 + np.arange(k * 256, (k + 1) * 256, dtype=np.int64))
+          for k in range(8)]
+
+# primary: durable + replicable, fronted by a frame server
+mgr_p = SiddhiManager()
+mgr_p.set_persistence_store(
+    IncrementalFileSystemPersistenceStore(work + "/pstore"))
+rt_p = mgr_p.create_app_runtime(APP)
+rt_p.start()
+srv = NetServer(lambda a, s: (_ for _ in ()).throw(KeyError(s)),
+                port=0, repl_resolve=lambda app: rt_p).start()
+
+# standby: same app text + the standby role, tailing the primary
+mgr_s = SiddhiManager()
+mgr_s.set_persistence_store(
+    IncrementalFileSystemPersistenceStore(work + "/sstore"))
+rt_s = mgr_s.create_app_runtime(APP.replace(
+    "@app:replication('semi-sync', degrade='async')",
+    "@app:replication('async', role='standby', "
+    f"peer='127.0.0.1:{srv.port}')"))
+rt_s.start()                             # passive: tails, serves nothing
+
+h = rt_p.input_handler("Ticks")
+for cols, ts in frames:
+    h.send_batch(cols, ts)
+rt_p.flush()
+n_live = len(rt_p.tables["Surges"].all_rows())
+
+deadline = time.time() + 20              # async tail: wait for convergence
+while time.time() < deadline:
+    if rt_s.replication.applied_watermark().get("Ticks", 0) >= len(frames):
+        break
+    time.sleep(0.05)
+print("standby:", {k: rt_s.replication.metrics()[k] for k in
+                   ("role", "applied_records", "applied_watermark")})
+
+rt_p.wal.close()                         # machine loss: no shutdown, the
+srv.stop()                               # process (and its box) vanish
+del rt_p, mgr_p
+
+report = rt_s.promote()                  # fence -> heal -> replay -> serve
+print("promotion:", {k: report[k] for k in
+                     ("promoted", "generation", "promote_s")})
+n_rec = len(rt_s.tables["Surges"].all_rows())
+print(f"matches: primary={n_live} promoted={n_rec} "
+      f"({'FAILOVER EXACT' if n_live == n_rec else 'MISMATCH'})")
+
+mgr_s.shutdown()
+shutil.rmtree(work, ignore_errors=True)

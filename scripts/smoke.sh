@@ -40,10 +40,11 @@ echo "== static analysis: samples corpus =="
 # the analyzer over every samples/*.py app string: expected findings are
 # PINNED (all info-severity conveniences in the samples); any new rule
 # firing — or an expected one disappearing — fails CI
-python -m siddhi_tpu.analysis --expect SA07,SA07,SA07,SA07,SA12,SA13,SA13 \
+python -m siddhi_tpu.analysis \
+    --expect SA07,SA07,SA07,SA07,SA12,SA13,SA13,SA13,SA14 \
     samples/simple_filter.py samples/time_window.py \
     samples/partitioned_pattern_tpu.py samples/net_serving.py \
-    samples/durable_serving.py
+    samples/durable_serving.py samples/replicated_failover.py
 
 echo "== tier-1 tests =="
 python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
@@ -61,6 +62,7 @@ WITNESS_OUT="$(mktemp -u /tmp/siddhi_lock_witness.XXXXXX.json)"
 SIDDHI_LOCK_CHECK=1 SIDDHI_LOCK_WITNESS_OUT="$WITNESS_OUT" \
     python -m pytest tests/test_net_admission.py tests/test_net_server.py \
     tests/test_wal.py tests/test_service.py tests/test_tracing.py \
+    tests/test_replication.py \
     -q -m 'not slow' -p no:cacheprovider
 python -m siddhi_tpu.analysis --threads --witness "$WITNESS_OUT"
 rm -f "$WITNESS_OUT"
@@ -423,6 +425,154 @@ try:
           f"{rec['replayed_frames']} frames replayed in "
           f"{rec['recovery_s']}s)")
 finally:
+    shutil.rmtree(work, ignore_errors=True)
+EOF
+
+echo "== HA failover smoke =="
+# machine-loss failover end-to-end (docs/RELIABILITY.md "High
+# availability"): two service subprocesses — a durable primary and a
+# hot standby tailing its WAL over the frame protocol — feed the
+# primary N ACK'd frames, wait for the standby's applied watermark to
+# converge, SIGKILL the primary, POST /siddhi/artifact/promote to the
+# standby, and assert the promoted node serves match counts identical
+# to an uninterrupted in-process run.  Exits nonzero on any drift.
+python - <<'EOF'
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+import numpy as np
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.persistence import IncrementalFileSystemPersistenceStore
+from siddhi_tpu.net import TcpFrameClient
+
+APP = """@app:name('HASmoke')
+@app:durability('batch', dir='{wal}', segment.bytes='4096')
+{extra}define stream S (sym string, p double);
+define table M (s1 string, p2 double);
+@info(name='q') from every e1=S[p > 100] -> e2=S[p > e1.p] within 1 sec
+select e1.sym as s1, e2.p as p2 insert into M;
+"""
+
+CHILD = """
+import sys, threading
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.persistence import IncrementalFileSystemPersistenceStore
+from siddhi_tpu.service import SiddhiService
+mgr = SiddhiManager()
+mgr.set_persistence_store(IncrementalFileSystemPersistenceStore(sys.argv[1]))
+svc = SiddhiService(port=0, manager=mgr).start()
+print(f"READY {svc.port} {svc.net_port}", flush=True)
+threading.Event().wait()
+"""
+
+rng = np.random.default_rng(13)
+ts0 = 1_700_000_000_000
+frames = [({"sym": np.array([f"K{i}" for i in rng.integers(0, 4, 256)]),
+            "p": np.round(rng.uniform(90, 130, 256), 2)},
+           ts0 + np.arange(k * 256, (k + 1) * 256, dtype=np.int64))
+          for k in range(6)]
+
+work = tempfile.mkdtemp(prefix="siddhi_ha_smoke_")
+
+# uninterrupted in-process reference
+mgr = SiddhiManager()
+mgr.set_persistence_store(
+    IncrementalFileSystemPersistenceStore(work + "/ref_store"))
+rt = mgr.create_app_runtime(APP.format(wal=work + "/ref_wal", extra=""))
+rt.start()
+h = rt.input_handler("S")
+for cols, ts in frames:
+    h.send_batch(cols, ts)
+rt.flush()
+want = len(rt.tables["M"].all_rows())
+mgr.shutdown()
+assert want > 0
+
+
+def start_service(store):
+    p = subprocess.Popen([sys.executable, "-c", CHILD, store],
+                         stdout=subprocess.PIPE, text=True)
+    line = p.stdout.readline().split()
+    assert line and line[0] == "READY", line
+    return p, int(line[1]), int(line[2])
+
+
+def post(port, path, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=body if isinstance(body, bytes) else json.dumps(body).encode(),
+        method="POST")
+    return json.loads(urllib.request.urlopen(req).read())
+
+
+def repl_info(port):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/siddhi/artifact/snapshot"
+            f"?siddhiApp=HASmoke") as r:
+        return json.loads(r.read())
+
+
+try:
+    primary, p_port, p_net = start_service(work + "/p_store")
+    post(p_port, "/siddhi/artifact/deploy",
+         APP.format(wal=work + "/p_wal", extra="").encode())
+    standby, s_port, s_net = start_service(work + "/s_store")
+    post(s_port, "/siddhi/artifact/deploy",
+         APP.format(wal=work + "/s_wal",
+                    extra="@app:replication('async', role='standby', "
+                          f"peer='127.0.0.1:{p_net}')\n").encode())
+
+    cli = TcpFrameClient("127.0.0.1", p_net, "S",
+                         [("sym", "string"), ("p", "double")],
+                         app="HASmoke")
+    for cols, ts in frames:
+        cli.send_batch(cols, ts)
+    cli.barrier(timeout=60)        # durable ACK: frames are in the WAL
+
+    # hot standby converges (async: poll its applied watermark)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        repl = repl_info(s_port).get("replication", {})
+        if repl.get("applied_watermark", {}).get("S", 0) >= len(frames):
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError(f"standby never converged: {repl}")
+
+    os.kill(primary.pid, signal.SIGKILL)   # machine loss
+    primary.wait(timeout=10)
+    try:
+        cli.close()
+    except OSError:
+        pass
+
+    rep = post(s_port, "/siddhi/artifact/promote", {"app": "HASmoke"})
+    assert rep["promoted"] and rep["generation"] >= 1, rep
+    assert rep["recovery"]["replayed_frames"] == len(frames), rep
+    got = len(post(s_port, "/siddhi/artifact/query",
+                   {"app": "HASmoke",
+                    "query": "from M select s1"})["rows"])
+    assert got == want, f"match drift after failover: {got} != {want}"
+    info = repl_info(s_port)
+    assert info["replication"]["role"] == "primary", info["replication"]
+    assert info["replication"]["promoted"] is True
+    os.kill(standby.pid, signal.SIGKILL)
+    print(f"OK: failover exact ({got} matches on the promoted standby, "
+          f"{rep['recovery']['replayed_frames']} frames replayed, "
+          f"promote {rep['promote_s']}s)")
+finally:
+    for p in ("primary", "standby"):
+        proc = locals().get(p)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
     shutil.rmtree(work, ignore_errors=True)
 EOF
 

@@ -50,6 +50,9 @@ RULES = {
     "SA13": ("warn", "@app:durability with no resolvable store/WAL "
                      "directory, or 'fsync' behind an unbounded "
                      "block-policy source"),
+    "SA14": ("warn", "@app:replication without @app:durability (nothing "
+                     "to ship), or 'semi-sync' over an unbounded "
+                     "block-policy source"),
 }
 
 
@@ -512,6 +515,54 @@ def _rule_sa13_durability(ctx, out):
                 f"'batch' (ACK/PING barriers still fsync)", sid))
 
 
+def _rule_sa14_replication(ctx, out):
+    """Replication misconfigurations (docs/RELIABILITY.md "High
+    availability & failover"):
+
+    (a) `@app:replication` without `@app:durability` — replication
+        ships the write-ahead log; with no log there is nothing to
+        ship, and the runtime constructor rejects the app at deploy.
+
+    (b) `'semi-sync'` combined with `shed.policy='block'` (explicit or
+        the default) on a source with no `max.pending` bound: the
+        durable-ACK barrier now waits on the standby's append-ack, so
+        a slow/partitioned standby stalls the PING path — with a
+        block-policy source and no pending bound, that stall
+        backpressures ingest unboundedly instead of surfacing as an
+        accounted shed or a bounded park."""
+    rep = ast.find_annotation(ctx.app.annotations, "app:replication")
+    if rep is None:
+        return
+    dur = ast.find_annotation(ctx.app.annotations, "app:durability")
+    mode = str(rep.element() or "async").lower()
+    if dur is None or str(dur.element() or "batch").lower() == "off":
+        out.append(_finding(
+            "SA14",
+            f"@app:replication({mode!r}) without @app:durability: "
+            f"replication ships the write-ahead log, and this app "
+            f"writes none — the deploy will be rejected; declare "
+            f"@app:durability('batch'|'fsync')",
+            "app"))
+        return
+    if mode != "semi-sync":
+        return
+    for sid, sd in ctx.app.stream_definitions.items():
+        src = ast.find_annotation(sd.annotations, "source")
+        if src is None:
+            continue
+        policy = str(src.element("shed.policy") or "block").lower()
+        if policy == "block" and src.element("max.pending") is None:
+            out.append(_finding(
+                "SA14",
+                f"@app:replication('semi-sync') with "
+                f"shed.policy='block' and no max.pending on source "
+                f"stream {sid!r}: the durable-ACK barrier waits on the "
+                f"standby's append-ack, so a slow or partitioned "
+                f"standby stalls ingest unboundedly — bound "
+                f"max.pending (or shed) so replication lag surfaces "
+                f"as accounted backpressure", sid))
+
+
 _RULE_FNS = (
     _rule_sa01_every_without_within,
     _rule_sa02_windowless_aggregation,
@@ -526,6 +577,7 @@ _RULE_FNS = (
     _rule_sa11_cross_join,
     _rule_sa12_f32_precision,
     _rule_sa13_durability,
+    _rule_sa14_replication,
 )
 
 _SEV_ORDER = {"error": 0, "warn": 1, "info": 2}

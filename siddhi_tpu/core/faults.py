@@ -424,7 +424,8 @@ class FaultInjector:
 
     POINTS = ("dispatch", "d2h", "sink.publish", "source.connect",
               "persist.save", "net.decode", "net.feed",
-              "wal.append", "wal.fsync", "wal.truncate")
+              "wal.append", "wal.fsync", "wal.truncate",
+              "repl.ship", "repl.ack", "repl.promote")
 
     def __init__(self, seed: int = 0, counts: Optional[dict] = None,
                  rates: Optional[dict] = None, kinds: Optional[dict] = None):

@@ -1,0 +1,373 @@
+"""Hot-standby WAL replication: roles, the semi-sync ACK barrier, and
+lag accounting (ROADMAP item 5 — "make it survive MACHINE loss").
+
+The moving parts live where the data lives — `core/wal.py` owns the
+byte-level tail/append/fencing, `net/repl.py` owns the wire (shipper on
+the primary's connection, receiver on the standby) — so this module is
+the app-level brain both sides share:
+
+* parse `@app:replication('async'|'semi-sync', role=..., peer=...)`
+  into a ReplicationConfig (validated against `@app:durability` — a
+  log you never write cannot be shipped; analysis rule SA14 flags the
+  same statically);
+* on the PRIMARY, track each standby's acknowledged watermark so the
+  durable-ACK barrier can extend from "local fsync" to "local fsync +
+  standby append-ack" (`wait_ack`), and derive the lag gauges
+  (`siddhi_tpu_repl_lag_records` / `_lag_seconds`) plus the
+  `repl_lag_breach` flight-recorder trigger;
+* on the STANDBY, track the applied watermark and the highest primary
+  generation seen, so `promote()` can fence ABOVE it (core/wal.py
+  write_generation) and the deposed primary's appends are rejected.
+
+Semi-sync semantics (docs/RELIABILITY.md): the producer's PING→ACK
+barrier succeeds only after the local fsync AND the standby confirms
+the same watermark appended to ITS log.  No standby connected, or an
+ack slower than `ack.timeout` -> the barrier FAILS (FrameDesync) and
+the producer retransmits from its last ACK — the retransmit contract
+is exactly what makes failover lossless, so degrading silently to
+async would be lying about durability.  Opt into that trade
+explicitly with `degrade='async'`.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from ..query import ast as qast
+from ..utils.locks import new_lock
+
+MODES = ("async", "semi-sync")
+ROLES = ("primary", "standby")
+
+
+class ReplicationError(Exception):
+    pass
+
+
+class ReplicationConfig:
+    """Parsed `@app:replication(...)` (plan-time; immutable)."""
+
+    def __init__(self, mode: str, role: str = "primary",
+                 peer: Optional[str] = None,
+                 ack_timeout_s: float = 5.0,
+                 heartbeat_s: float = 1.0,
+                 lag_records: int = 10_000,
+                 lag_breach_s: float = 5.0,
+                 degrade: Optional[str] = None):
+        if mode not in MODES:
+            raise ReplicationError(
+                f"@app:replication({mode!r}): unknown mode "
+                f"(have: async | semi-sync)")
+        if role not in ROLES:
+            raise ReplicationError(
+                f"@app:replication(role={role!r}): unknown role "
+                f"(have: primary | standby)")
+        if role == "standby" and not peer:
+            raise ReplicationError(
+                "@app:replication(role='standby') requires peer="
+                "'host:port' (the primary's frame endpoint to tail)")
+        if degrade not in (None, "async"):
+            raise ReplicationError(
+                f"@app:replication(degrade={degrade!r}): the only "
+                f"degradation is 'async' (barrier stops waiting for "
+                f"the standby when none is connected)")
+        self.mode = mode
+        self.role = role
+        self.peer = peer
+        self.ack_timeout_s = float(ack_timeout_s)
+        self.heartbeat_s = float(heartbeat_s)
+        self.lag_records = int(lag_records)
+        self.lag_breach_s = float(lag_breach_s)
+        self.degrade = degrade
+
+    def to_dict(self) -> dict:
+        return {"mode": self.mode, "role": self.role, "peer": self.peer,
+                "ack_timeout_s": self.ack_timeout_s,
+                "heartbeat_s": self.heartbeat_s,
+                "lag_records": self.lag_records,
+                "lag_breach_s": self.lag_breach_s,
+                "degrade": self.degrade}
+
+
+def config_from_annotations(app) -> Optional[ReplicationConfig]:
+    """`@app:replication('async'|'semi-sync', role=, peer=,
+    ack.timeout=, heartbeat=, lag.records=, lag.breach=, degrade=)`
+    -> ReplicationConfig, or None when the app is not replicated."""
+    ann = qast.find_annotation(app.annotations, "app:replication")
+    if ann is None:
+        return None
+    mode = (ann.element() or "async").lower()
+    kw: dict = {}
+    for k, v in ann.elements:
+        if not k:
+            continue
+        key = k.lower()
+        if key == "role":
+            kw["role"] = v.lower()
+        elif key == "peer":
+            kw["peer"] = v
+        elif key in ("ack.timeout", "ack.timeout.s"):
+            kw["ack_timeout_s"] = _seconds(v)
+        elif key in ("heartbeat", "heartbeat.s"):
+            kw["heartbeat_s"] = _seconds(v)
+        elif key == "lag.records":
+            kw["lag_records"] = int(v)
+        elif key in ("lag.breach", "lag.breach.s"):
+            kw["lag_breach_s"] = _seconds(v)
+        elif key == "degrade":
+            kw["degrade"] = v.lower()
+        else:
+            raise ReplicationError(
+                f"@app:replication: unknown option {k!r}")
+    return ReplicationConfig(mode, **kw)
+
+
+def _seconds(text) -> float:
+    """'250 ms' | '5 sec' | '1.5' -> seconds."""
+    s = str(text).strip().lower()
+    for suffix, mult in (("ms", 1e-3), ("milliseconds", 1e-3),
+                         ("millisecond", 1e-3), ("seconds", 1.0),
+                         ("second", 1.0), ("sec", 1.0), ("s", 1.0),
+                         ("minutes", 60.0), ("minute", 60.0),
+                         ("min", 60.0)):
+        if s.endswith(suffix):
+            return float(s[:-len(suffix)].strip()) * mult
+    return float(s)
+
+
+class ReplicationCoordinator:
+    """One app's replication state, shared by the runtime, the
+    net-plane shipper/receiver, and the PING barrier.
+
+    Primary side: `on_ack` folds each standby append-ack into the
+    acknowledged watermark and wakes `wait_ack` sleepers (the semi-sync
+    barrier).  Standby side: `note_applied` / `note_generation` track
+    what the receiver has landed, so promote() knows what to fence
+    above.  Either side: `metrics()` feeds
+    statistics()["replication"] and the siddhi_tpu_repl_* series."""
+
+    def __init__(self, config: ReplicationConfig,
+                 on_lag_breach: Optional[Callable[[str], None]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.config = config
+        self.role = config.role         # flips to "primary" at promote
+        self.promoted = False
+        self.clock = clock
+        self.on_lag_breach = on_lag_breach
+        self._lock = new_lock("ReplicationCoordinator._lock")
+        self._ack_cv = threading.Condition(self._lock)
+        # barrier sleepers poke the shipper so a semi-sync ACK is not
+        # gated on the shipper's idle-poll cadence (~IDLE_S of latency)
+        self.ship_wake = threading.Event()
+        # primary side --------------------------------------------------
+        self._acked: dict = {}          # stream -> standby-appended seq
+        self._local: dict = {}          # stream -> local appended seq
+        self._standbys = 0              # live subscriber connections
+        self._last_ack_t: Optional[float] = None
+        self._lag_breach_since: Optional[float] = None
+        self._lag_breached = False
+        self.shipped_records = 0
+        self.shipped_bytes = 0
+        self.shipped_snapshots = 0
+        self.acks = 0
+        self.heartbeats = 0
+        self.rejected_generation = 0    # fenced-off appends we refused
+        self.barrier_waits = 0
+        self.barrier_timeouts = 0
+        # standby side --------------------------------------------------
+        self._applied: dict = {}        # stream -> seq landed in our log
+        self._source_generation = 0     # highest primary gen seen
+        self.applied_records = 0
+        self.applied_bytes = 0
+        self.applied_snapshots = 0
+        self._last_record_t: Optional[float] = None
+
+    # -- primary: standby tracking & the semi-sync barrier -------------------
+
+    def standby_attached(self) -> None:
+        with self._lock:
+            self._standbys += 1
+
+    def standby_detached(self) -> None:
+        with self._ack_cv:
+            self._standbys = max(0, self._standbys - 1)
+            # wake barrier sleepers so a dead standby fails them at the
+            # timeout (or immediately under degrade='async')
+            self._ack_cv.notify_all()
+
+    def standbys(self) -> int:
+        with self._lock:
+            return self._standbys
+
+    def note_local(self, watermark: dict) -> None:
+        """The primary's own appended watermark (lag's minuend)."""
+        with self._lock:
+            for s, v in (watermark or {}).items():
+                if int(v) > self._local.get(s, 0):
+                    self._local[s] = int(v)
+
+    def note_shipped(self, records: int, nbytes: int) -> None:
+        with self._lock:
+            self.shipped_records += records
+            self.shipped_bytes += nbytes
+
+    def on_ack(self, watermark: dict) -> None:
+        """A standby confirmed `watermark` appended to ITS log."""
+        with self._lock:        # _ack_cv shares this lock: notify is legal
+            self.acks += 1
+            self._last_ack_t = self.clock()
+            for s, v in (watermark or {}).items():
+                if int(v) > self._acked.get(s, 0):
+                    self._acked[s] = int(v)
+            self._ack_cv.notify_all()
+        self._check_lag()
+
+    def on_heartbeat(self, watermark: dict) -> None:
+        with self._lock:
+            self.heartbeats += 1
+            self._last_ack_t = self.clock()
+        self._check_lag()
+
+    def _acked_covers_locked(self, watermark: dict) -> bool:
+        return all(self._acked.get(s, 0) >= int(v)
+                   for s, v in watermark.items())
+
+    def wait_ack(self, watermark: dict,
+                 timeout_s: Optional[float] = None) -> bool:
+        """Block until a standby has acknowledged every stream of
+        `watermark`, or the timeout lapses — the semi-sync half of the
+        durable-ACK barrier.  Returns False on timeout OR when no
+        standby is connected (unless degrade='async', which waives the
+        wait entirely): the caller MUST fail the barrier so the
+        producer retransmits."""
+        if not watermark:
+            return True
+        self.ship_wake.set()            # ship our tail NOW, not at poll
+        deadline = self.clock() + (timeout_s if timeout_s is not None
+                                   else self.config.ack_timeout_s)
+        with self._ack_cv:
+            self.barrier_waits += 1
+            while not self._acked_covers_locked(watermark):
+                if self._standbys == 0 and self.config.degrade == "async":
+                    return True         # explicit opt-out: local-only
+                remaining = deadline - self.clock()
+                if remaining <= 0:
+                    self.barrier_timeouts += 1
+                    return False
+                self._ack_cv.wait(min(remaining, 0.25))
+            return True
+
+    # -- standby: applied tracking -------------------------------------------
+
+    def note_applied(self, stream: str, seq: int, nbytes: int) -> None:
+        with self._lock:
+            if int(seq) > self._applied.get(stream, 0):
+                self._applied[stream] = int(seq)
+            self.applied_records += 1
+            self.applied_bytes += nbytes
+            self._last_record_t = self.clock()
+
+    def note_snapshot(self, watermark: Optional[dict]) -> None:
+        with self._lock:
+            self.applied_snapshots += 1
+            for s, v in (watermark or {}).items():
+                if int(v) > self._applied.get(s, 0):
+                    self._applied[s] = int(v)
+            self._last_record_t = self.clock()
+
+    def note_generation(self, generation: int) -> None:
+        with self._lock:
+            if int(generation) > self._source_generation:
+                self._source_generation = int(generation)
+
+    def source_generation(self) -> int:
+        with self._lock:
+            return self._source_generation
+
+    def applied_watermark(self) -> dict:
+        with self._lock:
+            return dict(self._applied)
+
+    def mark_promoted(self, generation: int) -> None:
+        with self._lock:        # _ack_cv shares this lock: notify is legal
+            self.role = "primary"
+            self.promoted = True
+            self._source_generation = int(generation)
+            self._ack_cv.notify_all()
+
+    # -- lag -----------------------------------------------------------------
+
+    def lag(self) -> tuple:
+        """-> (lag_records, lag_seconds) from whichever side's books
+        this node keeps (primary: local vs acked; standby: freshness of
+        the last applied record)."""
+        with self._lock:
+            if self.role == "primary":
+                rec = sum(max(0, v - self._acked.get(s, 0))
+                          for s, v in self._local.items())
+                sec = (self.clock() - self._last_ack_t) \
+                    if self._last_ack_t is not None and rec else 0.0
+            else:
+                rec = 0
+                sec = (self.clock() - self._last_record_t) \
+                    if self._last_record_t is not None else 0.0
+            return rec, max(0.0, sec)
+
+    def _check_lag(self) -> None:
+        """Sustained lag past BOTH thresholds fires `on_lag_breach`
+        once per excursion (the repl_lag_breach flight-recorder
+        trigger); recovery re-arms it."""
+        cb = self.on_lag_breach
+        if cb is None:
+            return
+        rec, sec = self.lag()
+        now = self.clock()
+        with self._lock:
+            over = (rec > self.config.lag_records)
+            if not over:
+                self._lag_breach_since = None
+                self._lag_breached = False
+                return
+            if self._lag_breach_since is None:
+                self._lag_breach_since = now
+            sustained = now - self._lag_breach_since
+            if sustained < self.config.lag_breach_s or self._lag_breached:
+                return
+            self._lag_breached = True
+        try:
+            cb(f"replication lag {rec} records "
+               f"(> {self.config.lag_records}) sustained "
+               f"{sustained:.1f}s with {self.standbys()} standby(s)")
+        except Exception:
+            pass                        # observability must not fail the path
+
+    # -- telemetry -----------------------------------------------------------
+
+    def metrics(self) -> dict:
+        rec, sec = self.lag()
+        with self._lock:
+            m = {"mode": self.config.mode,
+                 "role": self.role,
+                 "promoted": self.promoted,
+                 "peer": self.config.peer,
+                 "standbys": self._standbys,
+                 "lag_records": rec,
+                 "lag_seconds": round(sec, 3),
+                 "shipped_records": self.shipped_records,
+                 "shipped_bytes": self.shipped_bytes,
+                 "shipped_snapshots": self.shipped_snapshots,
+                 "acks": self.acks,
+                 "heartbeats": self.heartbeats,
+                 "rejected_generation": self.rejected_generation,
+                 "barrier_waits": self.barrier_waits,
+                 "barrier_timeouts": self.barrier_timeouts}
+            if self.role != "primary" or self.promoted:
+                m.update({"applied_records": self.applied_records,
+                          "applied_bytes": self.applied_bytes,
+                          "applied_snapshots": self.applied_snapshots,
+                          "source_generation": self._source_generation,
+                          "applied_watermark": dict(self._applied)})
+            if self._acked:
+                m["acked_watermark"] = dict(self._acked)
+            return m

@@ -277,6 +277,25 @@ class SiddhiAppRuntime:
         self._wal_recovery = None        # last recover() report
         self.last_revision_descriptor = None   # last persist() Revision
 
+        # @app:replication('async'|'semi-sync', role=, peer=...): hot-
+        # standby WAL replication (core/replication.py + net/repl.py,
+        # docs/RELIABILITY.md "High availability & failover").  The
+        # coordinator is built at start() (or lazily when a standby
+        # subscribes to an un-annotated durable app)
+        from .replication import ReplicationError, config_from_annotations
+        try:
+            self.replication_config = config_from_annotations(app)
+        except ReplicationError as e:
+            raise PlanError(str(e)) from None
+        if self.replication_config is not None and self.durability == "off":
+            raise PlanError(
+                "@app:replication requires @app:durability — without a "
+                "write-ahead log there is nothing to ship (analysis "
+                "rule SA14)")
+        self.replication = None          # ReplicationCoordinator
+        self._repl_receiver = None       # standby-side net.repl.WalReceiver
+        self._standby_active = False     # standby replica: ingest blocked
+
         # end-to-end frame tracing (core/tracing.py): cross-thread span
         # trees carried by Work/EventBatch/sink-outbox entries, plus the
         # trigger registry that promotes the always-on ring into retained
@@ -464,7 +483,20 @@ class SiddhiAppRuntime:
         """Start the runtime: fire `at 'start'` triggers, anchor periodic/
         cron triggers, and (in real-time mode) start the wall-clock
         scheduler pump (reference: SiddhiAppRuntime.start:370 starts
-        sources + trigger schedulers; Scheduler.java:89 timer service)."""
+        sources + trigger schedulers; Scheduler.java:89 timer service).
+
+        Under `@app:replication(role='standby')` the runtime starts as
+        a passive replica instead: it opens its local WAL and tails the
+        primary (net/repl.py), serving nothing until promote()."""
+        cfg = self.replication_config   # lint: allow (set once at parse)
+        coord = self._ensure_replication()
+        if cfg is not None and cfg.role == "standby" \
+                and not (coord is not None and coord.promoted):
+            self._start_standby()
+            return
+        self._start_serving()
+
+    def _start_serving(self) -> None:
         from .trigger import TriggerRuntime
         self._started = True
         if self.tracing is not None:
@@ -512,6 +544,96 @@ class SiddhiAppRuntime:
                 s.connected = True
         if not self._playback:
             self._start_scheduler()
+
+    # -- replication: standby role & failover (core/replication.py) ----------
+
+    def _ensure_replication(self, default: bool = False):
+        """The app's ReplicationCoordinator — built from the
+        annotation config, or (default=True, the serving plane's path
+        when a standby subscribes to an UN-annotated durable app) from
+        an implicit async-primary config."""
+        with self._lock:
+            if self.replication is not None:
+                return self.replication
+            cfg = self.replication_config
+            if cfg is None:
+                if not default or self.durability == "off":
+                    return None
+                from .replication import ReplicationConfig
+                cfg = self.replication_config = ReplicationConfig("async")
+            from .replication import ReplicationCoordinator
+            tr = self.tracing
+            self.replication = ReplicationCoordinator(
+                cfg, on_lag_breach=None if tr is None else
+                (lambda detail: tr.trigger("repl_lag_breach", detail)))
+            return self.replication
+
+    def is_standby(self) -> bool:
+        return self._standby_active
+
+    def _start_standby(self) -> None:
+        """Start as a passive replica: open the local WAL (healing scan
+        + seq recovery, NO replay into plans — state materializes at
+        promote()) and run the WalReceiver tailing the primary."""
+        self._started = True
+        self._standby_active = True
+        if self.tracing is not None:
+            self.tracing.reopen()
+        wal = self._open_wal()
+        if wal is None:
+            raise RuntimeError(
+                f"standby {self.app.name!r} could not open a WAL "
+                f"({getattr(self, '_wal_disabled_reason', 'no directory')})"
+                f" — a replica without a log cannot replicate")
+        if self.stats.enabled and self.stats.reporter is not None:
+            self.stats.start_reporting()
+        if self._repl_receiver is None:
+            from ..net.repl import WalReceiver
+            self._repl_receiver = WalReceiver(
+                self,
+                self.replication,   # lint: allow (set once at construction)
+                self.replication_config.peer).start()
+
+    def promote(self) -> dict:
+        """Fail over: flip this standby replica to serving primary.
+        Stops the tail, FENCES the log above every generation seen from
+        the old primary (its post-promote appends are rejected loudly),
+        then runs the ordinary recovery manager — restore the newest
+        shipped revision, heal the replicated log's torn tail, replay
+        to head — and starts serving.  Producers reconnect and
+        retransmit from their last ACK; with semi-sync that window is
+        exactly what the standby already has, so outputs stay
+        byte-identical and `events_in == applied + shed` holds across
+        the failover."""
+        coord = self.replication    # lint: allow (set once at construction)
+        if coord is None or not self._standby_active:
+            raise RuntimeError(
+                f"promote(): app {self.app.name!r} is not a standby "
+                f"replica")
+        t0 = time.perf_counter()
+        if self._repl_receiver is not None:
+            self._repl_receiver.stop()
+            self._repl_receiver = None
+        self.inject("repl.promote", self.app.name)
+        # fence FIRST: from here the old primary's generation is dead,
+        # even if recovery below fails and is retried
+        generation = self.wal.fence(coord.source_generation())
+        # close the tailing log so recover() re-opens it through the
+        # healing scan and replays the suffix past the restored
+        # watermark (seq continuity: _open_wal floors from _wal_closed)
+        self.wal.close()
+        self._wal_closed, self.wal = self.wal, None
+        self._standby_active = False
+        coord.mark_promoted(generation)
+        report = self.recover()
+        self._start_serving()
+        out = {"promoted": True, "generation": generation,
+               "watermark": self.wal.watermark()
+               if self.wal is not None else {},
+               "recovery": report,
+               "promote_s": round(time.perf_counter() - t0, 6)}
+        self._promote_report = out      # snapshot_info/explain audit trail
+        return out
 
     def _start_ingest_worker(self) -> None:
         """@app:async: frozen micro-batches queue to a worker that runs
@@ -724,6 +846,10 @@ class SiddhiAppRuntime:
             self._shutdown_serialized()
 
     def _shutdown_serialized(self) -> None:
+        if self._repl_receiver is not None:
+            self._repl_receiver.stop()
+            self._repl_receiver = None
+        self._standby_active = False
         for s in (*self.sources, *self.sinks):
             if s.connected:
                 s.disconnect()
@@ -814,7 +940,14 @@ class SiddhiAppRuntime:
 
     # -- ingest --------------------------------------------------------------
 
+    def _check_not_standby(self) -> None:
+        if self._standby_active:
+            raise RuntimeError(
+                f"app {self.app.name!r} is a standby replica — "
+                f"promote() before ingesting")
+
     def send(self, stream_id: str, data, timestamp: Optional[int] = None) -> None:
+        self._check_not_standby()
         with self._lock:
             self._send_locked(stream_id, data, timestamp)
         self._drain_async_outbox()
@@ -828,6 +961,7 @@ class SiddhiAppRuntime:
         `send` merge AHEAD of the columnar segment in that batch (the
         builder adopts the arrays zero-copy — batch.py append_columnar —
         so arrival order is preserved without a split micro-batch)."""
+        self._check_not_standby()
         from .schema import dtype_of as _dtype_of
         schema = self.schemas.get(stream_id)
         if schema is None:
@@ -2107,6 +2241,8 @@ class SiddhiAppRuntime:
                 d["reason"] = reason
         if self._wal_recovery is not None:
             d["recovery"] = dict(self._wal_recovery)
+        if getattr(self, "_promote_report", None) is not None:
+            d["promotion"] = dict(self._promote_report)
         return d
 
     def recover(self) -> dict:

@@ -845,6 +845,51 @@ def render_prometheus(reports: dict, openmetrics: bool = False) -> str:
                 doc.add("siddhi_tpu_wal_replayed_events", "gauge",
                         "events replayed by the last recovery", al,
                         rec.get("replayed_events"))
+        # replication series (core/replication.py): role, lag, volume,
+        # fencing rejections — the HA dashboard (docs/OBSERVABILITY.md)
+        repl = rep.get("replication")
+        if repl:
+            doc.add("siddhi_tpu_repl_role", "gauge",
+                    "replication role (1 primary, 0 standby)",
+                    {**al, "role": str(repl.get("role"))},
+                    1 if repl.get("role") == "primary" else 0)
+            doc.add("siddhi_tpu_repl_standbys", "gauge",
+                    "standby replicas attached to this primary", al,
+                    repl.get("standbys", 0))
+            doc.add("siddhi_tpu_repl_lag_records", "gauge",
+                    "WAL records appended locally but not yet "
+                    "acknowledged by a standby", al,
+                    repl.get("lag_records", 0))
+            doc.add("siddhi_tpu_repl_lag_seconds", "gauge",
+                    "seconds since the last standby ack/heartbeat "
+                    "(primary) or applied record (standby)", al,
+                    repl.get("lag_seconds", 0.0))
+            _REPL_COUNTERS = (
+                ("shipped_records", "siddhi_tpu_repl_shipped_records_total",
+                 "WAL records shipped to standbys"),
+                ("shipped_bytes", "siddhi_tpu_repl_shipped_bytes_total",
+                 "WAL bytes shipped to standbys"),
+                ("shipped_snapshots",
+                 "siddhi_tpu_repl_shipped_snapshots_total",
+                 "snapshot revisions shipped for catch-up"),
+                ("applied_records", "siddhi_tpu_repl_applied_records_total",
+                 "replicated WAL records appended to the local log"),
+                ("applied_snapshots",
+                 "siddhi_tpu_repl_applied_snapshots_total",
+                 "shipped snapshot revisions saved locally"),
+                ("acks", "siddhi_tpu_repl_acks_total",
+                 "standby append-acks received"),
+                ("rejected_generation",
+                 "siddhi_tpu_repl_rejected_generation_total",
+                 "frames/links rejected by the fencing token "
+                 "(deposed-primary writes)"),
+                ("barrier_timeouts",
+                 "siddhi_tpu_repl_barrier_timeouts_total",
+                 "semi-sync durable-ACK barriers failed waiting for a "
+                 "standby"))
+            for key, name, help_ in _REPL_COUNTERS:
+                if key in repl:
+                    doc.add(name, "counter", help_, al, repl[key])
         # frame-tracing series (core/tracing.py)
         trc = rep.get("tracing")
         if trc:
@@ -1185,6 +1230,12 @@ class StatisticsManager:
         # silent demotion would be
         if getattr(self.rt, "durability", "off") != "off":
             rep["durability"] = self.rt.durability_report()
+        # replication (core/replication.py): role, peer, lag, shipped/
+        # applied volume, fencing rejections — present once the app has
+        # a coordinator (annotated, or a standby subscribed)
+        coord = getattr(self.rt, "replication", None)
+        if coord is not None:
+            rep["replication"] = coord.metrics()
         # frame tracing (core/tracing.py): sampling/ring/trigger gauges.
         # ALWAYS present when the tracer exists (not gated on `enabled`)
         # — a triggered dump must be discoverable from any scrape

@@ -82,12 +82,45 @@ VERSION = 1
 FRAME = 1
 HEADER = struct.Struct("<HBBII")        # magic, version, type, len, crc
 SEG_RE = re.compile(r"^wal-(\d{8})\.seg$")
+GEN_FILE = "generation"                 # replication fencing token
 
 POLICIES = ("off", "batch", "fsync")
 
 
 class WalError(Exception):
     """A WAL append/scan failure that must not be silently swallowed."""
+
+
+def read_generation(directory: str) -> int:
+    """The log directory's replication fencing token (0 = never
+    fenced).  A promoted standby writes a HIGHER generation; remote
+    appends stamped with an older one are rejected loudly."""
+    try:
+        with open(os.path.join(directory, GEN_FILE), "r") as f:
+            return int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return 0
+
+
+def write_generation(directory: str, generation: int) -> None:
+    """Persist the fencing token durably (atomic publish + fsync): a
+    promote that crashed mid-write must not resurrect the deposed
+    generation."""
+    path = os.path.join(directory, GEN_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(int(generation)))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    try:
+        dfd = os.open(directory, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:                     # platform without dir fsync
+        pass
 
 
 def _string_delta(codes: np.ndarray, strings) -> dict:
@@ -335,6 +368,73 @@ class WriteAheadLog:
                 self._rotate_locked()
             return seq
 
+    def append_raw(self, record: bytes) -> tuple:
+        """Append one REPLICATED record verbatim (already framed and
+        CRC'd by the primary — the standby's log stays byte-identical).
+        Returns (stream, seq, applied): seq at-or-below the current
+        counter is an idempotent re-ship (applied=False, e.g. a
+        reconnect re-sending from the last ack); seq exactly current+1
+        appends; anything further ahead is a replication GAP — the
+        shipper must catch the standby up through a snapshot first —
+        and raises WalError loudly."""
+        rec = self._parse_record(record, 0)
+        if rec is None or rec[3] != len(record):
+            raise WalError("corrupt replicated record (CRC/framing)")
+        stream, seq, body, _end = rec
+        with self._lock:
+            cur = self.seqs.get(stream, 0)
+            if seq <= cur:
+                return stream, seq, False
+            if seq != cur + 1:
+                raise WalError(
+                    f"replication gap on stream {stream!r}: got seq "
+                    f"{seq}, expected {cur + 1} (snapshot catch-up "
+                    f"required)")
+            base = self._seg_len
+            try:
+                self._f.write(record)
+                self._f.flush()
+                if self.policy == "fsync":
+                    self._fsync_locked()
+                else:
+                    self._unsynced = True
+            except BaseException:
+                try:
+                    self._f.truncate(base)
+                    self._f.flush()
+                except OSError:
+                    pass
+                raise
+            self.seqs[stream] = seq
+            self._seg_max[stream] = seq
+            self._seg_len += len(record)
+            self.appended_frames += 1
+            self.appended_bytes += len(record)
+            try:
+                self.appended_events += int(
+                    np.asarray(pickle.loads(body)["ts"]).shape[0])
+            except Exception:
+                pass                    # counters only; the bytes landed
+            if self._seg_len >= self.segment_bytes:
+                self._rotate_locked()
+            return stream, seq, True
+
+    # -- replication fencing -------------------------------------------------
+
+    def generation(self) -> int:
+        """This log's persisted fencing token (see read_generation)."""
+        return read_generation(self.dir)
+
+    def fence(self, minimum: int = 0) -> int:
+        """Bump the fencing token past both the local value and
+        `minimum` (the highest generation seen from a peer) and persist
+        it durably.  Returns the new generation — every replicated
+        record the deposed generation ships after this is rejected."""
+        with self._lock:
+            gen = max(self.generation(), int(minimum)) + 1
+            write_generation(self.dir, gen)
+            return gen
+
     def _fsync_locked(self) -> None:
         self.inject("wal.fsync", "")
         t0 = time.perf_counter()
@@ -477,6 +577,14 @@ class WriteAheadLog:
                     cols[name] = _apply_string_delta(cols[name], delta)
                 yield stream, seq, rd["ts"], cols
 
+    # -- tailing (replication) -----------------------------------------------
+
+    def tail(self, watermark: Optional[dict] = None) -> "WalTail":
+        """A shipper's cursor over this log: raw records strictly after
+        the per-stream `watermark`, in append order.  See WalTail for
+        the gap/scar semantics."""
+        return WalTail(self, watermark)
+
     # -- lifecycle / telemetry -----------------------------------------------
 
     def close(self) -> None:
@@ -509,3 +617,145 @@ class WriteAheadLog:
                         fs[f"p{p}_ms"] = round(v * 1e3, 4)
                 m["fsync"] = fs
             return m
+
+
+class WalTail:
+    """A replication cursor over a LIVE WriteAheadLog.  The shipper
+    polls it for raw records strictly after a per-stream watermark,
+    reading segment files directly (appends flush a complete record
+    before releasing the lock, so a half-visible record parses as None
+    and is simply retried — the tail never takes the append lock for
+    file I/O).
+
+    Semantics, in order of precedence per record:
+
+    * seq < expected  -> already shipped (or covered by a snapshot the
+      standby restored): consumed silently.
+    * seq == expected -> emitted; the cursor advances.
+    * seq >  expected -> a GAP: snapshot-barrier truncation deleted
+      records the standby still needed.  `poll` reports gap=True
+      WITHOUT consuming the record — the shipper ships a Revision,
+      calls `advance_to(snapshot_watermark)`, and re-polls from the
+      same position.
+    * torn / CRC-scarred record -> the tail WAITS at the scar forever
+      (an in-flight append completes it; a sealed scar is the heal
+      boundary and nothing past it may ever ship — replay could not
+      apply it either).
+    * missing segment file below the open one -> gap=True (truncated
+      beneath the cursor)."""
+
+    def __init__(self, wal: WriteAheadLog, watermark: Optional[dict]):
+        self.wal = wal
+        self._next = {str(s): int(v) + 1
+                      for s, v in (watermark or {}).items()}
+        self._seg: Optional[int] = None  # segment under the cursor
+        self._off = 0                    # byte offset within it
+        self.emitted_records = 0
+        self.emitted_bytes = 0
+
+    def position(self) -> dict:
+        """Per-stream seq of the last record emitted (the shipped
+        watermark)."""
+        return {s: v - 1 for s, v in self._next.items() if v > 1}
+
+    def advance_to(self, watermark: Optional[dict]) -> None:
+        """Raise the cursor's expectations to a shipped snapshot's
+        watermark — records at-or-below it are now covered and will be
+        skipped, closing the gap that triggered the catch-up."""
+        for s, v in (watermark or {}).items():
+            if int(v) + 1 > self._next.get(str(s), 1):
+                self._next[str(s)] = int(v) + 1
+
+    def _sealed_done(self, maxima: dict) -> bool:
+        """True when a sealed segment's every frame is below the
+        cursor's expectations (skip it without reading the file)."""
+        return bool(maxima) and all(
+            s < self._next.get(sid, 1) for sid, s in maxima.items())
+
+    def poll(self, max_records: int = 256) -> tuple:
+        """-> (records, gap): up to `max_records` of
+        (stream, seq, raw_record_bytes) ready to ship, plus whether the
+        cursor hit a truncation gap (ship a snapshot, `advance_to`,
+        re-poll).  Empty records + gap=False means caught up (or
+        parked at a scar/in-flight record)."""
+        records: list = []
+        while len(records) < max_records:
+            with self.wal._lock:
+                open_seg = self.wal._seg_no
+                sealed = dict(self.wal._sealed)
+                # snapshot BEFORE reading files: a seq present here is
+                # already flushed (append updates seqs after the write,
+                # under the lock), so any of these still missing after
+                # a clean read-to-EOF was truncated, not in flight
+                seqs = dict(self.wal.seqs)
+            if self._seg is None:
+                segs = self.wal._segments()
+                if not segs:
+                    return records, False
+                self._seg = segs[0]
+                self._off = 0
+            if self._off == 0 and self._seg in sealed \
+                    and self._sealed_done(sealed[self._seg]):
+                if not self._advance_segment(open_seg):
+                    return records, False
+                continue
+            try:
+                with open(self.wal._seg_path(self._seg), "rb") as f:
+                    if self._off:
+                        f.seek(self._off)
+                    data = f.read()
+            except OSError:
+                if self._seg < open_seg:
+                    return records, True    # truncated beneath the tail
+                return records, False
+            off = 0
+            gap = False
+            while len(records) < max_records:
+                rec = WriteAheadLog._parse_record(data, off)
+                if rec is None:
+                    break
+                stream, seq, _body, end = rec
+                exp = self._next.get(stream, 1)
+                if seq > exp:
+                    gap = True              # do NOT consume the record
+                    break
+                if seq == exp:
+                    raw = bytes(data[off:end])
+                    records.append((stream, seq, raw))
+                    self._next[stream] = seq + 1
+                    self.emitted_records += 1
+                    self.emitted_bytes += len(raw)
+                self._off += end - off
+                off = end
+            if gap:
+                return records, True
+            if len(records) >= max_records:
+                return records, False
+            if off != len(data):
+                # torn tail (in-flight append) or a sealed scar: wait —
+                # nothing past a scar may ever ship
+                return records, False
+            # clean EOF: follow into the next segment, or report
+            # caught-up on the open one — unless the log's own counters
+            # say records we still owe existed and are GONE (truncation
+            # emptied the log entirely, e.g. a fresh subscriber after a
+            # snapshot barrier): that is a gap too, even with no record
+            # left to reveal it
+            if self._seg >= open_seg:
+                if any(v >= self._next.get(s, 1)
+                       for s, v in seqs.items()):
+                    return records, True
+                return records, False
+            if not self._advance_segment(open_seg):
+                return records, False
+        return records, False
+
+    def _advance_segment(self, open_seg: int) -> bool:
+        """Move the cursor to the next existing segment; False when
+        there is nowhere to go yet."""
+        segs = [n for n in self.wal._segments() if n > self._seg]
+        if not segs:
+            return False
+        self._seg = segs[0]
+        self._off = 0
+        return True

@@ -56,6 +56,35 @@ Frame types and payloads:
         the downstream root's `remote_parent` annotation (span ids
         are host-local).  Receivers that do not trace consume it.
 
+REPL frame family — hot-standby WAL replication (docs/RELIABILITY.md
+"High availability & failover").  A standby connects to the primary's
+frame port and sends REPL_SUBSCRIBE; the connection then becomes a
+replication link: the primary streams WAL records (and snapshot
+revisions for catch-up) down it, the standby streams append-acks back.
+
+    REPL_SUBSCRIBE (11), standby->primary, JSON: {"app": name,
+        "watermark": {stream: seq}, "generation": int} — subscribe to
+        the app's WAL from the standby's durable per-stream watermark.
+        `generation` is the highest fencing token the standby has
+        seen (0 on a fresh log).
+    REPL_RECORD (12), primary->standby: u64 generation, then one raw
+        WAL record (wal.py layout, its own CRC) verbatim — the
+        standby appends it byte-identically at its explicit seq.
+    REPL_SNAPSHOT (13), primary->standby: u64 generation, u32 meta
+        length, meta JSON {"revision", "watermark": {...}|null,
+        "final": bool}, then the revision blob — Revision shipping
+        for catch-up when the standby's watermark is behind a
+        snapshot-barrier truncation.  A chain ships oldest-first;
+        only the `final` frame's watermark floors the standby's seqs.
+    REPL_HEARTBEAT (14), primary->standby, JSON: {"generation",
+        "watermark": {stream: seq}, "ts_ms"} — periodic watermark so
+        the standby can compute replication lag while idle.
+    REPL_ACK (15), standby->primary, JSON: {"generation",
+        "watermark": {stream: seq}} — everything at-or-below the
+        watermark is appended (and, per the standby's sync policy,
+        synced) on the standby.  Under semi-sync this is half of the
+        primary's durable-ACK barrier.
+
 docs/SERVING.md carries the normative spec with a worked hex example.
 """
 from __future__ import annotations
@@ -83,10 +112,18 @@ ERROR = 7
 PING = 8
 BYE = 9
 TRACE = 10
+REPL_SUBSCRIBE = 11
+REPL_RECORD = 12
+REPL_SNAPSHOT = 13
+REPL_HEARTBEAT = 14
+REPL_ACK = 15
 
 _TYPE_NAMES = {HELLO: "HELLO", HELLO_OK: "HELLO_OK", DATA: "DATA",
                STRINGS: "STRINGS", CREDIT: "CREDIT", ACK: "ACK",
-               ERROR: "ERROR", PING: "PING", BYE: "BYE", TRACE: "TRACE"}
+               ERROR: "ERROR", PING: "PING", BYE: "BYE", TRACE: "TRACE",
+               REPL_SUBSCRIBE: "REPL_SUBSCRIBE", REPL_RECORD: "REPL_RECORD",
+               REPL_SNAPSHOT: "REPL_SNAPSHOT",
+               REPL_HEARTBEAT: "REPL_HEARTBEAT", REPL_ACK: "REPL_ACK"}
 
 
 class FrameError(Exception):
@@ -162,6 +199,101 @@ def decode_trace(payload: bytes) -> tuple:
         return str(d["trace"]), int(d.get("span", 0) or 0)
     except (ValueError, TypeError, UnicodeDecodeError) as e:
         raise FrameError(f"bad TRACE payload: {e}") from None
+
+
+# -- REPL family (hot-standby WAL replication) ------------------------------
+
+def _watermark_dict(wm) -> dict:
+    return {str(k): int(v) for k, v in (wm or {}).items()}
+
+
+def encode_repl_subscribe(app: str, watermark: dict,
+                          generation: int = 0) -> bytes:
+    return encode_frame(REPL_SUBSCRIBE, json.dumps(
+        {"app": str(app), "watermark": _watermark_dict(watermark),
+         "generation": int(generation)}).encode())
+
+
+def decode_repl_subscribe(payload: bytes) -> dict:
+    try:
+        d = json.loads(payload)
+        if not isinstance(d, dict) or not d.get("app"):
+            raise ValueError("missing app")
+        d["watermark"] = _watermark_dict(d.get("watermark"))
+        d["generation"] = int(d.get("generation", 0) or 0)
+        return d
+    except (ValueError, TypeError, UnicodeDecodeError) as e:
+        raise FrameError(f"bad REPL_SUBSCRIBE payload: {e}") from None
+
+
+def encode_repl_record(generation: int, record: bytes) -> bytes:
+    """`record` is one raw WAL record (wal.py layout, self-CRC'd) —
+    shipped verbatim so the standby's log is byte-identical."""
+    return encode_frame(REPL_RECORD,
+                        struct.pack("<Q", int(generation)) + record)
+
+
+def decode_repl_record(payload: bytes) -> tuple:
+    """-> (generation, raw_record_bytes)."""
+    if len(payload) < 8:
+        raise FrameError("truncated REPL_RECORD payload")
+    (gen,) = struct.unpack_from("<Q", payload, 0)
+    return gen, payload[8:]
+
+
+def encode_repl_snapshot(generation: int, revision: str, watermark,
+                         blob: bytes, final: bool = True) -> bytes:
+    meta = json.dumps({"revision": str(revision),
+                       "watermark": None if watermark is None
+                       else _watermark_dict(watermark),
+                       "final": bool(final)}).encode()
+    return encode_frame(REPL_SNAPSHOT,
+                        struct.pack("<QI", int(generation), len(meta))
+                        + meta + blob)
+
+
+def decode_repl_snapshot(payload: bytes) -> tuple:
+    """-> (generation, meta_dict, blob_bytes)."""
+    if len(payload) < 12:
+        raise FrameError("truncated REPL_SNAPSHOT payload")
+    gen, mlen = struct.unpack_from("<QI", payload, 0)
+    if 12 + mlen > len(payload):
+        raise FrameError("truncated REPL_SNAPSHOT meta")
+    try:
+        meta = json.loads(payload[12:12 + mlen])
+        if not isinstance(meta, dict) or not meta.get("revision"):
+            raise ValueError("missing revision")
+    except (ValueError, UnicodeDecodeError) as e:
+        raise FrameError(f"bad REPL_SNAPSHOT meta: {e}") from None
+    return gen, meta, payload[12 + mlen:]
+
+
+def encode_repl_heartbeat(generation: int, watermark: dict,
+                          ts_ms: int) -> bytes:
+    return encode_frame(REPL_HEARTBEAT, json.dumps(
+        {"generation": int(generation),
+         "watermark": _watermark_dict(watermark),
+         "ts_ms": int(ts_ms)}).encode())
+
+
+def encode_repl_ack(generation: int, watermark: dict) -> bytes:
+    return encode_frame(REPL_ACK, json.dumps(
+        {"generation": int(generation),
+         "watermark": _watermark_dict(watermark)}).encode())
+
+
+def decode_repl_status(payload: bytes) -> dict:
+    """Shared decoder for REPL_HEARTBEAT and REPL_ACK (both are a
+    {generation, watermark[, ts_ms]} JSON object)."""
+    try:
+        d = json.loads(payload)
+        if not isinstance(d, dict):
+            raise ValueError("not an object")
+        d["generation"] = int(d.get("generation", 0) or 0)
+        d["watermark"] = _watermark_dict(d.get("watermark"))
+        return d
+    except (ValueError, TypeError, UnicodeDecodeError) as e:
+        raise FrameError(f"bad REPL status payload: {e}") from None
 
 
 def encode_strings(new_strings: list, start_code: int = None) -> bytes:

@@ -1,0 +1,396 @@
+"""WAL replication over the frame protocol: the primary-side shipper
+and the standby-side receiver (core/replication.py holds the shared
+brain; docs/RELIABILITY.md "High availability & failover" the contract).
+
+A replication link is an ordinary frame connection that a standby
+flips with REPL_SUBSCRIBE: from then on the primary's `WalShipper`
+(its own thread, sharing the connection's write lock with the serve
+loop) streams raw WAL records down it — byte-identical, so the
+standby's log equals the primary's — and the standby's `WalReceiver`
+streams append-acks back.  When the standby's watermark has fallen
+behind a snapshot-barrier truncation, the shipper detects the gap
+(WalTail.poll) and ships the persistence store's catch-up chain as
+REPL_SNAPSHOT frames before resuming the record stream.
+
+Fencing: every shipped frame is stamped with the primary's generation
+(core/wal.py read_generation).  A promoted standby fences ABOVE the
+highest generation it saw, so a deposed primary that comes back and
+keeps shipping is rejected LOUDLY — the receiver captures to the
+ErrorStore, counts `rejected_generation`, answers with an ERROR frame,
+and drops the link (the split-brain chaos cell in bench.py pins this).
+
+Failure handling rides the existing machinery: the receiver reconnects
+under a BackoffPolicy behind a CircuitBreaker, and every non-clean
+session end is captured to the standby's ErrorStore ('repl.receive').
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import threading
+import time
+from typing import Callable, Optional
+
+from ..core.faults import BackoffPolicy, CircuitBreaker
+from ..core.persistence import _rev_time
+from ..utils.locks import new_lock
+from . import frame as fp
+
+
+class ReplProtocolError(Exception):
+    """Replication-level protocol violation (fencing, bad subscribe)."""
+
+
+def catchup_revisions(store, app: str) -> list:
+    """[(revision_id, blob, watermark|None)] the standby needs to
+    reach the store's newest restorable state, oldest first — the same
+    selection runtime.restore_last_state makes: the newest loadable
+    full ('F-' or plain) plus every later 'I-' delta.  The watermark is
+    each blob's own embedded per-stream WAL seq map."""
+    if store is None or not hasattr(store, "revisions"):
+        return []
+    revs = store.revisions(app)
+    fulls = [r for r in revs if not r.startswith("I-")]
+    if not fulls:
+        return []
+    base = fulls[-1]
+    chain = [base] + [r for r in revs
+                      if r.startswith("I-") and _rev_time(r) > _rev_time(base)]
+    out = []
+    for rev in chain:
+        try:
+            blob = store.load(app, rev)
+            body = pickle.loads(blob)
+        except Exception:
+            continue                    # corrupt: restore would skip it too
+        wm = body.get("snapshot", {}).get("wal") \
+            if isinstance(body, dict) and "table_deltas" in body \
+            else (body.get("wal") if isinstance(body, dict) else None)
+        out.append((rev, blob, wm))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# primary side
+# ---------------------------------------------------------------------------
+
+class WalShipper:
+    """Streams one app's WAL down one replication link.  Runs on its
+    own thread (the connection's serve loop keeps reading REPL_ACKs
+    concurrently); `write` must already be serialized against the serve
+    loop's replies by the connection's write lock."""
+
+    POLL_RECORDS = 256
+    IDLE_S = 0.02
+
+    def __init__(self, rt, coord, write: Callable[[bytes], None],
+                 subscribe: dict, stop: Callable[[], bool]):
+        self.rt = rt
+        self.coord = coord
+        self.write = write
+        self.stop = stop
+        self.watermark = dict(subscribe.get("watermark") or {})
+        self.standby_generation = int(subscribe.get("generation", 0))
+        self._thread: Optional[threading.Thread] = None
+        self._done = threading.Event()
+        self.error: Optional[BaseException] = None
+
+    def start(self) -> "WalShipper":
+        self._thread = threading.Thread(
+            target=self._run, name="siddhi-repl-ship", daemon=True)
+        self._thread.start()
+        return self
+
+    def join(self, timeout: float = 5.0) -> None:
+        self._done.wait(timeout)
+
+    def _run(self) -> None:
+        self.coord.standby_attached()
+        try:
+            self._ship()
+        except BaseException as e:      # surfaced on the connection
+            self.error = e
+            if not self.stop():
+                try:
+                    self.write(fp.encode_error(f"replication: {e}"))
+                except OSError:
+                    pass
+        finally:
+            self.coord.standby_detached()
+            self._done.set()
+
+    def _ship(self) -> None:
+        rt, coord = self.rt, self.coord
+        wal = getattr(rt, "wal", None)
+        if wal is None:
+            raise ReplProtocolError(
+                f"app {rt.app.name!r} has no live WAL to replicate "
+                f"(@app:durability required)")
+        generation = wal.generation()
+        if self.standby_generation > generation:
+            # the subscriber has seen a NEWER primary: we are deposed —
+            # refuse to serve rather than feed a stale timeline
+            coord.rejected_generation += 1
+            raise ReplProtocolError(
+                f"fenced: subscriber at generation "
+                f"{self.standby_generation} > ours ({generation}) — "
+                f"this node was deposed")
+        tail = wal.tail(self.watermark)
+        hb_interval = coord.config.heartbeat_s
+        last_hb = 0.0
+        while not self.stop():
+            records, gap = tail.poll(self.POLL_RECORDS)
+            if records:
+                nbytes = 0
+                for stream, _seq, raw in records:
+                    rt.inject("repl.ship", stream)
+                    self.write(fp.encode_repl_record(generation, raw))
+                    nbytes += len(raw)
+                coord.note_shipped(len(records), nbytes)
+            if gap:
+                self._ship_catchup(tail, generation)
+                continue
+            coord.note_local(wal.watermark())
+            now = time.monotonic()
+            if now - last_hb >= hb_interval:
+                last_hb = now
+                self.write(fp.encode_repl_heartbeat(
+                    generation, wal.watermark(), rt.now_ms()))
+            if not records:
+                # idle-poll, but wake instantly when a semi-sync barrier
+                # needs its record on the wire (coord.wait_ack sets this)
+                coord.ship_wake.wait(self.IDLE_S)
+                coord.ship_wake.clear()
+
+    def _ship_catchup(self, tail, generation: int) -> None:
+        """The standby fell behind a snapshot-barrier truncation: ship
+        the store's restore chain as REPL_SNAPSHOT frames, then advance
+        the tail to the chain's watermark and resume streaming."""
+        rt = self.rt
+        store = rt.manager.persistence_store if rt.manager else None
+        chain = catchup_revisions(store, rt.app.name)
+        if not chain:
+            raise ReplProtocolError(
+                f"replication gap on {rt.app.name!r} with no snapshot "
+                f"revision to catch up from (truncated WAL, empty "
+                f"store)")
+        final_wm = None
+        for rev, blob, wm in chain:
+            if wm is not None:
+                final_wm = wm
+        for i, (rev, blob, wm) in enumerate(chain):
+            rt.inject("repl.ship", f"snapshot:{rev}")
+            final = i == len(chain) - 1
+            self.write(fp.encode_repl_snapshot(
+                generation, rev, final_wm if final else None, blob,
+                final=final))
+        self.coord.shipped_snapshots += len(chain)
+        tail.advance_to(final_wm)
+
+
+# ---------------------------------------------------------------------------
+# standby side
+# ---------------------------------------------------------------------------
+
+class WalReceiver:
+    """Tails a primary's WAL into the standby's local log + store.
+    One daemon thread: connect (BackoffPolicy under a CircuitBreaker),
+    REPL_SUBSCRIBE from the local durable watermark, then apply frames
+    as they arrive — records via wal.append_raw (byte-identical),
+    snapshot revisions via store.save — acking each applied batch."""
+
+    ACK_EVERY_S = 0.2
+
+    def __init__(self, rt, coord, peer: str):
+        host, _, port = str(peer).rpartition(":")
+        if not host or not port.isdigit():
+            raise ReplProtocolError(
+                f"@app:replication peer {peer!r} is not 'host:port'")
+        self.rt = rt
+        self.coord = coord
+        self.host, self.port = host, int(port)
+        self._stop = threading.Event()
+        self._sock: Optional[socket.socket] = None
+        self._lock = new_lock("WalReceiver._lock")
+        self._thread = threading.Thread(
+            target=self._run, name="siddhi-repl-recv", daemon=True)
+        self.sessions = 0
+        self.last_error: Optional[str] = None
+
+    def start(self) -> "WalReceiver":
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        with self._lock:
+            sock = self._sock
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._thread.join(timeout)
+
+    # -- the tailing loop ----------------------------------------------------
+
+    def _run(self) -> None:
+        breaker = CircuitBreaker(failure_threshold=5, reset_timeout_s=1.0)
+        backoff = iter(())
+        while not self._stop.is_set():
+            if not breaker.allow():
+                self._stop.wait(0.1)
+                continue
+            try:
+                self._session()
+                breaker.on_success()
+                backoff = iter(())      # clean end: reset the schedule
+            except Exception as e:
+                breaker.on_failure()
+                if self._stop.is_set():
+                    return
+                self.last_error = f"{type(e).__name__}: {e}"
+                self.rt.error_store.add(
+                    "_replication", "repl.receive", e, self.rt.now_ms())
+                try:
+                    delay = next(backoff)
+                except StopIteration:
+                    backoff = iter(BackoffPolicy(
+                        max_tries=1 << 30, base_delay_s=0.05,
+                        max_delay_s=2.0).delays())
+                    delay = next(backoff)
+                self._stop.wait(delay)
+
+    def _session(self) -> None:
+        rt, coord = self.rt, self.coord
+        wal = rt.wal
+        if wal is None:
+            raise ReplProtocolError("standby has no open WAL")
+        sock = socket.create_connection((self.host, self.port), timeout=5.0)
+        try:
+            # the append-ack is the primary's semi-sync barrier: a
+            # Nagle-delayed ack frame stalls every producer barrier
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        with self._lock:
+            self._sock = sock
+        try:
+            sock.settimeout(0.2)
+            self.sessions += 1
+            known_gen = max(wal.generation(), coord.source_generation())
+            sock.sendall(fp.encode_repl_subscribe(
+                rt.app.name, wal.watermark(), known_gen))
+            buf = bytearray()
+            applied = 0
+            last_ack = time.monotonic()
+            while not self._stop.is_set():
+                frames = self._poll(sock, buf)
+                for ftype, payload in frames:
+                    if payload is None:     # CRC-rejected frame
+                        raise fp.FrameDesync(
+                            "checksum mismatch on replication link")
+                    applied += self._on_frame(ftype, payload, sock)
+                # ack as soon as a poll round applied anything: the
+                # primary's semi-sync barrier is blocked on exactly this
+                # (ACK_EVERY_S only throttles the idle re-ack cadence)
+                now = time.monotonic()
+                if applied or (now - last_ack >= self.ACK_EVERY_S
+                               and frames):
+                    self._ack(sock)
+                    applied = 0
+                    last_ack = now
+        finally:
+            with self._lock:
+                self._sock = None
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _poll(self, sock: socket.socket, buf: bytearray) -> list:
+        frames = fp.parse_buffer_inplace(buf)
+        if frames:
+            return frames
+        try:
+            b = sock.recv(1 << 16)
+        except socket.timeout:
+            return []
+        if not b:
+            raise EOFError("replication link closed by primary")
+        buf += b
+        return fp.parse_buffer_inplace(buf)
+
+    def _check_generation(self, gen: int) -> None:
+        """Fencing: a frame stamped below OUR generation comes from a
+        deposed primary — reject it loudly and kill the link."""
+        coord, rt = self.coord, self.rt
+        local = max(rt.wal.generation(), coord.source_generation())
+        if gen < local:
+            coord.rejected_generation += 1
+            err = ReplProtocolError(
+                f"fenced: record from deposed primary generation {gen} "
+                f"< local {local} — rejected")
+            rt.error_store.add("_replication", "repl.fence", err,
+                               rt.now_ms())
+            raise err
+        coord.note_generation(gen)
+
+    def _on_frame(self, ftype: int, payload: bytes,
+                  sock: socket.socket) -> int:
+        """-> number of applied records/snapshots (0 for control)."""
+        rt, coord = self.rt, self.coord
+        if ftype == fp.REPL_RECORD:
+            gen, raw = fp.decode_repl_record(payload)
+            self._check_generation(gen)
+            stream, seq, applied = rt.wal.append_raw(raw)
+            if applied:
+                coord.note_applied(stream, seq, len(raw))
+            return 1
+        if ftype == fp.REPL_SNAPSHOT:
+            gen, meta, blob = fp.decode_repl_snapshot(payload)
+            self._check_generation(gen)
+            store = rt.manager.persistence_store if rt.manager else None
+            if store is None:
+                raise ReplProtocolError(
+                    "snapshot catch-up needs a persistence store on "
+                    "the standby")
+            store.save(rt.app.name, meta["revision"], blob)
+            if meta.get("final"):
+                wm = meta.get("watermark")
+                coord.note_snapshot(wm)
+                if wm:
+                    # the shipped chain covers everything at-or-below
+                    # its watermark: records resume strictly after it
+                    rt.wal.floor_seqs(wm)
+            else:
+                coord.note_snapshot(None)
+            return 1
+        if ftype == fp.REPL_HEARTBEAT:
+            st = fp.decode_repl_status(payload)
+            self._check_generation(st["generation"])
+            # answer immediately: heartbeats double as the semi-sync
+            # liveness probe, and an ack carrying our unchanged
+            # watermark is how the primary measures lag, not progress
+            self._ack(sock)
+            return 0
+        if ftype == fp.ERROR:
+            try:
+                import json
+                msg = json.loads(payload).get("error", "")
+            except Exception:
+                msg = payload.decode("utf-8", "replace")
+            raise ReplProtocolError(f"primary rejected the link: {msg}")
+        raise fp.FrameError(
+            f"unexpected {fp.type_name(ftype)} frame on replication "
+            f"link")
+
+    def _ack(self, sock: socket.socket) -> None:
+        rt, coord = self.rt, self.coord
+        rt.inject("repl.ack", rt.app.name)
+        gen = max(rt.wal.generation(), coord.source_generation())
+        sock.sendall(fp.encode_repl_ack(gen, rt.wal.watermark()))

@@ -206,6 +206,14 @@ class Connection:
         self.server = server
         self.label = label
         self.send = send                # None: no backchannel (ring)
+        # replication link state: once REPL_SUBSCRIBE arrives the
+        # connection is repl-dedicated — a WalShipper thread writes
+        # records down it while the serve loop keeps reading acks, so
+        # every write goes through _wlock
+        self._wlock = new_lock("Connection._wlock")
+        self.closed = False
+        self._shipper = None
+        self._repl_coord = None
         self.rt = None
         self.stream_id: Optional[str] = None
         self.schema = None
@@ -229,6 +237,12 @@ class Connection:
             return False
         if ftype == fp.HELLO:
             self._on_hello(fp.decode_hello(payload))
+            return True
+        if ftype == fp.REPL_SUBSCRIBE:
+            self._on_repl_subscribe(fp.decode_repl_subscribe(payload))
+            return True
+        if ftype in (fp.REPL_ACK, fp.REPL_HEARTBEAT):
+            self._on_repl_status(fp.decode_repl_status(payload), ftype)
             return True
         if self.rt is None:
             raise fp.FrameError(
@@ -280,6 +294,20 @@ class Connection:
                     # reconnects and retransmits from its last ACK.
                     raise fp.FrameDesync(
                         f"durability barrier failed: {e}") from e
+                # semi-sync replication moves the durable-ACK barrier
+                # to "local fsync + standby append-ack": the producer's
+                # retransmit buffer may only be discarded once the
+                # frames exist on BOTH machines.  A timeout (or no
+                # standby, unless degrade='async') fails the barrier —
+                # lying here would turn machine loss into silent loss.
+                coord = getattr(self.rt, "replication", None)
+                if coord is not None and coord.config.mode == "semi-sync" \
+                        and coord.role == "primary":
+                    if not coord.wait_ack(wal.watermark()):
+                        raise fp.FrameDesync(
+                            f"semi-sync barrier: no standby append-ack "
+                            f"within {coord.config.ack_timeout_s}s "
+                            f"({coord.standbys()} standby(s) attached)")
             self._reply(fp.encode_ack(token))
             return True
         raise fp.FrameError(
@@ -314,6 +342,54 @@ class Connection:
         self.credit_chunk = self.server.credit if hello.get("credit") else 0
         self._reply(fp.encode_hello_ok(self.credit_chunk))
 
+    # -- replication link (net/repl.py WalShipper) ---------------------------
+
+    def _on_repl_subscribe(self, sub: dict) -> None:
+        if self.send is None:
+            raise fp.FrameError(
+                "replication needs a duplex transport (not a ring)")
+        if self._shipper is not None:
+            raise fp.FrameError(
+                f"duplicate REPL_SUBSCRIBE on {self.label}")
+        try:
+            rt = self.server.repl_resolve(sub["app"])
+        except KeyError as e:
+            raise fp.FrameError(str(e).strip("'\"")) from None
+        if getattr(rt, "is_standby", lambda: False)():
+            raise fp.FrameError(
+                f"app {sub['app']!r} is itself a standby replica — "
+                f"subscribe to the primary")
+        coord = rt._ensure_replication(default=True)
+        if coord is None or getattr(rt, "wal", None) is None:
+            raise fp.FrameError(
+                f"app {sub['app']!r} has no live WAL to replicate "
+                f"(@app:durability required)")
+        from .repl import WalShipper
+        self.rt = rt                    # repl-dedicated binding
+        self._repl_coord = coord
+        self._shipper = WalShipper(
+            rt, coord, self._reply, sub,
+            stop=lambda: self.server.stopping() or self.closed).start()
+
+    def _on_repl_status(self, status: dict, ftype: int) -> None:
+        coord = self._repl_coord
+        if coord is None:
+            raise fp.FrameError(
+                f"{fp.type_name(ftype)} before REPL_SUBSCRIBE on "
+                f"{self.label}")
+        wal = getattr(self.rt, "wal", None)
+        if wal is not None and status["generation"] > wal.generation():
+            # the standby has been promoted past us: we are deposed —
+            # fatal, and every later local append is suspect
+            coord.rejected_generation += 1
+            raise fp.FrameDesync(
+                f"fenced: standby at generation {status['generation']} "
+                f"> ours ({wal.generation()}) — this node was deposed")
+        if ftype == fp.REPL_ACK:
+            coord.on_ack(status["watermark"])
+        else:
+            coord.on_heartbeat(status["watermark"])
+
     def _on_data(self, payload: bytes) -> None:
         rt = self.rt
         try:
@@ -328,8 +404,8 @@ class Connection:
         for name in self._str_cols:     # one gather per string column
             cols[name] = self.remap.apply(cols[name])
         n = int(ts.shape[0])
-        self.frames += 1
-        self.events += n
+        self.frames += 1  # lint: unlocked-ok (single serve-thread writer; _wlock only serializes wire writes)
+        self.events += n  # lint: unlocked-ok (single serve-thread writer; _wlock only serializes wire writes)
         # frame tracing: a producer-stamped id (TRACE frame) always
         # traces; otherwise the runtime tracer makes the sampling call.
         # The handle rides the Work so a parked ('oldest') frame fed
@@ -377,15 +453,18 @@ class Connection:
         # durability signal producers must trust for retransmit.
         if self.send is None or not self.credit_chunk:
             return
-        self._since_credit += 1
+        self._since_credit += 1  # lint: unlocked-ok (single serve-thread writer; _wlock only serializes wire writes)
         if self._since_credit >= max(1, self.credit_chunk // 2):
             self._reply(fp.encode_credit(self._since_credit))
             self.server._count(credit_granted=self._since_credit)
             self._since_credit = 0
 
     def _reply(self, data: bytes) -> None:
+        # locked: on a replication link the WalShipper thread and the
+        # serve loop both write to the same wire
         if self.send is not None:
-            self.send(data)
+            with self._wlock:
+                self.send(data)
 
 
 # ---------------------------------------------------------------------------
@@ -399,11 +478,16 @@ class NetServer:
 
     def __init__(self, resolve_fn: Callable, host: str = "127.0.0.1",
                  port: int = 0, credit: int = 64, name: str = "siddhi-net",
-                 listen: bool = True):
+                 listen: bool = True,
+                 repl_resolve: Optional[Callable] = None):
         """`listen=False` builds a listener-less server — no TCP socket
         at all — for transports that only need the connection/feed-gate
-        machinery (shm-ring consumers via attach_ring)."""
+        machinery (shm-ring consumers via attach_ring).  `repl_resolve`
+        maps an app name to its runtime for REPL_SUBSCRIBE links
+        (raising KeyError rejects the subscription); None disables
+        replication on this front door."""
         self._resolve = resolve_fn
+        self._repl_resolve = repl_resolve
         self.credit = int(credit)
         self.name = name
         self._sock = None
@@ -439,6 +523,13 @@ class NetServer:
 
     def resolve(self, app: Optional[str], stream: str):
         return self._resolve(app, stream)
+
+    def repl_resolve(self, app: str):
+        if self._repl_resolve is None:
+            raise KeyError(
+                f"replication is not enabled on this endpoint "
+                f"(no repl_resolve for app {app!r})")
+        return self._repl_resolve(app)
 
     def stopping(self) -> bool:
         return self._stop.is_set()
@@ -584,6 +675,13 @@ class NetServer:
                 continue                # poll the stop flag
             except OSError:
                 return                  # listener closed
+            try:
+                # barrier-critical small frames (durable ACKs, the
+                # semi-sync replication handshake) must not sit out a
+                # Nagle/delayed-ACK round trip
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
             t = threading.Thread(
                 target=self._serve_conn, args=(sock, addr),
                 name=f"{self.name}-conn", daemon=True)
@@ -668,6 +766,9 @@ class NetServer:
             self._count(protocol_errors=1)
         finally:
             if conn is not None:
+                conn.closed = True      # stops a WalShipper on this link
+                if conn._shipper is not None:
+                    conn._shipper.join(timeout=2.0)
                 try:
                     conn.pump()
                 except Exception:

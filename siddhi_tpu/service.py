@@ -222,6 +222,14 @@ class SiddhiService:
                         else:
                             self._reply(200, service.snapshot_action(
                                 app, bool(req.get("incremental"))))
+                    elif path == "/siddhi/artifact/promote":
+                        req = json.loads(self._body() or b"{}")
+                        app = req.get("app")
+                        if app not in service.runtimes:
+                            self._reply(404, {"error":
+                                              f"no deployed app {app!r}"})
+                        else:
+                            self._reply(200, service.promote(app))
                     elif path == "/siddhi/artifact/query":
                         req = json.loads(self._body())
                         rows = service.store_query(req["app"], req["query"])
@@ -352,7 +360,8 @@ class SiddhiService:
         if net:
             from .net.server import NetServer
             self.net = NetServer(self._net_resolve, port=net_port,
-                                 name="siddhi-service-net")
+                                 name="siddhi-service-net",
+                                 repl_resolve=self._repl_resolve)
             self.net_port = self.net.port
 
     # -- data plane -------------------------------------------------------
@@ -361,6 +370,13 @@ class SiddhiService:
         rt = self.runtimes.get(app or "")
         if rt is None:
             raise KeyError(f"no deployed app {app!r}")
+        if rt.is_standby():
+            # a replica serves nothing: producers must talk to the
+            # primary (or promote this node first) — rejecting at HELLO
+            # keeps their retransmit buffers intact
+            raise KeyError(
+                f"app {app!r} is a standby replica — promote it or "
+                f"send to the primary")
         ctrl = rt.admission.get(stream)
         if ctrl is None:
             if stream not in rt.schemas:
@@ -375,6 +391,15 @@ class SiddhiService:
             ctrl = rt.admission.setdefault(
                 stream, controller_from_options(stream, {}, rt))
         return rt, ctrl
+
+    def _repl_resolve(self, app: str):
+        """REPL_SUBSCRIBE resolution for the data plane: the app's
+        runtime (the shipper-side checks — durability, standby role —
+        live in net/server.py)."""
+        rt = self.runtimes.get(app or "")
+        if rt is None:
+            raise KeyError(f"no deployed app {app!r}")
+        return rt
 
     def net_info(self) -> dict:
         if self.net is None:
@@ -433,7 +458,12 @@ class SiddhiService:
         # resumes exactly where the durable log ends, instead of
         # parking-only.  (The old runtime above shut down first, so
         # its final barrier landed before this replay scans the log.)
-        if rt.durability != "off":
+        cfg = getattr(rt, "replication_config", None)
+        if rt.durability != "off" and not (cfg is not None
+                                           and cfg.role == "standby"):
+            # standby replicas do NOT recover at deploy: their state
+            # materializes at promote() from the replicated log + the
+            # shipped revisions (rt.start() enters standby mode)
             rt.recover()
         rt.start()
         self.runtimes[name] = rt
@@ -672,6 +702,16 @@ class SiddhiService:
         rt = self.runtimes[app]
         return rt.persist(incremental=incremental).to_dict()
 
+    def promote(self, app: str) -> dict:
+        """POST /siddhi/artifact/promote: fail a standby replica over
+        to serving primary (rt.promote() — fence, recover to head,
+        start serving).  Serialized with deploy/undeploy: a promote
+        racing a redeploy of the same name must see one runtime."""
+        with self._ops_lock:
+            rt = self.runtimes[app]
+            # lint: allow (bounded recovery join under the ops lock by design)
+            return rt.promote()
+
     def snapshot_info(self, app: str) -> dict:
         """GET /siddhi/artifact/snapshot: the durability/recovery state
         of a deployed app — last revision descriptor (this process OR
@@ -689,7 +729,15 @@ class SiddhiService:
         if rt.wal is not None:
             out["wal"] = rt.wal.metrics()
         if getattr(rt, "_wal_recovery", None) is not None:
+            # the last recover() report (replayed/skipped/failed/
+            # corrupt/recovery_s): the post-failover audit trail —
+            # also mirrored in rt.explain()["durability"]["recovery"]
             out["recovery"] = rt._wal_recovery
+        if getattr(rt, "_promote_report", None) is not None:
+            out["promotion"] = rt._promote_report
+        coord = getattr(rt, "replication", None)
+        if coord is not None:
+            out["replication"] = coord.metrics()
         return out
 
     def trace(self, app: Optional[str] = None) -> dict:

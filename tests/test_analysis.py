@@ -123,6 +123,14 @@ FIXTURES = {
         define stream Out (v double);
         @info(name='q') from S[v > 0] select v insert into Out;
     """,
+    "SA14": """
+        @app:durability('batch', dir='/tmp/wal')
+        @app:replication('semi-sync', peer='127.0.0.1:7071')
+        @source(type='tcp', port='0')
+        define stream S (v double);
+        define stream Out (v double);
+        @info(name='q') from S[v > 0] select v insert into Out;
+    """,
 }
 
 CLEAN = [

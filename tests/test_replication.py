@@ -1,0 +1,645 @@
+"""Hot-standby WAL replication (core/replication.py + net/repl.py +
+core/wal.py tail/fencing): REPL frame round-trips, the tail's
+rotation/truncation/scar edge cases, verbatim standby appends, the
+semi-sync durable-ACK barrier, generation fencing, and the in-process
+failover path (standby converges -> promote() -> byte-identical log +
+replayed outputs)."""
+import glob
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.persistence import FileSystemPersistenceStore
+from siddhi_tpu.core.replication import (ReplicationConfig,
+                                         ReplicationCoordinator,
+                                         ReplicationError)
+from siddhi_tpu.core.wal import (WalError, WriteAheadLog,
+                                 read_generation, write_generation)
+from siddhi_tpu.net import frame as fp
+from siddhi_tpu.net.client import NetClientError, TcpFrameClient
+from siddhi_tpu.net.repl import ReplProtocolError, WalReceiver
+from siddhi_tpu.net.server import NetServer
+
+# transport/replication semantics are backend-independent: host-only
+# apps skip every jit compile (same budget rationale as test_net_server)
+HOST = ("@app:deviceFilters('never')\n@app:devicePatterns('never')\n"
+        "@app:deviceWindows('never')\n")
+BODY = """
+define stream S (sym string, p double);
+define table T (sym string, p double);
+@info(name='ins') from S select sym, p insert into T;
+@info(name='out') from S[p > 110.0] select sym, p insert into Out;
+"""
+
+
+def table_rows(rt, name="T"):
+    return sorted(map(tuple, rt.tables[name].all_rows()))
+
+
+def frames(n_frames=5, batch=16, seed=7):
+    rng = np.random.default_rng(seed)
+    ts0 = 1_700_000_000_000
+    return [({"sym": np.array([f"K{i}" for i in
+                               rng.integers(0, 4, batch)]),
+              "p": np.round(rng.uniform(90, 130, batch), 2)},
+             ts0 + np.arange(k * batch, (k + 1) * batch, dtype=np.int64))
+            for k in range(n_frames)]
+
+
+def feed(rt, frs, stream="S"):
+    h = rt.input_handler(stream)
+    for cols, ts in frs:
+        h.send_batch(cols, ts)
+    rt.flush()
+
+
+def wal_append(wal, stream, seq_hint, n=4):
+    """Append one tiny frame; returns the assigned seq."""
+    ts = np.arange(n, dtype=np.int64) + 1_700_000_000_000 + seq_hint * n
+    cols = {"v": np.arange(n, dtype=np.float64) + seq_hint}
+    return wal.append(stream, ts, cols, strings=None)
+
+
+def drain(tail, max_polls=50):
+    """Poll until caught up; -> (records, saw_gap)."""
+    out, saw_gap = [], False
+    for _ in range(max_polls):
+        recs, gap = tail.poll()
+        out.extend(recs)
+        saw_gap = saw_gap or gap
+        if not recs:
+            break
+    return out, saw_gap
+
+
+def wait_for(pred, timeout_s=10.0, interval_s=0.01):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval_s)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# REPL frame round-trips
+# ---------------------------------------------------------------------------
+
+def test_repl_subscribe_roundtrip():
+    blob = fp.encode_repl_subscribe("HA", {"S": 12, "Q": 3}, 7)
+    frames_, = [fp.parse_buffer(bytes(blob))[0]]
+    (ftype, payload), = frames_
+    assert ftype == fp.REPL_SUBSCRIBE
+    sub = fp.decode_repl_subscribe(payload)
+    assert sub["app"] == "HA"
+    assert sub["watermark"] == {"S": 12, "Q": 3}
+    assert sub["generation"] == 7
+
+
+def test_repl_record_roundtrip():
+    raw = b"\x01\x02record-bytes\xff" * 3
+    blob = fp.encode_repl_record(5, raw)
+    (ftype, payload), = fp.parse_buffer(bytes(blob))[0]
+    assert ftype == fp.REPL_RECORD
+    gen, got = fp.decode_repl_record(payload)
+    assert gen == 5 and got == raw
+
+
+@pytest.mark.parametrize("final,wm", [(True, {"S": 9}), (False, None)])
+def test_repl_snapshot_roundtrip(final, wm):
+    blob = fp.encode_repl_snapshot(2, "F-HA-123", wm, b"\x00" * 64,
+                                   final=final)
+    (ftype, payload), = fp.parse_buffer(bytes(blob))[0]
+    assert ftype == fp.REPL_SNAPSHOT
+    gen, meta, body = fp.decode_repl_snapshot(payload)
+    assert gen == 2 and body == b"\x00" * 64
+    assert meta["revision"] == "F-HA-123"
+    assert bool(meta["final"]) == final
+    assert meta.get("watermark") == wm
+
+
+def test_repl_status_frames_roundtrip():
+    for enc, ft in ((fp.encode_repl_ack(3, {"S": 4}), fp.REPL_ACK),
+                    (fp.encode_repl_heartbeat(3, {"S": 4}, 1234),
+                     fp.REPL_HEARTBEAT)):
+        (ftype, payload), = fp.parse_buffer(bytes(enc))[0]
+        assert ftype == ft
+        st = fp.decode_repl_status(payload)
+        assert st["generation"] == 3 and st["watermark"] == {"S": 4}
+
+
+# ---------------------------------------------------------------------------
+# WAL tail edge cases (rotation, truncation, scars)
+# ---------------------------------------------------------------------------
+
+def test_tail_streams_live_appends(tmp_path):
+    wal = WriteAheadLog(str(tmp_path), policy="batch")
+    tail = wal.tail()
+    assert tail.poll() == ([], False)       # empty log: caught up
+    for i in range(3):
+        wal_append(wal, "S", i)
+    recs, gap = drain(tail)
+    assert not gap
+    assert [(s, q) for s, q, _ in recs] == [("S", 1), ("S", 2), ("S", 3)]
+    wal_append(wal, "S", 3)                 # appended AFTER the drain
+    recs, gap = drain(tail)
+    assert not gap and [(s, q) for s, q, _ in recs] == [("S", 4)]
+    wal.close()
+
+
+def test_tail_follows_segment_rotation(tmp_path):
+    wal = WriteAheadLog(str(tmp_path), policy="batch", segment_bytes=256)
+    for i in range(12):
+        wal_append(wal, "S", i)
+    assert len(glob.glob(os.path.join(str(tmp_path), "wal-*.seg"))) > 2
+    recs, gap = drain(wal.tail())
+    assert not gap
+    assert [q for _, q, _ in recs] == list(range(1, 13))
+    wal.close()
+
+
+def test_tail_from_watermark_skips_prefix(tmp_path):
+    wal = WriteAheadLog(str(tmp_path), policy="batch")
+    for i in range(6):
+        wal_append(wal, "S", i)
+    recs, gap = drain(wal.tail({"S": 4}))
+    assert not gap and [q for _, q, _ in recs] == [5, 6]
+    wal.close()
+
+
+def test_tail_truncation_reports_gap_then_catchup_resumes(tmp_path):
+    """Snapshot-barrier truncation beneath a fresh subscriber is a GAP
+    (ship a Revision, advance_to, re-poll) — never an error, and the
+    gap record is NOT consumed."""
+    wal = WriteAheadLog(str(tmp_path), policy="batch", segment_bytes=256)
+    for i in range(10):
+        wal_append(wal, "S", i)
+    wal.rotate()                            # seal everything appended
+    deleted = wal.truncate({"S": 6})        # sealed segs wholly <= 6 go
+    assert deleted > 0
+    tail = wal.tail()                       # standby from NOTHING
+    recs, gap = tail.poll()
+    assert gap                              # records 1..k are gone
+    # the snapshot chain covers <= its watermark; advance and re-poll
+    tail.advance_to({"S": 6})
+    recs, gap = drain(tail)
+    assert not gap
+    assert [q for _, q, _ in recs] == list(range(7, 11))
+    wal.close()
+
+
+def test_tail_never_ships_past_a_scar(tmp_path):
+    """A CRC-scarred record parks the tail forever: everything before
+    the scar ships, nothing after it ever does (replay could not apply
+    it either — the scar is the heal boundary)."""
+    wal = WriteAheadLog(str(tmp_path), policy="batch")
+    for i in range(5):
+        wal_append(wal, "S", i)
+    tail = wal.tail()
+    recs, _ = drain(tail)
+    assert len(recs) == 5
+    # corrupt record 3 of a SECOND tail's view: flip payload bytes
+    seg = glob.glob(os.path.join(str(tmp_path), "wal-*.seg"))[0]
+    boundaries = []
+    data = open(seg, "rb").read()
+    off = 0
+    while True:
+        rec = WriteAheadLog._parse_record(data, off)
+        if rec is None:
+            break
+        boundaries.append((off, rec[3]))
+        off = rec[3]
+    start, _end = boundaries[2]
+    with open(seg, "r+b") as f:
+        f.seek(start + 20)
+        f.write(b"\xde\xad\xbe\xef")
+    scarred = wal.tail()
+    recs, gap = drain(scarred)
+    assert not gap
+    assert [q for _, q, _ in recs] == [1, 2]    # parked AT the scar
+    for _ in range(3):                          # and it STAYS parked
+        assert scarred.poll() == ([], False)
+    wal.close()
+
+
+# ---------------------------------------------------------------------------
+# append_raw: the standby's verbatim apply
+# ---------------------------------------------------------------------------
+
+def test_append_raw_byte_identical_and_idempotent(tmp_path):
+    primary = WriteAheadLog(str(tmp_path / "p"), policy="batch")
+    standby = WriteAheadLog(str(tmp_path / "s"), policy="batch")
+    for i in range(4):
+        wal_append(primary, "S", i)
+    recs, _ = drain(primary.tail())
+    for _stream, _seq, raw in recs:
+        stream, seq, applied = standby.append_raw(raw)
+        assert applied
+    # re-ship (reconnect from an older ack): idempotent, not an error
+    assert standby.append_raw(recs[0][2]) == ("S", 1, False)
+    assert standby.watermark() == primary.watermark()
+    primary.close(), standby.close()
+    pb = b"".join(open(f, "rb").read() for f in
+                  sorted(glob.glob(str(tmp_path / "p" / "wal-*.seg"))))
+    sb = b"".join(open(f, "rb").read() for f in
+                  sorted(glob.glob(str(tmp_path / "s" / "wal-*.seg"))))
+    assert pb == sb and len(pb) > 0
+
+
+def test_append_raw_gap_raises_loudly(tmp_path):
+    primary = WriteAheadLog(str(tmp_path / "p"), policy="batch")
+    standby = WriteAheadLog(str(tmp_path / "s"), policy="batch")
+    for i in range(4):
+        wal_append(primary, "S", i)
+    recs, _ = drain(primary.tail())
+    standby.append_raw(recs[0][2])
+    with pytest.raises(WalError, match="replication gap.*snapshot"):
+        standby.append_raw(recs[3][2])      # seq 4 after seq 1
+    with pytest.raises(WalError, match="corrupt replicated record"):
+        standby.append_raw(recs[1][2][:-3])
+    primary.close(), standby.close()
+
+
+# ---------------------------------------------------------------------------
+# fencing: the generation token
+# ---------------------------------------------------------------------------
+
+def test_generation_persists_and_fence_is_monotonic(tmp_path):
+    d = str(tmp_path)
+    assert read_generation(d) == 0
+    write_generation(d, 3)
+    assert read_generation(d) == 3
+    wal = WriteAheadLog(d, policy="batch")
+    assert wal.generation() == 3
+    assert wal.fence() == 4                 # past local
+    assert wal.fence(10) == 11              # past the peer's too
+    wal.close()
+    assert read_generation(d) == 11         # durable across reopen
+
+
+# ---------------------------------------------------------------------------
+# coordinator: the semi-sync barrier + lag accounting
+# ---------------------------------------------------------------------------
+
+def test_config_validation():
+    with pytest.raises(ReplicationError, match="unknown mode"):
+        ReplicationConfig("sync")
+    with pytest.raises(ReplicationError, match="requires peer"):
+        ReplicationConfig("async", role="standby")
+    with pytest.raises(ReplicationError, match="degrade"):
+        ReplicationConfig("semi-sync", degrade="ignore")
+    cfg = ReplicationConfig("semi-sync", peer="h:1", ack_timeout_s=0.5)
+    assert cfg.to_dict()["mode"] == "semi-sync"
+
+
+def test_wait_ack_returns_when_covered_and_times_out_otherwise():
+    coord = ReplicationCoordinator(
+        ReplicationConfig("semi-sync", ack_timeout_s=0.2))
+    coord.standby_attached()
+    coord.on_ack({"S": 5})
+    assert coord.wait_ack({"S": 5}) is True       # already covered
+    assert coord.wait_ack({"S": 9}) is False      # nobody acks: timeout
+    assert coord.barrier_timeouts == 1
+    # a concurrent ack wakes the sleeper before the deadline
+    t = threading.Timer(0.05, coord.on_ack, args=({"S": 9},))
+    t.start()
+    assert coord.wait_ack({"S": 9}, timeout_s=2.0) is True
+    t.join()
+
+
+def test_wait_ack_no_standby_fails_unless_degraded():
+    strict = ReplicationCoordinator(
+        ReplicationConfig("semi-sync", ack_timeout_s=0.1))
+    assert strict.wait_ack({"S": 1}) is False     # no standby: FAIL
+    lax = ReplicationCoordinator(
+        ReplicationConfig("semi-sync", ack_timeout_s=0.1,
+                          degrade="async"))
+    assert lax.wait_ack({"S": 1}) is True         # explicit opt-out
+
+
+def test_lag_breach_fires_once_per_sustained_excursion():
+    now = [0.0]
+    hits = []
+    coord = ReplicationCoordinator(
+        ReplicationConfig("async", lag_records=10, lag_breach_s=1.0),
+        on_lag_breach=hits.append, clock=lambda: now[0])
+    coord.note_local({"S": 100})                  # 100 behind, 0 acked
+    coord.on_ack({"S": 2})                        # starts the excursion
+    assert hits == []                             # not sustained yet
+    now[0] = 2.0
+    coord.on_ack({"S": 3})
+    assert len(hits) == 1 and "lag" in hits[0]
+    now[0] = 3.0
+    coord.on_ack({"S": 4})                        # still breached: once
+    assert len(hits) == 1
+    coord.on_ack({"S": 100})                      # recovered: re-arms
+    now[0] = 10.0
+    coord.note_local({"S": 300})
+    coord.on_ack({"S": 101})
+    now[0] = 20.0
+    coord.on_ack({"S": 102})
+    assert len(hits) == 2
+
+
+def test_metrics_shape():
+    coord = ReplicationCoordinator(ReplicationConfig("async"))
+    m = coord.metrics()
+    for k in ("mode", "role", "standbys", "lag_records", "lag_seconds",
+              "shipped_records", "acks", "rejected_generation",
+              "barrier_timeouts"):
+        assert k in m
+    sb = ReplicationCoordinator(
+        ReplicationConfig("async", role="standby", peer="h:1"))
+    sb.note_applied("S", 3, 100)
+    sb.note_generation(2)
+    m = sb.metrics()
+    assert m["applied_watermark"] == {"S": 3}
+    assert m["source_generation"] == 2
+
+
+# ---------------------------------------------------------------------------
+# in-process failover: standby converges, promote replays, fencing bites
+# ---------------------------------------------------------------------------
+
+def _no_resolve(app, stream):
+    raise KeyError(stream)
+
+
+def _mk_primary(tmp_path, mode_ann=""):
+    mgr = SiddhiManager()
+    mgr.set_persistence_store(
+        FileSystemPersistenceStore(str(tmp_path / "pstore")))
+    rt = mgr.create_app_runtime(
+        HOST + "@app:name('HA')\n"
+        + f"@app:durability('batch', dir='{tmp_path / 'pwal'}', "
+          f"segment.bytes='2048')\n" + mode_ann + BODY)
+    rows = []
+    rt.add_batch_callback("Out", lambda b: rows.extend(
+        map(tuple, b.rows(rt.strings))))
+    rt.start()
+    srv = NetServer(_no_resolve, port=0,
+                    repl_resolve=lambda app: {"HA": rt}[app]).start()
+    return mgr, rt, srv, rows
+
+
+def _mk_standby(tmp_path, port, extra=""):
+    mgr = SiddhiManager()
+    mgr.set_persistence_store(
+        FileSystemPersistenceStore(str(tmp_path / "sstore")))
+    rt = mgr.create_app_runtime(
+        HOST + "@app:name('HA')\n"
+        + f"@app:durability('batch', dir='{tmp_path / 'swal'}', "
+          f"segment.bytes='2048')\n"
+        + f"@app:replication('async', role='standby', "
+          f"peer='127.0.0.1:{port}'{extra})\n" + BODY)
+    rows = []
+    rt.add_batch_callback("Out", lambda b: rows.extend(
+        map(tuple, b.rows(rt.strings))))
+    rt.start()
+    return mgr, rt, rows
+
+
+def test_failover_end_to_end(tmp_path):
+    mgr_p, rt_p, srv, rows_p = _mk_primary(tmp_path)
+    mgr_s, rt_s, rows_s = _mk_standby(tmp_path, srv.port)
+    try:
+        assert rt_s.is_standby()
+        with pytest.raises(RuntimeError, match="standby"):
+            feed(rt_s, frames(1))               # ingest is BLOCKED
+        frs = frames()
+        feed(rt_p, frs)
+        wm_p = rt_p.wal.watermark()
+        assert wm_p.get("S", 0) == len(frs)
+        # standby's log converges to the primary's watermark
+        assert wait_for(lambda: rt_s.replication.applied_watermark()
+                        == wm_p)
+        # acks flowed back: the primary sees the standby's progress
+        assert wait_for(lambda: rt_p.replication is not None
+                        and rt_p.replication.metrics()
+                        .get("acked_watermark") == wm_p)
+        assert rt_p.replication.standbys() == 1
+        # happy path: ZERO error-store captures on either side
+        assert len(rt_s.error_store) == 0
+        assert len(rt_p.error_store) == 0
+        # --- machine loss: the primary goes away -------------------------
+        srv.stop()
+        mgr_p.shutdown()
+        report = rt_s.promote()
+        assert report["promoted"] and report["generation"] >= 1
+        assert report["recovery"]["replayed_frames"] == len(frs)
+        # byte-identical replay: the standby computed the SAME outputs
+        assert sorted(rows_s) == sorted(rows_p) and rows_p
+        # the log itself is byte-identical up to the failover point
+        pb = b"".join(open(f, "rb").read() for f in
+                      sorted(glob.glob(str(tmp_path / "pwal"
+                                           / "wal-*.seg"))))
+        sb = b"".join(open(f, "rb").read() for f in
+                      sorted(glob.glob(str(tmp_path / "swal"
+                                           / "wal-*.seg"))))
+        assert pb == sb and pb
+        # promoted: ingest unblocked, seqs continue past the watermark
+        feed(rt_s, frames(1, seed=11))
+        assert rt_s.wal.watermark()["S"] == len(frs) + 1
+        # observability: role flip + promotion report are surfaced
+        stats = rt_s.statistics()["replication"]
+        assert stats["role"] == "primary" and stats["promoted"]
+        dur = rt_s.explain()["durability"]
+        assert dur["promotion"]["generation"] == report["generation"]
+        assert dur["recovery"]["replayed_frames"] == len(frs)
+    finally:
+        srv.stop()
+        mgr_p.shutdown()
+        mgr_s.shutdown()
+
+
+def test_catchup_over_truncated_wal_ships_snapshot(tmp_path):
+    """A standby subscribing from scratch AFTER a snapshot barrier
+    truncated the primary's log gets the Revision chain, then the
+    record stream — a gap is a catch-up, not an error."""
+    mgr_p, rt_p, srv, _rows = _mk_primary(tmp_path)
+    try:
+        feed(rt_p, frames(6, batch=32))
+        rt_p.persist()                      # barrier + truncate sealed
+        assert rt_p.wal.truncated_segments > 0
+        wm_p = rt_p.wal.watermark()
+        mgr_s, rt_s, _ = _mk_standby(tmp_path, srv.port)
+        try:
+            assert wait_for(lambda: rt_s.replication.applied_watermark()
+                            .get("S", 0) >= wm_p["S"])
+            m = rt_s.statistics()["replication"]
+            assert m["applied_snapshots"] >= 1      # the chain shipped
+            assert len(rt_s.error_store) == 0       # and NOT as an error
+            # the shipped revision restores at promote
+            srv.stop(), mgr_p.shutdown()
+            report = rt_s.promote()
+            assert report["recovery"]["restored_revision"] is not None
+        finally:
+            mgr_s.shutdown()
+    finally:
+        srv.stop()
+        mgr_p.shutdown()
+
+
+def test_deposed_primary_is_rejected_loudly(tmp_path):
+    """Split-brain: after the standby fences, frames stamped with the
+    old generation are refused — error-store capture + counter, no
+    silent apply."""
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(
+        HOST + "@app:name('HA')\n"
+        + f"@app:durability('batch', dir='{tmp_path / 'wal'}')\n"
+        + "@app:replication('async', role='standby', "
+          "peer='127.0.0.1:1')\n" + BODY)
+    coord = rt._ensure_replication()
+    rt._standby_active = True
+    rt._started = True
+    rt.wal = None
+    rt._open_wal()
+    recv = WalReceiver(rt, coord, "127.0.0.1:1")    # never started
+    coord.note_generation(3)                        # saw primary gen 3
+    with pytest.raises(ReplProtocolError, match="deposed"):
+        recv._check_generation(2)
+    assert coord.rejected_generation == 1
+    ents = rt.error_store.entries("_replication")
+    assert len(ents) == 1 and ents[0].point == "repl.fence"
+    mgr.shutdown()
+
+
+def test_shipper_refuses_subscriber_from_the_future(tmp_path):
+    """The OTHER split-brain direction: a primary asked to serve a
+    standby that has seen a NEWER generation knows it was deposed."""
+    from siddhi_tpu.net.repl import WalShipper
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(
+        HOST + "@app:name('HA')\n"
+        + f"@app:durability('batch', dir='{tmp_path / 'wal'}')\n" + BODY)
+    rt.start()
+    coord = rt._ensure_replication(default=True)
+    wrote = []
+    sh = WalShipper(rt, coord, wrote.append,
+                    {"app": "HA", "watermark": {}, "generation": 99},
+                    stop=lambda: False)
+    with pytest.raises(ReplProtocolError, match="deposed"):
+        sh._ship()
+    assert coord.rejected_generation == 1
+    mgr.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the semi-sync barrier over the wire
+# ---------------------------------------------------------------------------
+
+SRC = "@source(type='tcp', port='0')\n"
+
+
+def _wire_app(tmp_path, repl):
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(
+        HOST + "@app:name('SemiSync')\n"
+        + f"@app:durability('batch', dir='{tmp_path / 'wal'}')\n"
+        + repl + SRC + BODY)
+    rt.start()
+    cols = TcpFrameClient.cols_of_schema(rt.schemas["S"])
+    cli = TcpFrameClient("127.0.0.1", rt.sources[0].port, "S", cols)
+    return mgr, rt, cli
+
+
+def test_semi_sync_barrier_fails_without_standby(tmp_path):
+    """No standby connected -> the durable-ACK barrier must FAIL (the
+    producer retransmits) rather than lie about durability."""
+    mgr, rt, cli = _wire_app(
+        tmp_path, "@app:replication('semi-sync', ack.timeout='200 ms')\n")
+    try:
+        cols, ts = frames(1)[0]
+        cli.send_batch(cols, ts)
+        # the server fails the barrier with FrameDesync and drops the
+        # link: the client sees either its own timeout error or the
+        # hangup — both force the retransmit path
+        with pytest.raises((NetClientError, EOFError, OSError)):
+            cli.barrier(timeout=3.0)
+        assert rt.replication.barrier_timeouts >= 1
+    finally:
+        cli.close()
+        mgr.shutdown()
+
+
+def test_semi_sync_degrade_async_waives_the_wait(tmp_path):
+    mgr, rt, cli = _wire_app(
+        tmp_path, "@app:replication('semi-sync', ack.timeout='200 ms', "
+        "degrade='async')\n")
+    try:
+        cols, ts = frames(1)[0]
+        cli.send_batch(cols, ts)
+        cli.barrier(timeout=5.0)            # succeeds: explicit opt-out
+        assert rt.replication.barrier_timeouts == 0
+    finally:
+        cli.close()
+        mgr.shutdown()
+
+
+def test_semi_sync_barrier_succeeds_with_live_standby(tmp_path):
+    """The full semi-sync contract: PING/ACK completes only once the
+    standby appended — and it does, because the receiver acks every
+    heartbeat immediately."""
+    mgr_p, rt_p, srv, _rows = _mk_primary(
+        tmp_path, "@app:replication('semi-sync', ack.timeout='5 sec', "
+        "heartbeat='50 ms')\n")
+    cols = None
+    mgr_s = None
+    try:
+        mgr_s, rt_s, _ = _mk_standby(tmp_path, srv.port)
+        assert wait_for(lambda: rt_p.replication is not None
+                        and rt_p.replication.standbys() == 1)
+        feed(rt_p, frames(2))
+        ok = rt_p.replication.wait_ack(rt_p.wal.watermark(),
+                                       timeout_s=10.0)
+        assert ok is True
+        assert rt_p.replication.barrier_waits >= 1
+    finally:
+        srv.stop()
+        mgr_p.shutdown()
+        if mgr_s is not None:
+            mgr_s.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# plan-time guards + observability surfacing (satellite pins)
+# ---------------------------------------------------------------------------
+
+def test_replication_requires_durability():
+    from siddhi_tpu.core.planner import PlanError
+    mgr = SiddhiManager()
+    with pytest.raises(PlanError, match="SA14"):
+        mgr.create_app_runtime(
+            HOST + "@app:name('X')\n@app:replication('async')\n" + BODY)
+    mgr.shutdown()
+
+
+def test_recovery_report_surfaces_in_snapshot_info_and_explain(tmp_path):
+    """Satellite bugfix pin: the last recover() report must show in
+    BOTH the snapshot endpoint payload and explain()['durability']."""
+    from siddhi_tpu.core.wal import WriteAheadLog as _W
+    mgr = SiddhiManager()
+    mgr.set_persistence_store(FileSystemPersistenceStore(str(tmp_path)))
+    app = (HOST + "@app:name('Rec')\n"
+           + f"@app:durability('batch', dir='{tmp_path / 'wal'}')\n"
+           + BODY)
+    rt = mgr.create_app_runtime(app)
+    rt.start()
+    feed(rt, frames(2))
+    rt.wal.close()
+    mgr._runtimes.clear()                   # simulated crash
+    rt2 = mgr.create_app_runtime(app)
+    rt2.start()                             # recover() runs on start
+    dur = rt2.explain()["durability"]
+    assert dur["recovery"]["replayed_frames"] == 2
+    from siddhi_tpu.service import SiddhiService
+    svc = SiddhiService.__new__(SiddhiService)
+    svc.runtimes = {"Rec": rt2}
+    info = svc.snapshot_info("Rec")
+    assert info["recovery"]["replayed_frames"] == 2
+    mgr.shutdown()
