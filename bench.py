@@ -1778,21 +1778,25 @@ K9_CONFIGS = {"pattern": (K9_PATTERN, ["S"]),
               "join": (K9_JOIN, ["S", "T"])}
 
 
-def _k9_tape(seed, streams, rounds=10, batch=128, keys=6):
+def _k9_tape(seed, streams, rounds=10, batch=128, keys=6,
+             with_ts=False):
     """Deterministic per-round frame tape, regenerated identically by
-    the parent (clean run + resume) and the to-be-killed child."""
+    the parent (clean run + resume) and the to-be-killed child.
+    `with_ts` adds the event-time column aggregations fold by."""
     rng = np.random.default_rng(seed)
     ts0 = 1_700_000_000_000
     out = []
     for k in range(rounds):
         rd = {}
         for sid in streams:
-            rd[sid] = (
-                {"sym": np.array([f"K{i}" for i in
-                                  rng.integers(0, keys, batch)]),
-                 "p": q4(rng.uniform(60.0, 140.0, batch))},
-                ts0 + np.arange(k * batch, (k + 1) * batch,
-                                dtype=np.int64) * 2)
+            ts = ts0 + np.arange(k * batch, (k + 1) * batch,
+                                 dtype=np.int64) * 2
+            cols = {"sym": np.array([f"K{i}" for i in
+                                     rng.integers(0, keys, batch)]),
+                    "p": q4(rng.uniform(60.0, 140.0, batch))}
+            if with_ts:
+                cols["ts"] = ts
+            rd[sid] = (cols, ts)
         out.append(rd)
     return out
 
@@ -1839,7 +1843,8 @@ def chaos_kill9_child(spec_path: str) -> None:
                                     rt.schemas[sid]))
             for sid in spec["streams"]}
     tape = _k9_tape(spec["seed"], spec["streams"], spec["rounds"],
-                    spec["batch"], spec["keys"])
+                    spec["batch"], spec["keys"],
+                    with_ts=spec.get("with_ts", False))
     for k, rd in enumerate(tape):
         if k == spec["snapshot_at"]:
             rt.persist()
@@ -1968,6 +1973,122 @@ def chaos_kill9(seed: int = 7) -> dict:
         cfg["pass"] = all(cfg[k]["pass"] for k in
                           ("mid_wal_append", "mid_snapshot"))
         out["configs"][name] = cfg
+    return out
+
+
+K9_AGG = _K9_HEAD + """
+@source(type='tcp', port='0')
+define stream S (sym string, p double, ts long);
+define aggregation Roll
+from S
+select sym, sum(p) as total, avg(p) as mean, count() as n
+group by sym
+aggregate by ts every sec, min;
+"""
+
+K9_AGG_QUERY = ("from Roll within 1699999000000L, 1700001000000L "
+                "per 'sec' select sym, total, mean, n")
+
+
+def chaos_agg_kill9(seed: int = 7) -> dict:
+    """`--chaos` queryable-state section: the kill-9 harness pointed at
+    a `define aggregation` app.  A subprocess feeds TCP frames into the
+    durable rollup and is SIGKILLED mid-`wal.append` (snapshot behind
+    it) and mid-snapshot; the parent recovers and resumes the unacked
+    tail.  Asserted per kill point, against an uninterrupted run:
+
+      * store-query rows byte-identical (the exactly-once invariant on
+        the aggregation plane — no bucket double-merge, none lost)
+      * the device-resident bucket store itself byte-identical
+        (`state_dict()` compares raw f64 bases, not rendered rows)
+      * zero ErrorStore captures"""
+    import json as _json
+    import os
+    import shutil
+    import subprocess
+    import tempfile
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.persistence import FileSystemPersistenceStore
+
+    rounds, batch, keys = 10, 128, 6
+    streams = ["S"]
+    tape = _k9_tape(seed, streams, rounds, batch, keys, with_ts=True)
+
+    # uninterrupted reference (in-proc feed, same tape; durability off
+    # -- the reference run needs no WAL and must not warn about one)
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(
+        K9_AGG.replace("@app:durability('batch')\n", ""))
+    rt.start()
+    h = rt.input_handler("S")
+    for rd in tape:
+        cols, ts = rd["S"]
+        h.send_batch(cols, ts)
+    rt.flush()
+    want_rows = rt.query(K9_AGG_QUERY)
+    want_state = rt.aggregations["Roll"].state_dict()
+    dev_path = rt.explain()["aggregations"]["Roll"]["path"]
+    mgr.shutdown()
+
+    out = {"seed": seed, "clean_rows": len(want_rows),
+           "path": dev_path, "kills": {}, "pass": dev_path != "host"}
+    snapshot_at = 4
+    for kname, point, at in (
+            ("mid_wal_append", "wal.append", snapshot_at + 3),
+            ("mid_snapshot", "persist.save", 1)):
+        work = tempfile.mkdtemp(prefix="siddhi_k9agg_")
+        snap_dir = os.path.join(work, "snap")
+        spec = {"app": K9_AGG.replace(
+                    "@app:durability('batch')",
+                    f"@app:durability('batch', dir='{work}/wal')"),
+                "streams": streams, "snap_dir": snap_dir,
+                "seed": seed, "rounds": rounds, "batch": batch,
+                "keys": keys, "snapshot_at": snapshot_at,
+                "with_ts": True, "kill_point": point, "kill_at": at}
+        spec_path = os.path.join(work, "spec.json")
+        with open(spec_path, "w") as f:
+            _json.dump(spec, f)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--chaos-child", spec_path],
+            capture_output=True, timeout=600)
+        killed = proc.returncode == -9
+        rep = {}
+        rows_ok = state_ok = False
+        shed = resumed = 0
+        if killed:
+            m2 = SiddhiManager()
+            m2.set_persistence_store(FileSystemPersistenceStore(snap_dir))
+            rt2 = m2.create_app_runtime(spec["app"])
+            rep = rt2.recover()
+            durable = dict(rt2.wal.seqs)
+            h2 = rt2.input_handler("S")
+            for k, rd in enumerate(tape):
+                if k + 1 > durable.get("S", 0):
+                    cols, ts = rd["S"]
+                    h2.send_batch(cols, ts)
+                    resumed += batch
+            rt2.flush()
+            rows_ok = rt2.query(K9_AGG_QUERY) == want_rows
+            state_ok = (rt2.aggregations["Roll"].state_dict()
+                        == want_state)
+            shed = sum(len(e.events or ())
+                       for e in rt2.error_store.entries())
+            m2.shutdown()
+        ok = killed and rows_ok and state_ok and shed == 0
+        out["kills"][kname] = {
+            "killed": killed,
+            "restored_revision": rep.get("restored_revision"),
+            "replayed_frames": rep.get("replayed_frames"),
+            "resumed_events": resumed, "shed": shed,
+            "rows_identical": rows_ok,
+            "bucket_state_identical": state_ok, "pass": ok}
+        if not killed:
+            out["kills"][kname]["child_rc"] = proc.returncode
+            out["kills"][kname]["child_tail"] = \
+                proc.stderr.decode(errors="replace")[-500:]
+        out["pass"] = out["pass"] and ok
+        shutil.rmtree(work, ignore_errors=True)
     return out
 
 
@@ -2586,6 +2707,13 @@ def chaos_bench(seed: int = 7) -> dict:
     out["kill9"] = k9
     out["pass"] = out["pass"] and bool(k9.get("pass"))
 
+    # queryable-state chaos: SIGKILL mid-flush on a durable aggregation,
+    # recover, prove the bucket store itself is byte-identical
+    a9 = _safe("chaos agg kill9", lambda: chaos_agg_kill9(seed),
+               {"pass": False})
+    out["agg_kill9"] = a9
+    out["pass"] = out["pass"] and bool(a9.get("pass"))
+
     # machine-loss chaos: SIGKILL the primary PROCESS (its disk is
     # gone), promote the hot standby, resume the producer — lossless
     ml = _safe("chaos machine loss", lambda: chaos_machine_loss(seed),
@@ -2709,6 +2837,184 @@ def pattern_families_smoke() -> dict:
     return out
 
 
+# ---------------------------------------------------------------------------
+# queryable-state workload matrix (`--matrix`): DEBS-style rollup shapes
+# over the aggregation plane, every cell asserting device-vs-host parity
+# (docs/AGGREGATION.md)
+# ---------------------------------------------------------------------------
+
+MATRIX_TS0 = 1_700_000_000_000
+
+
+def _matrix_app(head=""):
+    return (head +
+            "define stream Trades "
+            "(sym string, p double, v double, ts long);\n"
+            "define aggregation Roll\n"
+            "from Trades\n"
+            "select sym, sum(p * v) as turnover, avg(p) as mean, "
+            "min(p) as lo, max(p) as hi, count() as n\n"
+            "group by sym\n"
+            "aggregate by ts every sec, min, hour;\n")
+
+
+def _matrix_tape(n_batches, batch, keys, seed=13):
+    rng = np.random.default_rng(seed)
+    out = []
+    for k in range(n_batches):
+        ts = (MATRIX_TS0 + k * 1500
+              + np.sort(rng.integers(0, 1500, batch)))
+        out.append((
+            {"sym": np.array([f"G{i}" for i in
+                              rng.integers(0, keys, batch)]),
+             "p": rng.uniform(10, 500, batch),
+             "v": rng.uniform(1, 50, batch),
+             "ts": ts.astype(np.int64)},
+            ts.astype(np.int64)))
+    return out
+
+
+def _matrix_query(per="min"):
+    return (f"from Roll within {MATRIX_TS0 - 3_600_000}L, "
+            f"{MATRIX_TS0 + 86_400_000}L per {per!r} "
+            f"select sym, turnover, mean, lo, hi, n")
+
+
+def matrix_bench(smoke=False) -> dict:
+    """Queryable-state workload matrix (`--matrix`): DEBS-grand-challenge
+    shaped cells over `define aggregation`:
+
+      * rollup_kN — ingest-only rollup sweep across group-by
+        cardinalities; per-cell differential against the forced-host
+        path (`@app:deviceAggregations('off')`) across EVERY duration
+      * mixed     — interleaved ingest + store queries on one thread
+        (the dashboard-refresh shape); in-process store-query p99
+      * wire      — paced TCP producer thread + a second connection
+        issuing concurrent wire store queries; client-observed p99 and
+        final wire-vs-inproc row parity
+
+    Per-cell summary (eps + store_query_p99_ms + parity) lands in
+    BENCH_DETAIL.json; the final stdout line is machine-parseable."""
+    import threading
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.net import TcpFrameClient
+
+    n_batches = 8 if smoke else 24
+    batch = 512 if smoke else 4096
+    key_sweep = (8, 64) if smoke else (8, 128, 1024)
+    pers = ("sec", "min", "hour")
+
+    def run_inproc(head, keys, query_every=0):
+        mgr = SiddhiManager()
+        rt = mgr.create_app_runtime(_matrix_app(head))
+        rt.start()
+        h = rt.input_handler("Trades")
+        tape = _matrix_tape(n_batches, batch, keys)
+        qlat = []
+        t0 = time.perf_counter()
+        for i, (cols, ts) in enumerate(tape):
+            h.send_batch(cols, ts)
+            if query_every and (i + 1) % query_every == 0:
+                tq = time.perf_counter()
+                rt.query(_matrix_query())
+                qlat.append((time.perf_counter() - tq) * 1e3)
+        rt.flush()
+        elapsed = time.perf_counter() - t0
+        rows = {per: sorted(rt.query(_matrix_query(per)))
+                for per in pers}
+        path = rt.explain()["aggregations"]["Roll"]["path"]
+        sq = (rt.statistics().get("aggregation", {})
+              .get("store_query", {}))
+        mgr.shutdown()
+        return rows, elapsed, path, qlat, sq
+
+    out = {"smoke": smoke, "events_per_cell": n_batches * batch,
+           "cells": {}, "pass": True}
+
+    # rollup cardinality sweep: device vs forced-host differential
+    host_rows = {}
+    for keys in key_sweep:
+        dev_rows, el, path, _, _ = run_inproc("", keys)
+        hrows, _, hpath, _, _ = run_inproc(
+            "@app:deviceAggregations('off')\n", keys)
+        host_rows[keys] = hrows
+        parity = dev_rows == hrows
+        ok = (parity and path == "device-resident" and hpath == "host"
+              and all(len(v) > 0 for v in dev_rows.values()))
+        out["cells"][f"rollup_k{keys}"] = {
+            "keys": keys, "eps": round(n_batches * batch / el),
+            "path": path, "parity": parity,
+            "rows": {per: len(v) for per, v in dev_rows.items()},
+            "pass": ok}
+        out["pass"] = out["pass"] and ok
+
+    # mixed ingest + store-query load on one thread
+    mkeys = key_sweep[-1]
+    mrows, mel, mpath, qlat, msq = run_inproc("", mkeys, query_every=1)
+    mok = (mrows == host_rows[mkeys] and mpath == "device-resident"
+           and len(qlat) == n_batches)
+    out["cells"]["mixed"] = {
+        "keys": mkeys, "eps": round(n_batches * batch / mel),
+        "store_queries": len(qlat),
+        "store_query_p99_ms": round(float(np.percentile(qlat, 99)), 3),
+        "tracker_p99_ms": msq.get("p99_ms"),
+        "parity": mrows == host_rows[mkeys], "pass": mok}
+    out["pass"] = out["pass"] and mok
+
+    # concurrent wire store queries under paced TCP ingest
+    wkeys = key_sweep[0]
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(
+        _matrix_app("@source(type='tcp', port='0')\n"))
+    rt.start()
+    port = rt.sources[0].port
+    cols_spec = TcpFrameClient.cols_of_schema(rt.schemas["Trades"])
+    tape = _matrix_tape(n_batches, batch, wkeys)
+    stop = threading.Event()
+    feed_err = []
+
+    def feed():
+        cli = TcpFrameClient("127.0.0.1", port, "Trades", cols_spec)
+        try:
+            for cols, ts in tape:
+                cli.send_batch(cols, ts)
+                time.sleep(0.001)      # paced: leave room for queries
+            cli.barrier(timeout=300)
+        except Exception as e:          # surfaced in the cell result
+            feed_err.append(repr(e))
+        finally:
+            stop.set()
+            cli.close()
+
+    qcli = TcpFrameClient("127.0.0.1", port, "Trades", cols_spec)
+    th = threading.Thread(target=feed)
+    t0 = time.perf_counter()
+    th.start()
+    wlat = []
+    while not stop.is_set() or not wlat:
+        tq = time.perf_counter()
+        qcli.query(_matrix_query(), timeout=120)
+        wlat.append((time.perf_counter() - tq) * 1e3)
+    th.join()
+    elapsed = time.perf_counter() - t0
+    wire_rows = sorted(qcli.query(_matrix_query(), timeout=120))
+    inproc_rows = sorted(rt.query(_matrix_query()))
+    qcli.close()
+    wsq = rt.statistics().get("aggregation", {}).get("store_query", {})
+    mgr.shutdown()
+    wok = (not feed_err and wire_rows == inproc_rows
+           and len(wire_rows) > 0)
+    out["cells"]["wire"] = {
+        "keys": wkeys, "eps": round(n_batches * batch / elapsed),
+        "store_queries": len(wlat),
+        "store_query_p99_ms": round(float(np.percentile(wlat, 99)), 3),
+        "tracker_p99_ms": wsq.get("p99_ms"),
+        "parity": wire_rows == inproc_rows,
+        "feed_errors": feed_err, "pass": wok}
+    out["pass"] = out["pass"] and wok
+    return out
+
+
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
     if "--chaos-child" in argv:
@@ -2739,6 +3045,28 @@ def main(argv=None):
                           "value": res["tcp_vs_rest"],
                           "unit": "tcp_frame_eps_over_per_event_rest",
                           **res}))
+        if not res["pass"]:
+            sys.exit(1)
+        return
+    if "--matrix" in argv:
+        # queryable-state workload matrix (docs/AGGREGATION.md): rollup
+        # cardinality sweep + mixed query/ingest + concurrent wire
+        # store queries, each cell device-vs-host parity-checked;
+        # --smoke shrinks it for scripts/smoke.sh
+        res = matrix_bench(smoke="--smoke" in argv)
+        detail = {"harness": harness_info(), "matrix": res}
+        with open("BENCH_DETAIL.json", "w") as f:
+            json.dump(detail, f, indent=1, default=str)
+        print(json.dumps({
+            "metric": "queryable_state_matrix",
+            "value": 1 if res["pass"] else 0,
+            "unit": "all_cells_device_host_parity",
+            "cells": {k: {"eps": c.get("eps"),
+                          "store_query_p99_ms":
+                              c.get("store_query_p99_ms"),
+                          "parity": c.get("parity", c.get("pass"))}
+                      for k, c in res["cells"].items()},
+            "detail": "BENCH_DETAIL.json"}))
         if not res["pass"]:
             sys.exit(1)
         return

@@ -1,0 +1,81 @@
+"""Queryable state plane quickstart: device-resident incremental
+aggregation served by SiddhiQL store queries (docs/AGGREGATION.md).
+
+`define aggregation` rolls every trade into per-duration buckets —
+seconds through hours here — and the runtime keeps the bucket state
+ITSELF on device (one float64 base matrix per duration, merged in
+place by a jitted segment-reduce; `rt.explain()` shows the plan as
+`device-resident`).  Dashboards never see any of that machinery: they
+ask with a store query (`from TradeAgg within ... per 'min' select
+...`), in process via `rt.query()` or over the wire via
+`FrameClient.query()` / `POST /siddhi/artifact/query`.
+
+(The app string deliberately keeps the analyzer's SA15 warning
+visible: `group by sym` with no `@purge` retention means one rolling
+bucket row per (bucket, symbol) pair per duration, forever — the
+smoke corpus pins the finding.  Production apps declare
+`@purge(retention='1 hour')` or similar.)
+
+    python samples/aggregated_dashboard.py
+"""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+from siddhi_tpu import SiddhiManager
+
+APP = """
+@app:name('Dashboard')
+define stream Trades (sym string, price double, vol long, ts long);
+
+define aggregation TradeAgg
+from Trades
+select sym, sum(price * vol) as turnover, avg(price) as avgPrice,
+       min(price) as lo, max(price) as hi, count() as trades
+group by sym
+aggregate by ts every sec, min, hour;
+"""
+
+rng = np.random.default_rng(21)
+ts0 = 1_700_000_000_000
+syms = np.array(["AAPL", "NVDA", "TSLA", "AMZN"])
+
+mgr = SiddhiManager()
+rt = mgr.create_app_runtime(APP)
+rt.start()
+h = rt.input_handler("Trades")
+for k in range(16):
+    n = 512
+    ts = ts0 + k * 15_000 + rng.integers(0, 15_000, n)
+    ts.sort()
+    h.send_batch({"sym": syms[rng.integers(0, 4, n)],
+                  "price": np.round(rng.uniform(90, 410, n), 2),
+                  "vol": rng.integers(1, 50, n).astype(np.int64),
+                  "ts": ts.astype(np.int64)}, ts.astype(np.int64))
+rt.flush()
+
+agg = rt.aggregations["TradeAgg"]
+print("placement:", rt.explain()["aggregations"]["TradeAgg"]["path"])
+print("state:", agg.metrics())
+
+rows = rt.query(
+    f"from TradeAgg within {ts0}L, {ts0 + 300_000}L per 'min' "
+    f"select sym, turnover, avgPrice, trades")
+print(f"\nper-minute rollup ({len(rows)} rows):")
+for bucket, row in sorted(rows)[:8]:
+    sym, turnover, avg_price, trades = row
+    print(f"  {bucket}  {sym:<5} turnover={turnover:>12.2f} "
+          f"avg={avg_price:7.2f} trades={trades}")
+
+rows = rt.query(
+    f"from TradeAgg within {ts0 - 3_600_000}L, {ts0 + 3_600_000}L "
+    f"per 'hour' select sym, lo, hi, trades")
+print(f"\nhourly extremes ({len(rows)} rows):")
+for bucket, (sym, lo, hi, trades) in sorted(rows):
+    print(f"  {bucket}  {sym:<5} lo={lo:7.2f} hi={hi:7.2f} "
+          f"trades={trades}")
+
+sq = rt.statistics()["aggregation"]["store_query"]
+print(f"\nstore queries: {sq['batches']} "
+      f"(p99 {sq.get('p99_ms', 0.0)} ms)")
+mgr.shutdown()

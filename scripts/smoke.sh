@@ -41,10 +41,11 @@ echo "== static analysis: samples corpus =="
 # PINNED (all info-severity conveniences in the samples); any new rule
 # firing — or an expected one disappearing — fails CI
 python -m siddhi_tpu.analysis \
-    --expect SA07,SA07,SA07,SA07,SA12,SA13,SA13,SA13,SA14 \
+    --expect SA07,SA07,SA07,SA07,SA12,SA13,SA13,SA13,SA14,SA15 \
     samples/simple_filter.py samples/time_window.py \
     samples/partitioned_pattern_tpu.py samples/net_serving.py \
-    samples/durable_serving.py samples/replicated_failover.py
+    samples/durable_serving.py samples/replicated_failover.py \
+    samples/aggregated_dashboard.py
 
 echo "== tier-1 tests =="
 python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
@@ -584,6 +585,86 @@ echo "== net serving-plane smoke =="
 # shed.policy='shed' asserting p99 <= 2x unloaded, zero unaccounted
 # loss (every shed event in the ErrorStore) and full replay
 python bench.py --net --smoke
+
+echo "== queryable-state smoke =="
+# the state plane end-to-end: deploy a `define aggregation` app, ingest
+# over the frame plane, then read the SAME rollup three ways — wire
+# QUERY frame, REST store query, in-process runtime.query() — and
+# assert all three agree byte-for-byte and /metrics carries the
+# siddhi_tpu_agg_* series
+python - <<'EOF'
+import json
+import urllib.request
+
+import numpy as np
+
+from siddhi_tpu.net import TcpFrameClient
+from siddhi_tpu.service import SiddhiService
+
+svc = SiddhiService(port=0).start()
+base = f"http://127.0.0.1:{svc.port}"
+try:
+    app = ("@app:name('AggSmoke')\n"
+           "define stream T (sym string, p double, ts long);\n"
+           "define aggregation Roll\n"
+           "from T select sym, sum(p) as total, count() as n\n"
+           "group by sym aggregate by ts every sec, min;\n")
+    req = urllib.request.Request(f"{base}/siddhi/artifact/deploy",
+                                 data=app.encode(), method="POST")
+    urllib.request.urlopen(req).read()
+    rt = svc.runtimes["AggSmoke"]
+    ts0 = 1_700_000_000_000
+    cli = TcpFrameClient("127.0.0.1", svc.net_port, "T",
+                         TcpFrameClient.cols_of_schema(rt.schemas["T"]),
+                         app="AggSmoke")
+    ts = ts0 + np.arange(256, dtype=np.int64) * 20
+    cli.send_batch({"sym": np.array([f"S{i % 5}" for i in range(256)]),
+                    "p": np.linspace(1.0, 64.0, 256),
+                    "ts": ts}, ts)
+    cli.barrier(timeout=30)
+    q = (f"from Roll within {ts0}L, {ts0 + 60_000}L per 'sec' "
+         f"select sym, total, n")
+    assert rt.explain()["aggregations"]["Roll"]["path"] \
+        == "device-resident"
+    inproc = sorted(rt.query(q))
+    wire = sorted(cli.query(q))
+    cli.close()
+    req = urllib.request.Request(
+        f"{base}/siddhi/artifact/query",
+        data=json.dumps({"app": "AggSmoke", "query": q}).encode(),
+        method="POST")
+    with urllib.request.urlopen(req) as r:
+        rest = sorted((t, tuple(row)) for t, row in
+                      json.loads(r.read())["rows"])
+    assert len(inproc) > 0 and wire == inproc and rest == inproc, \
+        (len(inproc), len(wire), len(rest))
+    with urllib.request.urlopen(f"{base}/metrics") as r:
+        text = r.read().decode()
+    for series in ("siddhi_tpu_agg_groups", "siddhi_tpu_agg_buckets",
+                   "siddhi_tpu_agg_store_queries_total"):
+        assert any(ln.startswith(series) for ln in text.splitlines()), \
+            f"{series} missing from /metrics"
+    print(f"OK: {len(inproc)} rollup rows identical over wire QUERY, "
+          f"REST, and in-process; agg series live")
+finally:
+    svc.stop()
+EOF
+
+echo "== queryable-state workload matrix smoke =="
+# bench.py --matrix --smoke: shrunk DEBS-style cells (rollup cardinality
+# sweep, mixed query/ingest, concurrent wire store queries), each cell
+# device-vs-host parity-checked; last line must parse as JSON with
+# per-cell eps + store-query p99
+python bench.py --matrix --smoke | tee /tmp/_matrix_smoke.out
+python - <<'EOF'
+import json
+d = json.loads(open("/tmp/_matrix_smoke.out")
+               .read().strip().splitlines()[-1])
+assert d["metric"] == "queryable_state_matrix" and d["value"] == 1, d
+assert all(c.get("parity") for c in d["cells"].values()), d["cells"]
+print("OK: matrix cells", ", ".join(
+    f"{k}={c['eps']} eps" for k, c in d["cells"].items()))
+EOF
 
 echo "== seeded chaos smoke =="
 # bench.py --chaos: injected dispatch + sink faults under a fixed seed;

@@ -53,6 +53,9 @@ RULES = {
     "SA14": ("warn", "@app:replication without @app:durability (nothing "
                      "to ship), or 'semi-sync' over an unbounded "
                      "block-policy source"),
+    "SA15": ("warn", "aggregation groups by an unbounded key with no "
+                     "@purge retention (rolling bucket state never "
+                     "expires)"),
 }
 
 
@@ -563,6 +566,34 @@ def _rule_sa14_replication(ctx, out):
                 f"as accounted backpressure", sid))
 
 
+def _rule_sa15_aggregation_retention(ctx, out):
+    """An aggregation keeps one rolling bucket row per (bucket, group)
+    pair PER DURATION (docs/AGGREGATION.md "Retention").  With a
+    `group by` the row count scales with key cardinality times elapsed
+    wall time, and nothing ever expires it — on the device-resident
+    path that is base-matrix capacity doubling forever, on the host
+    path an ever-growing dict.  `@purge(retention='...')` bounds it;
+    `@purge(enable='false')` is an explicit opt-out this rule
+    respects."""
+    for aid, ad in sorted(ctx.app.aggregation_definitions.items()):
+        if not ad.selector.group_by:
+            continue
+        purge = ast.find_annotation(ad.annotations, "purge")
+        if purge is not None:
+            continue                     # any @purge (even an explicit
+        #                                  opt-out) is a decision made
+        keys = ", ".join(v.attribute for v in ad.selector.group_by)
+        durs = ", ".join(d.value for d in ad.durations)
+        out.append(_finding(
+            "SA15",
+            f"aggregation {aid!r} groups by ({keys}) across "
+            f"durations ({durs}) with no @purge annotation: bucket "
+            f"state grows with key cardinality x wall time and never "
+            f"expires — declare @purge(retention='...') (or "
+            f"per-duration spans), or @purge(enable='false') to "
+            f"accept unbounded state", aid))
+
+
 _RULE_FNS = (
     _rule_sa01_every_without_within,
     _rule_sa02_windowless_aggregation,
@@ -578,6 +609,7 @@ _RULE_FNS = (
     _rule_sa12_f32_precision,
     _rule_sa13_durability,
     _rule_sa14_replication,
+    _rule_sa15_aggregation_retention,
 )
 
 _SEV_ORDER = {"error": 0, "warn": 1, "info": 2}

@@ -65,6 +65,54 @@ def duration_of(name: str) -> Duration:
     return d
 
 
+def parse_span_ms(text) -> int:
+    """'1 hour' / '90 sec' / bare ms integer -> milliseconds."""
+    s = str(text).strip()
+    parts = s.split()
+    if len(parts) == 2:
+        return int(float(parts[0]) * duration_of(parts[1]).approx_millis)
+    try:
+        return int(s)
+    except ValueError:
+        raise PlanError(f"cannot parse retention span {text!r} "
+                        f"(want e.g. '1 hour' or ms)") from None
+
+
+def _parse_retention(ad: ast.AggregationDefinition) -> dict:
+    """@purge on a `define aggregation` -> {Duration: retention_ms}.
+
+    Forms (reference: @purge/@retentionPeriod on aggregations):
+      @purge(retention='1 hour')            uniform retention
+      @purge('1 hour')                      same, positional
+      @purge(retention='1 hour', sec='2 min')   per-duration override
+      @purge(enable='false', ...)           disabled
+    Returns {} when absent/disabled — keep every bucket forever."""
+    ann = ast.find_annotation(ad.annotations, "purge")
+    if ann is None:
+        return {}
+    if str(ann.element("enable", "true")).lower() in ("false", "off"):
+        return {}
+    out: dict = {}
+    default = ann.element("retention")
+    if default is not None:
+        for d in ad.durations:
+            out[d] = parse_span_ms(default)
+    seen = set()
+    for name, dur in _DUR_NAMES.items():
+        if dur in seen or dur not in ad.durations:
+            continue
+        v = ann.element(name) if len(ann.elements) > 1 or default is None \
+            else None
+        if v is not None and v != default:
+            out[dur] = parse_span_ms(v)
+            seen.add(dur)
+    if not out:
+        raise PlanError(
+            f"aggregation {ad.id!r}: @purge needs a retention span "
+            f"(e.g. @purge(retention='1 hour'))")
+    return out
+
+
 def bucket_starts(ts: np.ndarray, dur: Duration) -> np.ndarray:
     """Vectorized bucket start (ms) per timestamp; months/years use
     calendar boundaries via numpy datetime64 truncation (the reference
@@ -194,16 +242,29 @@ class AggregationRuntime(QueryPlan):
         self.n_bases = sum(len(_BASES[s.name]) for s in self.sites)
         self.store: dict = {d: {} for d in self.durations}
 
-        # opt-in device path for the segmented reductions (SURVEY §5: the
-        # incremental tree as segmented scans on TPU).  Default is the host
-        # numpy path: through a tunneled chip every device->host pull pays
-        # ~100 ms latency, which dwarfs the reduction itself at typical
-        # batch sizes — on a locally-attached TPU flip it on.
+        # @purge retention (reference: @purge/@retentionPeriod on the
+        # aggregation definition): buckets whose start falls behind the
+        # newest seen start minus the duration's retention are evicted
+        # on ingest.  None = keep forever (and analyzer rule SA15 warns
+        # when that meets an unbounded group-by).
+        self.retention_ms: dict = _parse_retention(ad)
+        self.evicted: dict = {d: 0 for d in self.durations}
+        self._newest: dict = {d: None for d in self.durations}
+
+        # Placement (docs/AGGREGATION.md "Device lowering"):
+        #   default   device-RESIDENT plan (core/agg_device.py) — bucket
+        #             state lives on device, host touch on query only;
+        #   'always'  the legacy per-batch device reduce (kernel per
+        #             batch, store on host) — kept for mesh sharding;
+        #   'off'     host numpy path (also the forced-path differential
+        #             lever).  Ineligible shapes (calendar durations,
+        #             failed jax import) demote to host with a D-AGG
+        #             record visible in rt.explain().
         da = ast.find_annotation(rt.app.annotations, "app:deviceAggregations")
-        self.device = (da is not None
-                       and str(da.element()).lower() in ("always", "true")
-                       and Duration.MONTHS not in self.durations
-                       and Duration.YEARS not in self.durations)
+        mode = str(da.element()).lower() if da is not None else "auto"
+        calendar = (Duration.MONTHS in self.durations
+                    or Duration.YEARS in self.durations)
+        self.device = mode in ("always", "true") and not calendar
         self._dev_cache: dict = {}      # padded n -> jitted kernel
         # multi-chip: events shard over devices, each computes its
         # shard's per-(bucket, group) partials, and the commutative base
@@ -211,6 +272,42 @@ class AggregationRuntime(QueryPlan):
         # merge that already combines batches into the store
         from .planner import mesh_for
         self._mesh = mesh_for(rt, "shard") if self.device else None
+        self.device_plan = None
+        if not self.device:
+            self._plan_device(rt, ad, mode, calendar)
+
+    def _plan_device(self, rt, ad, mode: str, calendar: bool) -> None:
+        """Build the device-resident plan, or record WHY not (D-AGG)."""
+        import os
+        env = os.environ.get("SIDDHI_AGG_DEVICE", "").lower()
+        if mode in ("off", "never", "false", "host"):
+            rt.placement.demote(
+                ad.id, "D-AGG",
+                f"@app:deviceAggregations({mode!r}) chose the host path",
+                alternative="device-agg")
+            return
+        if env in ("0", "off", "host"):
+            rt.placement.demote(
+                ad.id, "D-AGG",
+                "SIDDHI_AGG_DEVICE env opt-out chose the host path",
+                alternative="device-agg")
+            return
+        if calendar:
+            rt.placement.demote(
+                ad.id, "D-AGG",
+                "month/year durations need calendar (datetime64) bucket "
+                "truncation — host path",
+                alternative="device-agg")
+            return
+        try:
+            from .agg_device import DeviceAggregationPlan
+            from .autotune import agg_capacity_for
+            cap = agg_capacity_for(rt, payload=None)
+            self.device_plan = DeviceAggregationPlan(self, cap)
+        except Exception as e:          # jax missing / backend init failed
+            rt.placement.demote(
+                ad.id, "D-AGG", "device aggregation plan unavailable",
+                cause=e, alternative="device-agg")
 
     # -- ingest (vectorized segmented reduction) -----------------------------
 
@@ -256,6 +353,10 @@ class AggregationRuntime(QueryPlan):
 
         # integer views of group columns for exact vectorized unique
         gints = [self._int_view(c) for c in gcols]
+        if self.device_plan is not None:
+            self._ingest_device_resident(ts, gints, gcols, vals)
+            self._enforce_retention()
+            return []
         if self.device:
             per_dur = self._reduce_device(ts, gints, vals)
         else:
@@ -274,7 +375,58 @@ class AggregationRuntime(QueryPlan):
                     st[key] = new
                 else:
                     st[key] = self._merge(old, new)
+            if len(buckets_of):
+                top = int(buckets_of.max())
+                if self._newest[dur] is None or top > self._newest[dur]:
+                    self._newest[dur] = top
+        self._enforce_retention()
         return []
+
+    def _ingest_device_resident(self, ts, gints, gcols, vals) -> None:
+        """Per duration: host computes the batch's unique (bucket,
+        group) segments (the same np.unique the host reduce uses, so
+        keys match bit-for-bit), the device plan segment-reduces the
+        bases and scatter-merges them into the resident bucket store —
+        no per-event host work, no D2H until somebody queries."""
+        vals64 = [np.ascontiguousarray(v, dtype=np.float64) for v in vals]
+        for dur in self.durations:
+            buckets = bucket_starts(ts, dur)
+            segs = np.stack([buckets, *gints], axis=1) if gints \
+                else buckets[:, None]
+            uniq, inv = np.unique(segs, axis=0, return_inverse=True)
+            m = len(uniq)
+            first_rows = np.empty(m, dtype=np.int64)
+            first_rows[inv[::-1]] = np.arange(len(inv))[::-1]
+            gkeys = [tuple(self._decode_gval(c[int(r)], a)
+                           for c, a in zip(gcols, self.group_attrs))
+                     for r in first_rows]
+            self.device_plan.ingest(dur, uniq[:, 0], gkeys,
+                                    inv.astype(np.int32), vals64)
+            top = int(uniq[:, 0].max())
+            if self._newest[dur] is None or top > self._newest[dur]:
+                self._newest[dur] = top
+
+    def _enforce_retention(self) -> None:
+        """@purge: drop buckets older than newest-start minus retention.
+        Device-resident stores evict host-side only (slot frees; the
+        stale device row is overwritten on reuse)."""
+        if not self.retention_ms:
+            return
+        for dur in self.durations:
+            r = self.retention_ms.get(dur)
+            newest = self._newest[dur]
+            if r is None or newest is None:
+                continue
+            cutoff = newest - r
+            if self.device_plan is not None:
+                self.evicted[dur] += self.device_plan.evict_before(
+                    dur, cutoff)
+                continue
+            st = self.store[dur]
+            doomed = [k for k in st if k[0] < cutoff]
+            for k in doomed:
+                del st[k]
+            self.evicted[dur] += len(doomed)
 
     def _reduce_host(self, ts, gints, vals):
         """numpy segmented reduction; returns per duration
@@ -481,6 +633,14 @@ class AggregationRuntime(QueryPlan):
 
     # -- query side (within/per selection) -----------------------------------
 
+    def _materialize(self) -> None:
+        """Pull device-resident bucket state into the host dict stores
+        (no-op on the host path, and per-duration dirty-gated on the
+        device path) — every read surface (store queries, snapshots)
+        calls this first so both paths share one store format."""
+        if self.device_plan is not None:
+            self.device_plan.sync_into(self.store)
+
     def rows_between(self, per: Duration, t0: Optional[int],
                      t1: Optional[int]) -> list:
         """Output rows [(bucket_start, env)] for buckets of `per` whose
@@ -489,6 +649,7 @@ class AggregationRuntime(QueryPlan):
             raise PlanError(
                 f"aggregation {self.ad.id!r}: per-duration {per.value!r} not "
                 f"in defined range {[d.value for d in self.durations]}")
+        self._materialize()
         out = []
         for (start, gkey), bases in sorted(self.store[per].items()):
             if t0 is not None and start < t0:
@@ -534,6 +695,7 @@ class AggregationRuntime(QueryPlan):
     # -- snapshot ------------------------------------------------------------
 
     def state_dict(self) -> dict:
+        self._materialize()
         return {"store": {d.value: {k: list(v) for k, v in s.items()}
                           for d, s in self.store.items()}}
 
@@ -543,6 +705,41 @@ class AggregationRuntime(QueryPlan):
                       for dv, s in d["store"].items()}
         for dur in self.durations:           # tolerate missing durations
             self.store.setdefault(dur, {})
+        for dur, st in self.store.items():
+            self._newest[dur] = (max(k[0] for k in st) if st else None)
+        if self.device_plan is not None:
+            self.device_plan.load_from(self.store)
+
+    # -- telemetry (statistics()["aggregation"] / siddhi_tpu_agg_*) ----------
+
+    def group_count(self) -> int:
+        """Distinct live group keys, measured on the finest duration
+        (group cardinality is duration-invariant until retention evicts
+        a key's last bucket)."""
+        fine = self.durations[0]
+        if self.device_plan is not None:
+            keys = self.device_plan.rings[fine].key_to_slot
+        else:
+            keys = self.store[fine]
+        return len({g for (_b, g) in keys})
+
+    def metrics(self) -> dict:
+        durs = {}
+        for d in self.durations:
+            live = (self.device_plan.live_buckets(d)
+                    if self.device_plan is not None
+                    else len(self.store[d]))
+            ent = {"buckets": live, "evicted": self.evicted[d]}
+            if self.device_plan is not None:
+                ent["capacity"] = self.device_plan.capacity(d)
+            r = self.retention_ms.get(d) if self.retention_ms else None
+            if r is not None:
+                ent["retention_ms"] = r
+            durs[d.name] = ent
+        return {"device": bool(self.device or self.device_plan is not None),
+                "resident": self.device_plan is not None,
+                "groups": self.group_count(),
+                "durations": durs}
 
 
 # ---------------------------------------------------------------------------

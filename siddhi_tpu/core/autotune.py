@@ -54,7 +54,7 @@ from ..utils.locks import new_lock
 
 CACHE_VERSION = 1
 GEOMETRY_KEYS = ("batch", "pipeline_depth", "chunk_lanes", "lane_pack",
-                 "plan_family")
+                 "plan_family", "agg_capacity")
 PLAN_FAMILIES = ("filter", "window", "join", "pattern", "multi_query", "app")
 # pattern-kernel execution families (docs/PERFORMANCE.md "Plan families"):
 # seq = persistent sequential-in-T NFA scan, chunk = stateless chunked-halo
@@ -79,6 +79,7 @@ class Geometry:
     chunk_lanes: Optional[int] = None       # chunked-NFA lane count K
     lane_pack: Optional[int] = None         # fused multi-query lanes/kernel
     plan_family: Optional[str] = None       # pattern family (PATTERN_FAMILIES)
+    agg_capacity: Optional[int] = None      # device agg bucket-ring slots
 
     def to_dict(self) -> dict:
         return {k: getattr(self, k) for k in GEOMETRY_KEYS
@@ -471,6 +472,23 @@ def fused_lane_pack_for(rt, group_sig) -> int:
         if g is not None and g.lane_pack is not None:
             return g.lane_pack
     return 0
+
+
+def agg_capacity_for(rt, payload=None, default: int = 1024) -> int:
+    """Initial slot count of the device-resident aggregation bucket
+    store, per duration (core/agg_device.py; the ring doubles on
+    overflow so this is a starting geometry, not a bound).
+    @app:aggCapacity wins, then the tuning cache, then the default —
+    the same precedence every other geometry knob applies."""
+    an = ast.find_annotation(rt.app.annotations, "app:aggCapacity")
+    if an is not None:
+        return max(8, int(an.element()))
+    tn = getattr(rt, "tuner", None)
+    if tn is not None and payload is not None:
+        g = tn.lookup("app", payload)
+        if g is not None and g.agg_capacity is not None:
+            return max(8, g.agg_capacity)
+    return default
 
 
 # ---------------------------------------------------------------------------

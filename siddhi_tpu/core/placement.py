@@ -36,6 +36,9 @@ Demotion rule ids (docs/ANALYSIS.md "Demotion records"):
                 or failed build validation) in favor of another family
   D-QUARANTINE  the runtime degradation ladder swapped a device plan for
                 its interpreter twin after consecutive dispatch failures
+  D-AGG         an incremental aggregation stayed on the host reduce path
+                instead of the device-resident bucket store (calendar
+                durations, explicit opt-out, or jax unavailable)
 """
 from __future__ import annotations
 
@@ -55,14 +58,16 @@ DEMOTION_RULES = {
     "D-PARTITION": "partitioned pattern fell back to host clones",
     "D-FAMILY": "pattern plan family rejected",
     "D-QUARANTINE": "runtime ladder quarantined the plan",
+    "D-AGG": "aggregation stayed on the host reduce path",
 }
 
 # rule ids whose records mean the query itself left (or never reached)
 # the device path — D-FAMILY keeps the query on device under another
-# family and D-FUSED only rejects the fused-lane packing (the query may
-# still plan onto the device individually), so neither counts toward
-# `interp_demotions`
-_INTERP_RULES = frozenset(DEMOTION_RULES) - {"D-FAMILY", "D-FUSED"}
+# family, D-FUSED only rejects the fused-lane packing (the query may
+# still plan onto the device individually), and D-AGG concerns the
+# aggregation state plane, not a query's execution path — so none of
+# the three counts toward `interp_demotions`
+_INTERP_RULES = frozenset(DEMOTION_RULES) - {"D-FAMILY", "D-FUSED", "D-AGG"}
 
 
 @dataclass
@@ -263,12 +268,34 @@ def explain(rt) -> dict:
             queries[base] = ent
         else:                # another per-key clone of the same query
             prev["instances"] = prev.get("instances", 1) + 1
+    # the queryable-state plane: per-aggregation placement (device-
+    # resident vs host), retention/eviction accounting, and the D-AGG
+    # reason chain for anything that stayed on the host reduce path
+    aggs: dict = {}
+    for an, a in sorted(getattr(rt, "aggregations", {}).items()):
+        ent = {"path": ("device-resident"
+                        if getattr(a, "device_plan", None) is not None
+                        else "device-batch" if getattr(a, "device", False)
+                        else "host"),
+               "durations": [d.name for d in a.durations]}
+        ret = getattr(a, "retention_ms", None)
+        if ret:
+            ent["retention_ms"] = {d.name: v for d, v in sorted(
+                ret.items(), key=lambda kv: kv[0].approx_millis)}
+        ev = getattr(a, "evicted", None)
+        if ev and any(ev.values()):
+            ent["evicted"] = {d.name: n for d, n in ev.items() if n}
+        dems = [d.to_dict() for d in rt.placement.for_query(an)]
+        if dems:
+            ent["demotions"] = dems
+        aggs[an] = ent
     # demotions whose query never produced a plan entry (fused-group
     # probes keyed by candidate names, partition clones not yet
     # instantiated) still surface at the top level
     return {
         "app": rt.app.name,
         "queries": {k: queries[k] for k in sorted(queries)},
+        **({"aggregations": aggs} if aggs else {}),
         "demotions": [d.to_dict() for d in rt.placement.records()],
         "placement": summary(rt),
         # the durability plane's EXPLAIN entry: the SAME block

@@ -747,9 +747,21 @@ class SiddhiAppRuntime:
         """Execute an on-demand query against tables / named windows /
         aggregations; returns [(timestamp_ms, row_tuple)].  Compiled form
         is cached per query text (reference LRU-caches similarly)."""
+        return self.query_with_schema(text)[1]
+
+    def query_with_schema(self, text: str) -> tuple:
+        """query() plus the compiled output schema -> (StreamSchema,
+        rows) — the wire RESULT path needs the column names/types to
+        encode the columnar reply; REST and in-process callers share
+        this one compile/validate/execute path."""
         from ..query.parser import parse_store_query
         from .store import StoreQueryExec
-        with self._lock:
+        import time as _time
+        # Take the net feed gate BEFORE the runtime lock (the same order
+        # as net/server.py make_work): net feeds hold the gate across
+        # admission -> feed, so a store query racing a frame flush can
+        # never observe a half-applied batch.
+        with self._net_gate, self._lock:
             exec_ = self._store_cache.get(text)
             if exec_ is None:
                 if len(self._store_cache) >= 64:   # bounded like the
@@ -762,7 +774,12 @@ class SiddhiAppRuntime:
             else:
                 self._store_cache[text] = self._store_cache.pop(text)  # LRU touch
             self.flush()
-            return exec_.execute()
+            t0 = _time.perf_counter()
+            rows = exec_.execute()
+            self.stats.observe_store_query(
+                _time.perf_counter() - t0, len(rows),
+                trace=self.current_trace())
+            return exec_.out_schema, rows
 
     def config_reader(self, namespace: str, name: str):
         """ConfigReader for one extension instance (reference:

@@ -796,6 +796,53 @@ def render_prometheus(reports: dict, openmetrics: bool = False) -> str:
             doc.add("siddhi_tpu_tuning_cache_entries", "gauge",
                     "persisted geometry winners in the tuning cache",
                     al, tun.get("tuning_cache_entries"))
+        # queryable-state series (core/aggregation.py): per-duration
+        # bucket/eviction gauges, group cardinality, and the store-query
+        # latency histogram (exemplar-carrying, like the stream
+        # dispatch histogram above)
+        ag = rep.get("aggregation")
+        if ag:
+            for an, m in (ag.get("aggregations") or {}).items():
+                gl = {**al, "aggregation": an}
+                doc.add("siddhi_tpu_agg_groups", "gauge",
+                        "live group keys per aggregation", gl,
+                        m.get("groups"))
+                doc.add("siddhi_tpu_agg_device", "gauge",
+                        "aggregation lowered to the device plan "
+                        "(1 device, 0 host; rt.explain() has the D-AGG "
+                        "chain)", gl, 1 if m.get("device") else 0)
+                for dn, dd in (m.get("durations") or {}).items():
+                    dl = {**gl, "duration": dn}
+                    doc.add("siddhi_tpu_agg_buckets", "gauge",
+                            "live rollup buckets per aggregation "
+                            "duration", dl, dd.get("buckets"))
+                    doc.add("siddhi_tpu_agg_evicted_total", "counter",
+                            "rollup buckets evicted by @purge retention "
+                            "per aggregation duration", dl,
+                            dd.get("evicted", 0))
+            sq = ag.get("store_query")
+            if sq:
+                doc.add("siddhi_tpu_agg_store_queries_total", "counter",
+                        "on-demand store queries executed (REST + wire "
+                        "QUERY frames)", al, sq.get("batches", 0))
+                doc.add("siddhi_tpu_agg_store_query_rows_total", "counter",
+                        "rows returned by on-demand store queries", al,
+                        sq.get("events", 0))
+                bk = sq.get("buckets")
+                if bk:
+                    hn = "siddhi_tpu_agg_store_query_latency_seconds"
+                    hh = ("store-query execution latency histogram; "
+                          "buckets carry trace-id exemplars")
+                    ex = sq.get("exemplars") or {}
+                    for le, c in bk.items():
+                        doc.add(hn, "histogram", hh, {**al, "le": le}, c,
+                                suffix="_bucket",
+                                exemplar=tuple(ex[le]) if le in ex
+                                else None)
+                    doc.add(hn, "histogram", hh, al,
+                            sq.get("seconds", 0.0), suffix="_sum")
+                    doc.add(hn, "histogram", hh, al,
+                            sq.get("batches", 0), suffix="_count")
         # durability series (core/wal.py): WAL volume, fsync latency,
         # segment churn, and the crash-recovery gauges
         dur = rep.get("durability")
@@ -982,6 +1029,10 @@ class StatisticsManager:
         # fault dispositions per stream/scope (ALWAYS counted — faults
         # are rare and must be visible even with statistics off)
         self.faults: dict = defaultdict(lambda: defaultdict(int))
+        # on-demand (store) query latency — ALWAYS observed (not gated
+        # on `enabled`): the queryable-state plane is its own surface
+        # (REST + wire QUERY frames) and its p99 is an SLO input
+        self.store_query = Tracker()
         self.tracer = PipelineTracer()
         self._t0 = time.perf_counter()
         self.reporter = None
@@ -1067,6 +1118,15 @@ class StatisticsManager:
         if not self.enabled:
             return
         self.stages[name].observe(seconds, events)
+
+    def observe_store_query(self, seconds: float, rows: int,
+                            trace=None) -> None:
+        """One executed store query (runtime.query_with_schema) — rows
+        count as the tracker's `events`; a traced caller (the net QUERY
+        path under a TRACE-stamped connection) lands a histogram
+        exemplar linking the latency bucket to its span tree."""
+        tid = getattr(trace, "trace_id", None) if trace is not None else None
+        self.store_query.observe(seconds, rows, trace_id=tid)
 
     def on_fault(self, scope: str, action: str) -> None:
         """One fault disposition (scope = stream or sink label, action =
@@ -1216,6 +1276,26 @@ class StatisticsManager:
                 net.setdefault(s.stream_id, {}).update(m)
         if net:
             rep["net"] = net
+        # queryable-state plane (core/aggregation.py): per-aggregation
+        # bucket/group/eviction gauges + the store-query latency
+        # histogram.  ALWAYS present when an aggregation exists or a
+        # store query ran (not gated on `enabled`) — the agg series on
+        # /metrics and the bench matrix both read this block
+        agg: dict = {}
+        for name, a in list(getattr(self.rt, "aggregations", {}).items()):
+            try:
+                m = a.metrics()
+            except Exception:
+                continue
+            if m:
+                agg[name] = m
+        if agg or self.store_query.batches:
+            ab: dict = {}
+            if agg:
+                ab["aggregations"] = agg
+            if self.store_query.batches:
+                ab["store_query"] = self.store_query.as_dict(buckets=True)
+            rep["aggregation"] = ab
         # adaptive execution geometry (core/autotune.py): tuning-cache
         # hit/miss gauges + the SLO controller's state and decision log
         tn = getattr(self.rt, "tuner", None)

@@ -122,6 +122,11 @@ class FrameClient:
         self.bytes_sent = 0
         self._acks: dict = {}
         self._next_token = 1
+        # store-query state: results keyed by token; the SERVER's egress
+        # string dictionary (RESULT string columns ship as codes, their
+        # strings as STRINGS deltas ahead of the RESULT)
+        self._results: dict = {}
+        self._peer_strings: list = [None]       # code 0 = null
 
     @classmethod
     def cols_of_schema(cls, schema) -> list:
@@ -202,6 +207,46 @@ class FrameClient:
                 raise NetClientError("barrier timed out")
         del self._acks[token]
 
+    def query(self, text: str, app: Optional[str] = None,
+              timeout: float = 30.0) -> list:
+        """Run a SiddhiQL store query server-side; returns
+        [(timestamp, row_tuple), ...] exactly as `runtime.query(text)`
+        would — byte-identical values, string columns resolved through
+        the server's egress dictionary (docs/SERVING.md "Store
+        queries").  `app` targets a deployed app by name; omitted, the
+        connection's HELLO-bound app serves (a query-only connection —
+        `stream=None` — defaults `app` to the constructor's)."""
+        token = self._next_token
+        self._next_token += 1
+        if app is None and self.stream is None:
+            app = self.app
+        self._send(fp.encode_query(token, text, app=app))
+        deadline = time.monotonic() + timeout
+        while token not in self._results:
+            f = self._recv_frame(max(0.001, deadline - time.monotonic()))
+            if f is not None:
+                self._on_control(*f)
+            elif time.monotonic() >= deadline:
+                raise NetClientError("query timed out")
+        meta, body = self._results.pop(token)
+        if "error" in meta:
+            raise NetClientError(str(meta["error"]))
+        cols = meta.get("cols", [])
+        ts, views = fp.decode_result_body(body, cols)
+        strs = self._peer_strings
+        str_js = [j for j, c in enumerate(cols) if str(c[1]) == "string"]
+        rows = []
+        for i in range(int(ts.shape[0])):
+            row = [v[i].item() for v in views]
+            for j in str_js:
+                code = int(views[j][i])
+                if code >= len(strs):
+                    raise NetClientError(
+                        "RESULT string code beyond the shipped dictionary")
+                row[j] = strs[code]         # code 0 -> strs[0] is None
+            rows.append((int(ts[i]), tuple(row)))
+        return rows
+
     def close(self) -> None:
         try:
             self._send(fp.encode_frame(fp.BYE))
@@ -239,6 +284,15 @@ class FrameClient:
             self.credit += fp.decode_i64(payload)
         elif ftype == fp.ACK:
             self._acks[fp.decode_u64(payload)] = True
+        elif ftype == fp.STRINGS:
+            # server egress dictionary delta (store-query results)
+            start, new = fp.decode_strings(payload)
+            if start > len(self._peer_strings):
+                raise NetClientError("server STRINGS delta gap")
+            self._peer_strings[start:start + len(new)] = new
+        elif ftype == fp.RESULT:
+            token, meta, body = fp.decode_result(payload)
+            self._results[token] = (meta, body)
         elif ftype == fp.ERROR:
             raise NetClientError(json.loads(payload)["error"])
 
@@ -248,16 +302,18 @@ class TcpFrameClient(FrameClient):
     mid-frame keeps the partial bytes, so control frames can never
     desync the stream."""
 
-    def __init__(self, host: str, port: int, stream: str, cols: list,
+    def __init__(self, host: str, port: int, stream: Optional[str] = None,
+                 cols: Optional[list] = None,
                  app: Optional[str] = None, credit: bool = True,
                  connect_timeout: float = 5.0):
-        super().__init__(app, stream, cols, credit)
+        super().__init__(app, stream, cols or [], credit)
         self.sock = socket.create_connection((host, port),
                                              timeout=connect_timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._rbuf = bytearray()        # append-in-place: O(1) amortized
         self._fq: list = []
-        self.hello()
+        if stream:                      # stream=None: query-only client,
+            self.hello()                # no ingest negotiation at all
 
     def _send(self, data: bytes) -> None:
         self.sock.sendall(data)
@@ -292,16 +348,19 @@ class WsFrameClient(FrameClient):
     Connects to the same NetServer port — the server sniffs the
     upgrade."""
 
-    def __init__(self, host: str, port: int, stream: str, cols: list,
+    def __init__(self, host: str, port: int, stream: Optional[str] = None,
+                 cols: Optional[list] = None,
                  app: Optional[str] = None, credit: bool = True,
                  connect_timeout: float = 5.0):
-        super().__init__(app, stream, cols, credit)
+        super().__init__(app, stream, cols or [], credit)
         self.sock = socket.create_connection((host, port),
                                              timeout=connect_timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._buf = bytearray()
+        self._wsq: list = []            # frames beyond the first per message
         self._handshake(host, port)
-        self.hello()
+        if stream:                      # stream=None: query-only client
+            self.hello()
 
     def _handshake(self, host: str, port: int) -> None:
         key = base64.b64encode(os.urandom(16)).decode()
@@ -338,8 +397,12 @@ class WsFrameClient(FrameClient):
         self.sock.sendall(hdr + mask + (arr ^ m).tobytes())
 
     def _recv_frame(self, timeout: Optional[float]):
-        """Read one ws message, parse the protocol frame inside.
-        Buffer-based: a timeout mid-message keeps the partial bytes."""
+        """Read one ws message, parse the protocol frame(s) inside.
+        Buffer-based: a timeout mid-message keeps the partial bytes.
+        One message may carry several frames (the server batches a
+        STRINGS delta with its RESULT in one write) — extras queue."""
+        if self._wsq:
+            return self._wsq.pop(0)
         while True:
             got = fp.parse_ws_frame_inplace(self._buf)
             if got is None:
@@ -361,8 +424,10 @@ class WsFrameClient(FrameClient):
             if opcode in (0x9, 0xA):        # ping/pong: ignore
                 continue
             frames, rest = fp.parse_buffer(body)
-            if rest or len(frames) != 1:
-                raise fp.FrameError("ws message is not one whole frame")
+            if rest or not frames:
+                raise fp.FrameError(
+                    "ws message is not a whole number of frames")
+            self._wsq.extend(frames[1:])
             return frames[0]
 
     def close(self) -> None:

@@ -85,6 +85,28 @@ revisions for catch-up) down it, the standby streams append-acks back.
         synced) on the standby.  Under semi-sync this is half of the
         primary's durable-ACK barrier.
 
+QUERY/RESULT — wire-served store queries (docs/AGGREGATION.md "Store
+queries over the wire").  A client sends a SiddhiQL store query string;
+the server compiles it once per connection (cached by query text),
+executes it against live tables/windows/aggregations under the runtime
+feed gate, and streams the rows back in the standard columnar DATA
+encoding, string columns as dictionary codes against a SERVER->client
+egress string table shipped as STRINGS deltas before the RESULT.
+
+    QUERY (16), client->server: u64 token, u16 app-name byte length,
+        app-name utf-8 (may be empty: the HELLO-bound app), then the
+        SiddhiQL store query text utf-8 to the end of the payload.
+    RESULT (17), server->client: u64 token (echoing the QUERY), u32
+        meta length, meta JSON {"cols": [[name, type], ...]} (or
+        {"error": "..."} with an empty body — errors ride RESULT, not
+        ERROR, so token correlation survives pipelining), then a
+        DATA-layout body: u32 n_rows, i64 timestamps, each column's
+        raw little-endian buffer in meta-declared order.  `double`
+        columns are always float64 on this plane (store-query rows are
+        host Python floats) regardless of the engine's compute dtype;
+        numeric nulls encode as NaN (floats) / 0 (ints), string nulls
+        as code 0.
+
 docs/SERVING.md carries the normative spec with a worked hex example.
 """
 from __future__ import annotations
@@ -117,13 +139,16 @@ REPL_RECORD = 12
 REPL_SNAPSHOT = 13
 REPL_HEARTBEAT = 14
 REPL_ACK = 15
+QUERY = 16
+RESULT = 17
 
 _TYPE_NAMES = {HELLO: "HELLO", HELLO_OK: "HELLO_OK", DATA: "DATA",
                STRINGS: "STRINGS", CREDIT: "CREDIT", ACK: "ACK",
                ERROR: "ERROR", PING: "PING", BYE: "BYE", TRACE: "TRACE",
                REPL_SUBSCRIBE: "REPL_SUBSCRIBE", REPL_RECORD: "REPL_RECORD",
                REPL_SNAPSHOT: "REPL_SNAPSHOT",
-               REPL_HEARTBEAT: "REPL_HEARTBEAT", REPL_ACK: "REPL_ACK"}
+               REPL_HEARTBEAT: "REPL_HEARTBEAT", REPL_ACK: "REPL_ACK",
+               QUERY: "QUERY", RESULT: "RESULT"}
 
 
 class FrameError(Exception):
@@ -296,6 +321,99 @@ def decode_repl_status(payload: bytes) -> dict:
         raise FrameError(f"bad REPL status payload: {e}") from None
 
 
+# -- QUERY/RESULT (wire-served store queries) -------------------------------
+
+def encode_query(token: int, text: str, app: str = None) -> bytes:
+    """Store-query request: the SiddhiQL text runs server-side against
+    the named app (empty -> the connection's HELLO-bound app)."""
+    ab = (app or "").encode()
+    if len(ab) > 0xFFFF:
+        raise FrameError(f"app name too long for wire ({len(ab)} bytes)")
+    return encode_frame(QUERY, struct.pack("<QH", int(token), len(ab))
+                        + ab + str(text).encode())
+
+
+def decode_query(payload: bytes) -> tuple:
+    """-> (token, app_or_None, query_text)."""
+    if len(payload) < 10:
+        raise FrameError("truncated QUERY payload")
+    token, alen = struct.unpack_from("<QH", payload, 0)
+    if 10 + alen > len(payload):
+        raise FrameError("truncated QUERY app name")
+    try:
+        app = payload[10:10 + alen].decode()
+        text = payload[10 + alen:].decode()
+    except UnicodeDecodeError as e:
+        raise FrameError(f"bad QUERY payload: {e}") from None
+    if not text.strip():
+        raise FrameError("empty QUERY text")
+    return token, (app or None), text
+
+
+def encode_result(token: int, meta: dict, body: bytes = b"") -> bytes:
+    """Store-query reply.  `meta` is {"cols": [[name, type], ...]} (or
+    {"error": str} with an empty body); `body` is a DATA-layout blob
+    from `encode_data_payload`."""
+    mb = json.dumps(meta).encode()
+    return encode_frame(RESULT, struct.pack("<QI", int(token), len(mb))
+                        + mb + body)
+
+
+def decode_result(payload: bytes) -> tuple:
+    """-> (token, meta_dict, body_bytes)."""
+    if len(payload) < 12:
+        raise FrameError("truncated RESULT payload")
+    token, mlen = struct.unpack_from("<QI", payload, 0)
+    if 12 + mlen > len(payload):
+        raise FrameError("truncated RESULT meta")
+    try:
+        meta = json.loads(payload[12:12 + mlen])
+        if not isinstance(meta, dict):
+            raise ValueError("not an object")
+    except (ValueError, UnicodeDecodeError) as e:
+        raise FrameError(f"bad RESULT meta: {e}") from None
+    return token, meta, payload[12 + mlen:]
+
+
+def decode_result_body(body: bytes, cols: list) -> tuple:
+    """RESULT body -> (timestamps view, [column views] in meta order).
+    `cols` is the meta's [[name, type], ...]; string columns come back
+    as int32 server-egress dictionary codes — resolve against the
+    STRINGS deltas the server shipped on this connection.  `double` is
+    always float64 here (see the module docstring)."""
+    from ..core.schema import dtype_of
+    from ..query.ast import AttrType
+    if len(body) < 4:
+        raise FrameError("truncated RESULT body")
+    (n,) = struct.unpack_from("<I", body, 0)
+    off = 4
+    need = 8 * n
+    if off + need > len(body):
+        raise FrameError("truncated RESULT body (timestamps)")
+    ts = np.frombuffer(body, dtype="<i8", count=n, offset=off)
+    off += need
+    out = []
+    for c in cols:
+        name, tname = str(c[0]), str(c[1])
+        try:
+            at = AttrType[tname.upper()]
+        except KeyError:
+            raise FrameError(f"RESULT column {name!r} has unknown type "
+                             f"{tname!r}") from None
+        dt = np.dtype(dtype_of(at, float64=True)).newbyteorder("<")
+        if dt.kind == "O":
+            raise FrameError(f"RESULT object column {name!r} cannot ride "
+                             f"the wire")
+        need = dt.itemsize * n
+        if off + need > len(body):
+            raise FrameError(f"truncated RESULT body (column {name!r})")
+        out.append(np.frombuffer(body, dtype=dt, count=n, offset=off))
+        off += need
+    if off != len(body):
+        raise FrameError(f"RESULT body has {len(body) - off} trailing bytes")
+    return ts, out
+
+
 def encode_strings(new_strings: list, start_code: int = None) -> bytes:
     """String-table delta frame; `new_strings` in code-assignment
     order, the first holding code `start_code`.  The explicit start
@@ -315,10 +433,10 @@ def encode_strings(new_strings: list, start_code: int = None) -> bytes:
     return encode_frame(STRINGS, b"".join(parts))
 
 
-def encode_data(timestamps: np.ndarray, columns: list) -> bytes:
-    """DATA frame from an int64 timestamp array + schema-ordered column
-    arrays (strings already encoded to int32 connection codes).  One
-    `tobytes` per column — no per-event work."""
+def encode_data_payload(timestamps: np.ndarray, columns: list) -> bytes:
+    """The DATA columnar layout (u32 n_rows + i64 timestamps + raw
+    column buffers) WITHOUT the frame envelope — shared by DATA frames
+    and RESULT bodies."""
     ts = np.ascontiguousarray(timestamps, dtype="<i8")
     n = int(ts.shape[0])
     parts = [struct.pack("<I", n), ts.tobytes()]
@@ -328,7 +446,14 @@ def encode_data(timestamps: np.ndarray, columns: list) -> bytes:
             raise FrameError(f"column has {arr.shape[0]} rows, expected {n}")
         parts.append(arr.astype(arr.dtype.newbyteorder("<"),
                                 copy=False).tobytes())
-    return encode_frame(DATA, b"".join(parts))
+    return b"".join(parts)
+
+
+def encode_data(timestamps: np.ndarray, columns: list) -> bytes:
+    """DATA frame from an int64 timestamp array + schema-ordered column
+    arrays (strings already encoded to int32 connection codes).  One
+    `tobytes` per column — no per-event work."""
+    return encode_frame(DATA, encode_data_payload(timestamps, columns))
 
 
 # ---------------------------------------------------------------------------

@@ -36,6 +36,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+import numpy as np
+
 from ..utils.locks import new_lock, new_rlock
 from . import frame as fp
 from .admission import ADMIT, AdmissionController, Work
@@ -219,6 +221,14 @@ class Connection:
         self.schema = None
         self.ctrl: Optional[AdmissionController] = None
         self.remap = fp.StringRemap()
+        # store-query egress dictionary (RESULT string columns): the
+        # mirror of `remap` — codes WE assign, shipped to the peer as
+        # STRINGS deltas ahead of each RESULT.  `_egress_synced` is the
+        # first code the peer has NOT mapped yet; it advances only after
+        # a successful encode, so a query that failed mid-encode re-ships
+        # its orphaned registrations with the next result
+        self._egress = fp.WireStringTable()
+        self._egress_synced = 1
         self.credit_chunk = 0
         self._since_credit = 0
         self._str_cols: list = []
@@ -243,6 +253,11 @@ class Connection:
             return True
         if ftype in (fp.REPL_ACK, fp.REPL_HEARTBEAT):
             self._on_repl_status(fp.decode_repl_status(payload), ftype)
+            return True
+        if ftype == fp.QUERY:
+            # dispatched BEFORE the rt-None check: a query-only
+            # connection never HELLOs (it names its app in the frame)
+            self._on_query(payload)
             return True
         if self.rt is None:
             raise fp.FrameError(
@@ -390,6 +405,75 @@ class Connection:
         else:
             coord.on_heartbeat(status["watermark"])
 
+    # -- store queries (QUERY -> STRINGS? + RESULT) ---------------------------
+
+    def _on_query(self, payload: bytes) -> None:
+        token, app, text = fp.decode_query(payload)
+        if self.send is None:
+            raise fp.FrameError(
+                "QUERY needs a duplex transport (not a ring)")
+        self.server._count(store_queries=1)
+        try:
+            rt = (self.server.query_resolve(app) if app is not None
+                  else self.rt)
+            if rt is None:
+                raise fp.FrameError(
+                    "QUERY names no app and no HELLO bound one")
+            # compile (cached per query text in the runtime) + execute
+            # under the feed gate — the result is a consistent snapshot
+            # against every transport feeding this runtime
+            schema, rows = rt.query_with_schema(text)
+            blob = self._encode_result(token, schema, rows)
+        except Exception as e:
+            # compile/execute/resolve failures ride RESULT, not ERROR,
+            # so the client correlates them by token — and a bad query
+            # never costs the producer its ingest connection
+            msg = str(e).strip("'\"") or type(e).__name__
+            blob = fp.encode_result(token, {"error": msg})
+        self._reply(blob)
+
+    def _encode_result(self, token: int, schema, rows) -> bytes:
+        """(optional STRINGS delta +) RESULT frame bytes for one store
+        query's out_schema + rows.  Doubles ship float64 (exactness
+        beats the ingest plane's f32 compaction here); numeric nulls
+        encode NaN/0, string nulls code 0."""
+        from ..core.schema import dtype_of
+        from ..query.ast import AttrType
+        meta_cols = [[a.name, a.type.name.lower()]
+                     for a in schema.attributes]
+        ts = np.fromiter((r[0] for r in rows), dtype=np.int64,
+                         count=len(rows))
+        cols = []
+        for j, a in enumerate(schema.attributes):
+            vals = [r[1][j] for r in rows]
+            if a.type == AttrType.STRING:
+                codes, _new = self._egress.encode_column(vals)
+                cols.append(codes)
+                continue
+            dt = np.dtype(dtype_of(a.type, float64=True))
+            if dt.kind == "O":
+                raise fp.FrameError(
+                    f"RESULT object column {a.name!r} cannot ride the "
+                    f"wire")
+            if dt.kind == "f":
+                arr = np.array([np.nan if v is None else v for v in vals],
+                               dtype=dt)
+            else:
+                arr = np.array([0 if v is None else v for v in vals],
+                               dtype=dt)
+            cols.append(arr)
+        body = fp.encode_data_payload(ts, cols)
+        out = []
+        delta = self._egress.strings_from(self._egress_synced)
+        if delta:
+            out.append(fp.encode_strings(delta,
+                                         start_code=self._egress_synced))
+        self._egress_synced = len(self._egress)
+        out.append(fp.encode_result(token, {"cols": meta_cols}, body))
+        # one write: the delta can never arrive after the RESULT that
+        # needs it, even with the WalShipper sharing this wire
+        return b"".join(out)
+
     def _on_data(self, payload: bytes) -> None:
         rt = self.rt
         try:
@@ -479,15 +563,20 @@ class NetServer:
     def __init__(self, resolve_fn: Callable, host: str = "127.0.0.1",
                  port: int = 0, credit: int = 64, name: str = "siddhi-net",
                  listen: bool = True,
-                 repl_resolve: Optional[Callable] = None):
+                 repl_resolve: Optional[Callable] = None,
+                 query_resolve: Optional[Callable] = None):
         """`listen=False` builds a listener-less server — no TCP socket
         at all — for transports that only need the connection/feed-gate
         machinery (shm-ring consumers via attach_ring).  `repl_resolve`
         maps an app name to its runtime for REPL_SUBSCRIBE links
         (raising KeyError rejects the subscription); None disables
-        replication on this front door."""
+        replication on this front door.  `query_resolve` maps an app
+        name to its runtime for QUERY frames naming an app explicitly
+        (the HELLO-bound runtime serves app-less queries either way);
+        None restricts store queries to HELLO-bound connections."""
         self._resolve = resolve_fn
         self._repl_resolve = repl_resolve
+        self._query_resolve = query_resolve
         self.credit = int(credit)
         self.name = name
         self._sock = None
@@ -518,6 +607,7 @@ class NetServer:
         self.bytes_in = 0
         self.credit_granted = 0
         self.protocol_errors = 0
+        self.store_queries = 0
 
     # -- wiring -------------------------------------------------------------
 
@@ -530,6 +620,14 @@ class NetServer:
                 f"replication is not enabled on this endpoint "
                 f"(no repl_resolve for app {app!r})")
         return self._repl_resolve(app)
+
+    def query_resolve(self, app: str):
+        if self._query_resolve is None:
+            raise KeyError(
+                f"named-app store queries are not enabled on this "
+                f"endpoint (no query_resolve for app {app!r}) — "
+                f"HELLO-bind the connection instead")
+        return self._query_resolve(app)
 
     def stopping(self) -> bool:
         return self._stop.is_set()
@@ -844,7 +942,8 @@ class NetServer:
              "wire_events": self.events_in,
              "wire_bytes": self.bytes_in,
              "credit_granted": self.credit_granted,
-             "protocol_errors": self.protocol_errors}
+             "protocol_errors": self.protocol_errors,
+             "store_queries": self.store_queries}
         if self._rings:
             occ = [r.occupancy() for r, _ in self._rings]
             m["rings"] = len(self._rings)

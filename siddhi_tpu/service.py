@@ -361,7 +361,8 @@ class SiddhiService:
             from .net.server import NetServer
             self.net = NetServer(self._net_resolve, port=net_port,
                                  name="siddhi-service-net",
-                                 repl_resolve=self._repl_resolve)
+                                 repl_resolve=self._repl_resolve,
+                                 query_resolve=self._query_resolve)
             self.net_port = self.net.port
 
     # -- data plane -------------------------------------------------------
@@ -396,6 +397,15 @@ class SiddhiService:
         """REPL_SUBSCRIBE resolution for the data plane: the app's
         runtime (the shipper-side checks — durability, standby role —
         live in net/server.py)."""
+        rt = self.runtimes.get(app or "")
+        if rt is None:
+            raise KeyError(f"no deployed app {app!r}")
+        return rt
+
+    def _query_resolve(self, app: str):
+        """QUERY-frame resolution: store queries naming an app run
+        against its deployed runtime — the same compile cache and feed
+        gate `POST /siddhi/artifact/query` goes through."""
         rt = self.runtimes.get(app or "")
         if rt is None:
             raise KeyError(f"no deployed app {app!r}")

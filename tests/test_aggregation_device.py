@@ -1,0 +1,311 @@
+"""Device-resident aggregation (core/agg_device.py): forced-path
+differential matrix (device bucket stores byte-identical to the host
+reduce path), @purge retention/eviction, capacity growth, and the
+placement/telemetry surfaces (docs/AGGREGATION.md)."""
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.query.ast import Duration
+
+def _app(select, group_by, durations, header="", agg_header=""):
+    gb = f"group by {group_by}\n" if group_by else ""
+    return (f"{header}"
+            f"define stream S (k string, k2 string, v double, w double, "
+            f"ts long);\n"
+            f"{agg_header}"
+            f"define aggregation A\nfrom S\nselect {select}\n{gb}"
+            f"aggregate by ts every {durations};\n")
+
+
+def _feed(rt, rows):
+    h = rt.input_handler("S")
+    h.send(rows)
+    rt.flush()
+
+
+def _rows(rng, n, nk=4, nk2=3, span_ms=400_000):
+    """n events over ~span_ms of event time: raw uniform doubles —
+    byte-identity must hold without any value quantization because both
+    paths fold events in the same order."""
+    ts0 = 1_700_000_000_000
+    ts = np.sort(ts0 + rng.integers(0, span_ms, n))
+    return [(f"K{rng.integers(0, nk)}", f"G{rng.integers(0, nk2)}",
+             float(rng.uniform(-50, 150)), float(rng.uniform(0, 9)),
+             int(t)) for t in ts]
+
+
+def _run(app, batches):
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(app)
+    rt.start()
+    for b in batches:
+        _feed(rt, b)
+    agg = rt.aggregations["A"]
+    state = agg.state_dict()
+    mgr.shutdown()
+    return state, agg
+
+
+# ---------------------------------------------------------------------------
+# forced-path differential matrix: every base function x group-by arity
+# 0/1/2 x duration ladders — the device store must be BYTE-IDENTICAL to
+# the host reduce path's (same floats, same keys), not merely close
+# ---------------------------------------------------------------------------
+
+MATRIX = [
+    ("sum(v) as s", "k", "sec, min"),
+    ("avg(v) as a", "k, k2", "sec, min, hour"),
+    ("min(v) as lo, max(v) as hi", None, "sec"),
+    ("count() as n", "k", "sec, min"),
+    ("sum(v) as s, avg(w) as a, min(v) as lo, max(w) as hi, count() as n",
+     "k, k2", "sec, min, hour, day"),
+    ("sum(v) as s, avg(v) as a", None, "sec, min"),
+]
+
+
+@pytest.mark.parametrize("select,group_by,durations", MATRIX)
+def test_device_resident_matches_host_bytes(select, group_by, durations):
+    batches = [_rows(np.random.default_rng(17 + i), 257 + 31 * i)
+               for i in range(4)]
+    dev_state, dev_agg = _run(_app(select, group_by, durations), batches)
+    host_state, host_agg = _run(
+        _app(select, group_by, durations,
+             header="@app:deviceAggregations('off')\n"), batches)
+    assert dev_agg.device_plan is not None and host_agg.device_plan is None
+    assert dev_state == host_state
+
+
+def test_differential_query_rows_identical():
+    """The user-visible surface too: rt.query rows (finalized avg etc.)
+    equal between the paths, at every duration level."""
+    select = "k, sum(v) as s, avg(v) as a, count() as n"
+    batches = [_rows(np.random.default_rng(5), 900, span_ms=7_200_000)]
+    results = {}
+    for name, header in (("dev", ""),
+                         ("host", "@app:deviceAggregations('off')\n")):
+        mgr = SiddhiManager()
+        rt = mgr.create_app_runtime(_app(select, "k", "sec, min, hour",
+                                         header=header))
+        rt.start()
+        for b in batches:
+            _feed(rt, b)
+        results[name] = {
+            per: sorted(rt.query(
+                f"from A within 0L, 4000000000000L per '{per}' "
+                f"select k, s, a, n"))
+            for per in ("sec", "min", "hour")}
+        mgr.shutdown()
+    assert results["dev"] == results["host"]
+    assert all(results["dev"][per] for per in ("sec", "min", "hour"))
+
+
+def test_incremental_merge_across_batches():
+    """A key seen in several batches merges into the SAME device slot
+    (old op new), not a fresh row per batch."""
+    app = _app("k, sum(v) as s, min(v) as lo, max(v) as hi, count() as n",
+               "k", "sec")
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(app)
+    rt.start()
+    _feed(rt, [("A", "x", 10.25, 0.0, 1000), ("A", "x", 2.5, 0.0, 1500)])
+    _feed(rt, [("A", "x", -4.0, 0.0, 1200), ("A", "x", 100.0, 0.0, 1900)])
+    rows = rt.query("from A within 0L, 10000L per 'sec' "
+                    "select k, s, lo, hi, n")
+    assert rows == [(1000, ("A", 108.75, -4.0, 100.0, 4))]
+    agg = rt.aggregations["A"]
+    assert agg.device_plan.live_buckets(Duration.SECONDS) == 1
+    mgr.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# placement: the D-AGG demotion taxonomy + explain() surfaces
+# ---------------------------------------------------------------------------
+
+def test_default_is_device_resident_and_explained():
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(_app("sum(v) as s", "k", "sec, min"))
+    agg = rt.aggregations["A"]
+    assert agg.device_plan is not None and not agg.device
+    ex = rt.explain()["aggregations"]["A"]
+    assert ex["path"] == "device-resident"
+    assert ex["durations"] == ["SECONDS", "MINUTES"]
+    mgr.shutdown()
+
+
+def test_opt_out_demotes_with_d_agg():
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(_app(
+        "sum(v) as s", "k", "sec",
+        header="@app:deviceAggregations('off')\n"))
+    agg = rt.aggregations["A"]
+    assert agg.device_plan is None and not agg.device
+    ex = rt.explain()["aggregations"]["A"]
+    assert ex["path"] == "host"
+    assert any(d["rule_id"] == "D-AGG" for d in ex["demotions"])
+    mgr.shutdown()
+
+
+def test_env_opt_out_demotes(monkeypatch):
+    monkeypatch.setenv("SIDDHI_AGG_DEVICE", "off")
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(_app("sum(v) as s", "k", "sec"))
+    agg = rt.aggregations["A"]
+    assert agg.device_plan is None
+    ex = rt.explain()["aggregations"]["A"]
+    assert any(d["rule_id"] == "D-AGG" for d in ex["demotions"])
+    mgr.shutdown()
+
+
+def test_calendar_durations_stay_on_host():
+    """MONTHS/YEARS buckets are calendar-truncated (datetime64 math on
+    the host); the resident plan declines them loudly instead of
+    approximating."""
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(_app("k, sum(v) as s", "k", "sec, month"))
+    agg = rt.aggregations["A"]
+    assert agg.device_plan is None
+    ex = rt.explain()["aggregations"]["A"]
+    assert ex["path"] == "host"
+    assert any(d["rule_id"] == "D-AGG" and "calendar" in d["reason"]
+               for d in ex["demotions"])
+    # the host fallback still aggregates correctly
+    _feed(rt, [("A", "x", 1.5, 0.0, 1000), ("A", "x", 2.0, 0.0, 1500)])
+    rows = rt.query("from A within 0L, 10000L per 'sec' select k, s")
+    assert rows == [(1000, ("A", 3.5))]
+    mgr.shutdown()
+
+
+def test_legacy_always_mode_keeps_batch_kernel():
+    # @app:deviceAggregations('always') keeps the pre-existing per-batch
+    # device reduce semantics (mesh-shardable) — not the resident plan
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(_app(
+        "sum(v) as s", "k", "sec",
+        header="@app:deviceAggregations('always')\n"))
+    agg = rt.aggregations["A"]
+    assert agg.device and agg.device_plan is None
+    assert rt.explain()["aggregations"]["A"]["path"] == "device-batch"
+    mgr.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# @purge retention: per-duration eviction, host/device parity
+# ---------------------------------------------------------------------------
+
+def test_purge_evicts_old_buckets_both_paths():
+    rows = ([("A", "x", 1.0, 0.0, 1_000)] +
+            [("A", "x", 2.0, 0.0, 5_000)] +
+            [("B", "x", 3.0, 0.0, 600_000)])  # 10 min later
+    states = {}
+    for name, header in (("dev", ""),
+                         ("host", "@app:deviceAggregations('off')\n")):
+        mgr = SiddhiManager()
+        rt = mgr.create_app_runtime(_app(
+            "k, sum(v) as s", "k", "sec, min", header=header,
+            agg_header="@purge(retention='1 min')\n"))
+        rt.start()
+        _feed(rt, rows[:2])
+        agg = rt.aggregations["A"]
+        assert agg.retention_ms == {Duration.SECONDS: 60_000,
+                                    Duration.MINUTES: 60_000}
+        assert agg.evicted[Duration.SECONDS] == 0
+        _feed(rt, rows[2:])      # newest bucket moves -> cutoff passes
+        assert agg.evicted[Duration.SECONDS] == 2, name
+        assert agg.evicted[Duration.MINUTES] == 1, name
+        states[name] = agg.state_dict()
+        # evicted buckets are gone from query results too
+        got = rt.query("from A within 0L, 4000000000000L per 'sec' "
+                       "select k, s")
+        assert got == [(600_000, ("B", 3.0))], name
+        ex = rt.explain()["aggregations"]["A"]
+        assert ex["evicted"] == {"SECONDS": 2, "MINUTES": 1}
+        assert ex["retention_ms"] == {"SECONDS": 60_000,
+                                      "MINUTES": 60_000}
+        mgr.shutdown()
+    assert states["dev"] == states["host"]
+
+
+def test_purge_per_duration_spans():
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(_app(
+        "sum(v) as s", "k", "sec, min, hour",
+        agg_header="@purge(sec='2 min', min='1 hour')\n"))
+    agg = rt.aggregations["A"]
+    assert agg.retention_ms == {Duration.SECONDS: 120_000,
+                                Duration.MINUTES: 3_600_000}
+    assert Duration.HOURS not in agg.retention_ms
+    mgr.shutdown()
+
+
+def test_purge_disable_is_respected():
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(_app(
+        "sum(v) as s", "k", "sec",
+        agg_header="@purge(enable='false')\n"))
+    assert rt.aggregations["A"].retention_ms == {}
+    mgr.shutdown()
+
+
+def test_eviction_frees_slots_for_reuse():
+    """Device rings recycle evicted slots (host-side frees, zero device
+    traffic): sustained ingest under retention never grows capacity."""
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(_app(
+        "sum(v) as s", "k", "sec", header="@app:aggCapacity(8)\n",
+        agg_header="@purge(retention='2 sec')\n"))
+    rt.start()
+    agg = rt.aggregations["A"]
+    for k in range(40):      # 40 buckets through an 8-slot ring
+        _feed(rt, [("A", "x", 1.0, 0.0, 1_000 * k)])
+    assert agg.device_plan.capacity(Duration.SECONDS) == 8
+    assert agg.evicted[Duration.SECONDS] >= 30
+    assert agg.device_plan.live_buckets(Duration.SECONDS) <= 4
+    mgr.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# capacity: annotation knob + growth, parity preserved across a grow
+# ---------------------------------------------------------------------------
+
+def test_capacity_annotation_and_growth():
+    batches = [_rows(np.random.default_rng(3), 400, nk=6, nk2=1,
+                     span_ms=90_000)]
+    dev_state, dev_agg = _run(_app(
+        "sum(v) as s, count() as n", "k", "sec",
+        header="@app:aggCapacity(8)\n"), batches)
+    host_state, _ = _run(_app(
+        "sum(v) as s, count() as n", "k", "sec",
+        header="@app:deviceAggregations('off')\n"), batches)
+    # ~90 buckets x 6 keys blew well past 8 slots: the ring doubled
+    cap = dev_agg.device_plan.capacity(Duration.SECONDS)
+    live = dev_agg.device_plan.live_buckets(Duration.SECONDS)
+    assert cap >= live > 8
+    assert dev_state == host_state
+
+
+# ---------------------------------------------------------------------------
+# telemetry: metrics()/statistics()/prometheus surfaces
+# ---------------------------------------------------------------------------
+
+def test_metrics_and_statistics_block():
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(_app("k, sum(v) as s", "k", "sec, min"))
+    rt.start()
+    _feed(rt, [("A", "x", 1.0, 0.0, 1000), ("B", "x", 2.0, 0.0, 2000)])
+    agg = rt.aggregations["A"]
+    m = agg.metrics()
+    assert m["device"] and m["resident"] and m["groups"] == 2
+    assert m["durations"]["SECONDS"]["buckets"] == 2
+    rt.query("from A within 0L, 10000L per 'sec' select k, s")
+    stats = rt.statistics()["aggregation"]
+    assert stats["aggregations"]["A"]["groups"] == 2
+    sq = stats["store_query"]
+    assert sq["batches"] == 1 and sq["events"] == 2
+    from siddhi_tpu.core.telemetry import render_prometheus
+    text = render_prometheus({"AggApp": rt.stats.report()})
+    assert "siddhi_tpu_agg_groups{" in text
+    assert "siddhi_tpu_agg_buckets{" in text
+    assert "siddhi_tpu_agg_store_queries_total{" in text
+    assert "siddhi_tpu_agg_store_query_latency_seconds_bucket{" in text
+    mgr.shutdown()

@@ -131,6 +131,14 @@ FIXTURES = {
         define stream Out (v double);
         @info(name='q') from S[v > 0] select v insert into Out;
     """,
+    "SA15": """
+        define stream Trades (sym string, price double, ts long);
+        define aggregation TradeAgg
+        from Trades
+        select sym, sum(price) as total
+        group by sym
+        aggregate by ts every sec, min;
+    """,
 }
 
 CLEAN = [
@@ -184,6 +192,38 @@ def test_sa04_lossy_type_mismatch():
     """)
     sa04 = [f for f in findings if f.rule_id == "SA04"]
     assert sa04 and "lossy" in sa04[0].message
+
+
+@pytest.mark.parametrize("purge", ["@purge(retention='1 hour')",
+                                   "@purge(enable='false')"])
+def test_sa15_silent_on_purge_decision(purge):
+    """Any @purge on the aggregation — a retention span OR an explicit
+    opt-out — is a decision; SA15 only fires on the silent default."""
+    findings = analyze_source(f"""
+        define stream Trades (sym string, price double, ts long);
+        {purge}
+        define aggregation TradeAgg
+        from Trades
+        select sym, sum(price) as total
+        group by sym
+        aggregate by ts every sec, min;
+    """)
+    assert not [f for f in findings if f.rule_id == "SA15"], \
+        [str(f) for f in findings]
+
+
+def test_sa15_silent_without_group_by():
+    # no group key: one row per bucket, bounded by elapsed time alone —
+    # not the cardinality blow-up the rule is about
+    findings = analyze_source("""
+        define stream Trades (sym string, price double, ts long);
+        define aggregation TotalAgg
+        from Trades
+        select sum(price) as total
+        aggregate by ts every sec, min;
+    """)
+    assert not [f for f in findings if f.rule_id == "SA15"], \
+        [str(f) for f in findings]
 
 
 def test_sa08_reuses_classify_reason_strings():

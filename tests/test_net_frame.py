@@ -196,3 +196,97 @@ def test_ws_frame_oversize_declared_length_desyncs():
     # at-the-bound messages still parse (one protocol frame + header)
     ok = bytearray(bytes([0x82, 126]) + struct.pack(">H", 3) + b"abc")
     assert fp.parse_ws_frame_inplace(ok) == (0x2, b"abc")
+
+
+# ---------------------------------------------------------------------------
+# store-query frames (QUERY / RESULT)
+# ---------------------------------------------------------------------------
+
+def test_query_frame_roundtrip():
+    blob = fp.encode_query(9, "from T select v", app="Dash")
+    ftype, payload = fp.read_frame(_stream_reader(blob))
+    assert ftype == fp.QUERY
+    assert fp.decode_query(payload) == (9, "Dash", "from T select v")
+    # app omitted -> None (the connection's HELLO-bound app serves)
+    _, p2 = fp.read_frame(_stream_reader(fp.encode_query(1, "from T select v")))
+    assert fp.decode_query(p2) == (1, None, "from T select v")
+
+
+def test_query_frame_rejects_garbage():
+    with pytest.raises(fp.FrameError, match="truncated"):
+        fp.decode_query(b"\x00" * 4)
+    with pytest.raises(fp.FrameError, match="truncated"):
+        fp.decode_query(struct.pack("<QH", 1, 99) + b"xy")
+    with pytest.raises(fp.FrameError, match="empty QUERY"):
+        fp.decode_query(struct.pack("<QH", 1, 0) + b"   ")
+
+
+def test_result_frame_roundtrip_with_body():
+    cols = [["sym", "string"], ["total", "double"], ["n", "long"]]
+    body = fp.encode_data_payload(
+        np.array([1000, 2000], dtype=np.int64),
+        [np.array([1, 2], dtype=np.int32),
+         np.array([10.25, 3.5]),
+         np.array([2, 1], dtype=np.int64)])
+    blob = fp.encode_result(5, {"cols": cols}, body)
+    ftype, payload = fp.read_frame(_stream_reader(blob))
+    assert ftype == fp.RESULT
+    token, meta, got_body = fp.decode_result(payload)
+    assert token == 5 and meta == {"cols": cols} and got_body == body
+    ts, views = fp.decode_result_body(got_body, cols)
+    assert ts.tolist() == [1000, 2000]
+    assert views[0].dtype == np.int32 and views[0].tolist() == [1, 2]
+    # doubles are ALWAYS float64 on the result plane
+    assert views[1].dtype == np.float64 and views[1].tolist() == [10.25, 3.5]
+    assert views[2].dtype == np.int64 and views[2].tolist() == [2, 1]
+
+
+def test_result_frame_error_meta():
+    blob = fp.encode_result(3, {"error": "no such aggregation"})
+    _, payload = fp.read_frame(_stream_reader(blob))
+    token, meta, body = fp.decode_result(payload)
+    assert token == 3 and meta["error"] == "no such aggregation"
+    assert body == b""
+
+
+def test_result_body_rejects_malformed():
+    cols = [["v", "double"]]
+    good = fp.encode_data_payload(np.array([1], dtype=np.int64),
+                                  [np.array([1.5])])
+    with pytest.raises(fp.FrameError, match="truncated"):
+        fp.decode_result_body(good[:-3], cols)
+    with pytest.raises(fp.FrameError, match="trailing"):
+        fp.decode_result_body(good + b"\x00", cols)
+    with pytest.raises(fp.FrameError, match="unknown type"):
+        fp.decode_result_body(good, [["v", "wat"]])
+    with pytest.raises(fp.FrameError, match="truncated"):
+        fp.decode_result(b"\x00" * 6)
+
+
+def test_query_result_worked_hex_example_matches_spec():
+    """The docs/SERVING.md store-query worked example: pin the exact
+    bytes of a QUERY frame and its 1-row RESULT so the spec and the
+    implementation cannot drift apart silently."""
+    q = fp.encode_query(7, "from T select v", app="Dash")
+    assert q[:2] == b"FS" and q[2] == 1 and q[3] == fp.QUERY
+    (n,) = struct.unpack_from("<I", q, 4)
+    qp = q[8:8 + n]
+    assert qp[:8] == struct.pack("<Q", 7)             # token
+    assert qp[8:10] == b"\x04\x00"                    # app_len = 4
+    assert qp[10:14] == b"Dash"
+    assert qp[14:] == b"from T select v"
+    (crc,) = struct.unpack_from("<I", q, 8 + n)
+    assert crc == (zlib.crc32(qp) & 0xFFFFFFFF)
+
+    body = fp.encode_data_payload(np.array([1000], dtype=np.int64),
+                                  [np.array([2.5])])
+    r = fp.encode_result(7, {"cols": [["v", "double"]]}, body)
+    assert r[:2] == b"FS" and r[3] == fp.RESULT
+    (n,) = struct.unpack_from("<I", r, 4)
+    rp = r[8:8 + n]
+    assert rp[:8] == struct.pack("<Q", 7)             # token echoes
+    (mlen,) = struct.unpack_from("<I", rp, 8)
+    assert rp[12:12 + mlen] == b'{"cols": [["v", "double"]]}'
+    assert rp[12 + mlen:12 + mlen + 4] == b"\x01\x00\x00\x00"  # n_rows
+    assert rp[12 + mlen + 4:12 + mlen + 12] == struct.pack("<q", 1000)
+    assert rp[12 + mlen + 12:] == struct.pack("<d", 2.5)

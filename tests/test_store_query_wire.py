@@ -1,0 +1,224 @@
+"""Wire-served store queries (QUERY/RESULT frames): round-trips
+byte-identical to in-process `runtime.query()` including string
+columns, WS parity, query-only connections, token-correlated errors,
+and the feed-gate regression — store queries racing a paced ingest
+thread always observe fully-merged bucket state."""
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.net import NetClientError, TcpFrameClient, WsFrameClient
+from siddhi_tpu.service import SiddhiService
+
+TS0 = 1_700_000_000_000
+
+AGG_BODY = (
+    "define stream Trades (sym string, price double, ts long);\n"
+    "define aggregation TradeAgg\n"
+    "from Trades\n"
+    "select sym, sum(price) as total, avg(price) as mean, count() as n\n"
+    "group by sym\n"
+    "aggregate by ts every sec, min;\n")
+
+QUERY = (f"from TradeAgg within {TS0 - 60_000}L, {TS0 + 600_000}L "
+         f"per 'sec' select sym, total, mean, n")
+
+
+def make_batches(n_batches=5, batch=48, seed=11, nsym=6):
+    rng = np.random.default_rng(seed)
+    out = []
+    for k in range(n_batches):
+        ts = TS0 + k * 2_500 + np.sort(rng.integers(0, 2_500, batch))
+        out.append((
+            {"sym": np.array([f"SYM{i}" for i in
+                              rng.integers(0, nsym, batch)]),
+             "price": rng.uniform(10, 500, batch),
+             "ts": ts.astype(np.int64)},
+            ts.astype(np.int64)))
+    return out
+
+
+@pytest.fixture()
+def wired():
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(
+        "@source(type='tcp', port='0')\n" + AGG_BODY)
+    rt.start()
+    yield rt
+    mgr.shutdown()
+
+
+def _client(rt, cls=TcpFrameClient, stream="Trades"):
+    cols = cls.cols_of_schema(rt.schemas[stream]) if stream else None
+    return cls("127.0.0.1", rt.sources[0].port, stream, cols)
+
+
+def test_wire_query_matches_runtime_query(wired):
+    rt = wired
+    cli = _client(rt)
+    for c, ts in make_batches():
+        cli.send_batch(c, ts)
+    cli.barrier()
+    host = rt.query(QUERY)
+    wire = cli.query(QUERY)
+    cli.close()
+    assert len(wire) > 0
+    # byte-identical: f64 totals compare with ==, string group keys
+    # resolved through the egress dictionary, counts as ints
+    assert sorted(wire) == sorted(host)
+
+
+def test_ws_query_matches_runtime_query():
+    mgr = SiddhiManager()
+    rt = mgr.create_app_runtime(
+        "@source(type='ws', port='0')\n" + AGG_BODY)
+    rt.start()
+    cli = _client(rt, cls=WsFrameClient)
+    for c, ts in make_batches(n_batches=3):
+        cli.send_batch(c, ts)
+    cli.barrier()
+    wire = cli.query(QUERY)
+    host = rt.query(QUERY)
+    cli.close()
+    mgr.shutdown()
+    assert len(wire) > 0 and sorted(wire) == sorted(host)
+
+
+def test_string_dictionary_delta_across_queries(wired):
+    """The per-connection egress dictionary ships each string once;
+    later RESULTs reference earlier codes and only delta new ones."""
+    rt = wired
+    cli = _client(rt)
+    batches = make_batches(n_batches=4, nsym=3)
+    cli.send_batch(*batches[0])
+    cli.barrier()
+    assert sorted(cli.query(QUERY)) == sorted(rt.query(QUERY))
+    # new symbols appear between queries -> second RESULT needs a
+    # STRINGS delta on top of the already-shipped codes
+    for c, ts in make_batches(n_batches=2, seed=99, nsym=9):
+        cli.send_batch(c, ts)
+    cli.barrier()
+    assert sorted(cli.query(QUERY)) == sorted(rt.query(QUERY))
+    # and a third query with nothing new ships no fresh strings but
+    # still resolves every code
+    assert sorted(cli.query(QUERY)) == sorted(rt.query(QUERY))
+    cli.close()
+
+
+def test_query_error_correlates_token_and_connection_survives(wired):
+    rt = wired
+    cli = _client(rt)
+    cli.send_batch(*make_batches(n_batches=1)[0])
+    cli.barrier()
+    with pytest.raises(NetClientError, match="not a table"):
+        cli.query("from NoSuchAgg select x")
+    # the error rode a RESULT frame for this token only -- the
+    # connection (and its ingest plane) is still healthy
+    cli.send_batch(*make_batches(n_batches=1, seed=5)[0])
+    cli.barrier()
+    assert sorted(cli.query(QUERY)) == sorted(rt.query(QUERY))
+    cli.close()
+
+
+def test_named_app_query_needs_service_resolver(wired):
+    """A bare @source server has no app registry: named-app store
+    queries are refused with a pointed error, HELLO-bound ones work."""
+    rt = wired
+    cli = TcpFrameClient("127.0.0.1", rt.sources[0].port, app="QApp")
+    with pytest.raises(NetClientError, match="named-app store queries"):
+        cli.query(QUERY)
+    cli.close()
+
+
+def test_store_query_under_paced_ingest_feed_gate(wired):
+    """Regression: store queries used to race the scheduler drain and
+    could observe half-merged bucket state.  Routed under the runtime
+    feed gate, every RESULT reflects a batch boundary: sum(price) with
+    price==1.0 must equal count() in every row of every probe."""
+    rt = wired
+    n_batches, batch = 12, 64
+    stop = threading.Event()
+    err = []
+
+    def feed():
+        fcli = _client(rt)
+        try:
+            for k in range(n_batches):
+                ts = TS0 + np.arange(k * batch, (k + 1) * batch,
+                                     dtype=np.int64)
+                fcli.send_batch(
+                    {"sym": np.array([f"S{i % 7}" for i in range(batch)]),
+                     "price": np.ones(batch), "ts": ts}, ts)
+                time.sleep(0.005)
+            fcli.barrier()
+        except Exception as e:        # pragma: no cover - surfaced below
+            err.append(e)
+        finally:
+            stop.set()
+            fcli.close()
+
+    qcli = _client(rt)
+    t = threading.Thread(target=feed)
+    t.start()
+    probes = 0
+    seen = 0
+    try:
+        while not stop.is_set() or probes == 0:
+            rows = qcli.query(QUERY)
+            probes += 1
+            total_n = 0
+            for _ts, (sym, total, mean, n) in rows:
+                assert total == float(n), (sym, total, n)
+                assert n == 0 or mean == 1.0
+                total_n += n
+            assert total_n >= seen, "store view went backwards"
+            seen = total_n
+            time.sleep(0.002)
+    finally:
+        t.join()
+        qcli.close()
+    assert not err, err
+    assert probes >= 2
+    # after the barrier the final view is complete
+    final = sum(n for _ts, (_s, _t, _m, n) in rt.query(QUERY))
+    assert final == n_batches * batch
+    assert (rt.stats.report()["aggregation"]["store_query"]["batches"]
+            >= probes)
+
+
+def test_rest_query_parity_with_wire():
+    svc = SiddhiService(port=0, net=True).start()
+    try:
+        body = ("@app:name('QDemo')\n" + AGG_BODY).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{svc.port}/siddhi/artifact/deploy",
+            data=body, method="POST")
+        with urllib.request.urlopen(req) as r:
+            assert json.loads(r.read())["status"] == "deployed"
+        rt = svc.runtimes["QDemo"]
+        h = rt.input_handler("Trades")
+        for c, ts in make_batches(n_batches=3):
+            h.send_batch(c, ts)
+        rt.flush()
+        host = rt.query(QUERY)
+        # wire path: query-only connection resolved by app name
+        cli = TcpFrameClient("127.0.0.1", svc.net_port, app="QDemo")
+        wire = cli.query(QUERY)
+        cli.close()
+        assert len(wire) > 0 and sorted(wire) == sorted(host)
+        # REST path: same rows, JSON-shaped
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{svc.port}/siddhi/artifact/query",
+            data=json.dumps({"app": "QDemo", "query": QUERY}).encode(),
+            method="POST")
+        with urllib.request.urlopen(req) as r:
+            rest = json.loads(r.read())["rows"]
+        assert sorted(map(tuple, ((ts, tuple(row)) for ts, row in rest))) \
+            == sorted(host)
+    finally:
+        svc.stop()
